@@ -1,0 +1,227 @@
+"""Controller: the centralized control plane (GCS equivalent).
+
+Parity map to the reference GCS (src/ray/gcs/gcs_server/gcs_server.h:221-295):
+- KV / function store   -> GcsInternalKVManager / GcsFunctionManager
+- actor directory       -> GcsActorManager (incl. max_restarts bookkeeping,
+                           gcs_actor_manager.h:89-91)
+- named actors          -> GcsActorManager named-actor index
+- placement groups      -> GcsPlacementGroupManager (bundle reservation)
+- node table            -> GcsNodeManager
+- task events           -> GcsTaskManager (bounded in-memory history)
+- refcounts             -> centralized stand-in for the distributed
+                           reference counter (core_worker/reference_count.cc)
+
+All state is in-memory in the driver process; the multi-node story keeps
+this process as head node (the reference's head-node GCS is the same
+topology). Persistence hooks (snapshot/restore) land with checkpointing.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu._private.specs import ActorSpec
+
+# Actor lifecycle states (reference rpc::ActorTableData states).
+PENDING = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+@dataclass
+class ActorRecord:
+    spec: ActorSpec
+    state: str = PENDING
+    worker_id: Optional[str] = None
+    node_id: Optional[str] = None
+    num_restarts: int = 0
+    death_cause: str = ""
+    created_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class PlacementGroupRecord:
+    pg_id: str
+    bundles: list[dict]
+    strategy: str
+    state: str = "PENDING"            # PENDING/CREATED/REMOVED
+    name: str = ""
+    # node each bundle was reserved on (single-node v0: all "local")
+    bundle_nodes: list[str] = field(default_factory=list)
+    created_at: float = field(default_factory=time.time)
+
+
+class Controller:
+    def __init__(self, task_event_capacity: int = 10000):
+        self._lock = threading.RLock()
+        self._kv: dict[tuple[str, str], Any] = {}
+        self._actors: dict[str, ActorRecord] = {}
+        self._named_actors: dict[tuple[str, str], str] = {}
+        self._refcounts: dict[str, int] = {}
+        self._pins: dict[str, int] = collections.defaultdict(int)
+        self._pgs: dict[str, PlacementGroupRecord] = {}
+        self._task_events: collections.deque = collections.deque(
+            maxlen=task_event_capacity)
+        self._job_start = time.time()
+
+    # ---- KV (GcsInternalKVManager parity) ----
+    def kv_put(self, key: str, value: Any, namespace: str = "default",
+               overwrite: bool = True) -> bool:
+        with self._lock:
+            k = (namespace, key)
+            if not overwrite and k in self._kv:
+                return False
+            self._kv[k] = value
+            return True
+
+    def kv_get(self, key: str, namespace: str = "default") -> Any:
+        with self._lock:
+            return self._kv.get((namespace, key))
+
+    def kv_del(self, key: str, namespace: str = "default") -> bool:
+        with self._lock:
+            return self._kv.pop((namespace, key), None) is not None
+
+    def kv_exists(self, key: str, namespace: str = "default") -> bool:
+        with self._lock:
+            return (namespace, key) in self._kv
+
+    def kv_keys(self, prefix: str = "", namespace: str = "default") -> list[str]:
+        with self._lock:
+            return [k for (ns, k) in self._kv
+                    if ns == namespace and k.startswith(prefix)]
+
+    # ---- function store ----
+    def put_function(self, func_id: str, data: bytes) -> None:
+        self.kv_put(func_id, data, namespace="_functions", overwrite=False)
+
+    def get_function(self, func_id: str) -> Optional[bytes]:
+        return self.kv_get(func_id, namespace="_functions")
+
+    # ---- refcounts ----
+    def addref(self, object_id: str, n: int = 1) -> None:
+        with self._lock:
+            self._refcounts[object_id] = self._refcounts.get(object_id, 0) + n
+
+    def decref(self, object_id: str) -> bool:
+        """Returns True when the object is now unreferenced and unpinned."""
+        with self._lock:
+            c = self._refcounts.get(object_id, 0) - 1
+            if c > 0:
+                self._refcounts[object_id] = c
+                return False
+            self._refcounts.pop(object_id, None)
+            return self._pins[object_id] == 0
+
+    def pin(self, object_id: str) -> None:
+        with self._lock:
+            self._pins[object_id] += 1
+
+    def unpin(self, object_id: str) -> bool:
+        """Returns True when the object is now unreferenced and unpinned."""
+        with self._lock:
+            self._pins[object_id] = max(0, self._pins[object_id] - 1)
+            return (self._pins[object_id] == 0
+                    and self._refcounts.get(object_id, 0) == 0)
+
+    def refcount(self, object_id: str) -> int:
+        with self._lock:
+            return self._refcounts.get(object_id, 0)
+
+    def unreferenced(self, object_id: str) -> bool:
+        with self._lock:
+            return (self._refcounts.get(object_id, 0) == 0
+                    and self._pins[object_id] == 0)
+
+    # ---- actors ----
+    def register_actor(self, spec: ActorSpec) -> ActorRecord:
+        with self._lock:
+            if spec.name is not None:
+                key = (spec.namespace, spec.name)
+                if key in self._named_actors:
+                    raise ValueError(
+                        f"Actor name {spec.name!r} already taken in "
+                        f"namespace {spec.namespace!r}")
+                self._named_actors[key] = spec.actor_id
+            rec = ActorRecord(spec=spec)
+            self._actors[spec.actor_id] = rec
+            return rec
+
+    def get_actor(self, actor_id: str) -> Optional[ActorRecord]:
+        with self._lock:
+            return self._actors.get(actor_id)
+
+    def get_named_actor(self, name: str,
+                        namespace: str = "default") -> Optional[str]:
+        with self._lock:
+            return self._named_actors.get((namespace, name))
+
+    def set_actor_state(self, actor_id: str, state: str,
+                        worker_id: Optional[str] = None,
+                        death_cause: str = "") -> None:
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            if rec is None:
+                return
+            rec.state = state
+            if worker_id is not None:
+                rec.worker_id = worker_id
+            if death_cause:
+                rec.death_cause = death_cause
+            if state == DEAD and rec.spec.name is not None:
+                self._named_actors.pop(
+                    (rec.spec.namespace, rec.spec.name), None)
+
+    def list_actors(self) -> list[dict]:
+        with self._lock:
+            return [{
+                "actor_id": aid, "state": r.state, "name": r.spec.name,
+                "class_id": r.spec.class_id, "worker_id": r.worker_id,
+                "num_restarts": r.num_restarts,
+                "max_restarts": r.spec.max_restarts,
+                "death_cause": r.death_cause,
+            } for aid, r in self._actors.items()]
+
+    # ---- placement groups ----
+    def register_pg(self, rec: PlacementGroupRecord) -> None:
+        with self._lock:
+            self._pgs[rec.pg_id] = rec
+
+    def get_pg(self, pg_id: str) -> Optional[PlacementGroupRecord]:
+        with self._lock:
+            return self._pgs.get(pg_id)
+
+    def list_pgs(self) -> list[dict]:
+        with self._lock:
+            return [{
+                "placement_group_id": pid, "state": r.state,
+                "bundles": r.bundles, "strategy": r.strategy, "name": r.name,
+            } for pid, r in self._pgs.items()]
+
+    # ---- task events (GcsTaskManager parity) ----
+    def record_task_event(self, task_id: str, name: str, state: str,
+                          worker_id: str = "", error: str = "") -> None:
+        with self._lock:
+            self._task_events.append({
+                "task_id": task_id, "name": name, "state": state,
+                "worker_id": worker_id, "error": error, "ts": time.time(),
+            })
+
+    def list_task_events(self, limit: int = 1000) -> list[dict]:
+        with self._lock:
+            out = list(self._task_events)
+        return out[-limit:]
+
+    def summarize_tasks(self) -> dict:
+        with self._lock:
+            latest: dict[str, dict] = {}
+            for ev in self._task_events:
+                latest[ev["task_id"]] = ev
+        counts: dict[str, int] = collections.defaultdict(int)
+        for ev in latest.values():
+            counts[ev["state"]] += 1
+        return dict(counts)

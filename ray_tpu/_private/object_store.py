@@ -1,0 +1,199 @@
+"""Object plane: serialization + shared-memory object store.
+
+Replaces the reference's two-tier object plane (in-process memory store,
+reference src/ray/core_worker/store_provider/memory_store/memory_store.h:43,
+and the plasma shm arena, reference src/ray/object_manager/plasma/) with:
+
+- ``serialize``/``deserialize`` built on pickle protocol 5 with
+  ``buffer_callback``: large contiguous buffers (numpy / jax host arrays)
+  are carved out-of-band so cross-process transfer is zero-copy through
+  POSIX shared memory, the same property plasma's fd-passing provides
+  (reference plasma/fling.cc) without a bespoke arena: the kernel shm
+  object *is* the arena and the eviction unit.
+- ``LocalStore``: the driver-resident authoritative store. Small payloads
+  live inline; each large buffer lives in its own named shm segment,
+  unlinked when the distributed refcount hits zero (refcounting lives in
+  the controller, reference core_worker/reference_count.cc analogue).
+
+Lifetime design: a segment exists *by name* in the kernel from creation
+until ``shm_unlink``; no process needs to hold a handle to keep it alive.
+Creators therefore write, then immediately close + unregister from the
+resource tracker. Readers map via raw ``mmap`` (not SharedMemory, which
+would leak an fd per attach); the mapping is freed automatically when the
+last deserialized array view is garbage collected. Unlink-while-mapped is
+safe POSIX: existing mappings survive, the name disappears.
+"""
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import threading
+import uuid
+from dataclasses import dataclass, field
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Optional
+
+import _posixshmem  # CPython's shm syscall wrapper (used by SharedMemory)
+import cloudpickle
+
+# Buffers below this many bytes ride inline in the pickled payload; larger
+# ones are carved into shm segments. Mirrors the reference's inline-small
+# -return threshold semantics (task returns under ~100KiB go to the owner's
+# memory store; reference core_worker.h AllocateReturnObject).
+INLINE_THRESHOLD = 100 * 1024
+
+
+def new_object_id() -> str:
+    return uuid.uuid4().hex[:20]
+
+
+@dataclass
+class StoredObject:
+    """Serialized object: inline payload + optional out-of-band shm buffers."""
+    object_id: str
+    payload: bytes                      # pickle5 stream (buffers external)
+    inline_buffers: list[bytes] = field(default_factory=list)
+    shm_names: list[str] = field(default_factory=list)
+    shm_sizes: list[int] = field(default_factory=list)
+    buffer_order: list[str] = field(default_factory=list)  # "i" inline / "s" shm
+    is_error: bool = False              # payload deserializes to an exception
+
+    @property
+    def nbytes(self) -> int:
+        return (len(self.payload) + sum(len(b) for b in self.inline_buffers)
+                + sum(self.shm_sizes))
+
+
+def _create_segment(name: str, data: memoryview) -> None:
+    """Create + fill a named segment, then release all process-local
+    resources; the segment persists by name until shm_unlink."""
+    shm = shared_memory.SharedMemory(name=name, create=True, size=len(data))
+    shm.buf[:len(data)] = data
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
+    shm.close()
+
+
+def _map_segment(name: str, size: int) -> memoryview:
+    """Map an existing segment read-write; the fd is closed immediately so
+    nothing leaks — the mmap lives as long as views into it do."""
+    fd = _posixshmem.shm_open("/" + name, os.O_RDWR, mode=0o600)
+    try:
+        mm = mmap.mmap(fd, size)
+    finally:
+        os.close(fd)
+    return memoryview(mm)[:size]
+
+
+def unlink_segment(name: str) -> None:
+    try:
+        _posixshmem.shm_unlink("/" + name)
+    except FileNotFoundError:
+        pass
+    except OSError:
+        pass
+
+
+def serialize(value: Any, object_id: Optional[str] = None,
+              create_shm: bool = True) -> StoredObject:
+    object_id = object_id or new_object_id()
+    raw_buffers: list[pickle.PickleBuffer] = []
+    payload = cloudpickle.dumps(value, protocol=5,
+                                buffer_callback=raw_buffers.append)
+    inline: list[bytes] = []
+    shm_names: list[str] = []
+    shm_sizes: list[int] = []
+    order: list[str] = []
+    for i, pb in enumerate(raw_buffers):
+        mv = pb.raw()
+        if len(mv) < INLINE_THRESHOLD or not create_shm:
+            inline.append(mv.tobytes())
+            order.append("i")
+        else:
+            name = f"rtpu_{object_id}_{i}"
+            _create_segment(name, mv)
+            shm_names.append(name)
+            shm_sizes.append(len(mv))
+            order.append("s")
+    is_error = isinstance(value, BaseException)
+    return StoredObject(object_id, payload, inline, shm_names, shm_sizes,
+                        order, is_error)
+
+
+def deserialize(obj: StoredObject) -> Any:
+    """Reconstruct the value. shm-backed buffers become zero-copy views
+    whose underlying mappings are freed when the views are collected."""
+    buffers: list[Any] = []
+    ii = si = 0
+    for kind in obj.buffer_order:
+        if kind == "i":
+            buffers.append(obj.inline_buffers[ii]); ii += 1
+        else:
+            buffers.append(_map_segment(obj.shm_names[si],
+                                        obj.shm_sizes[si])); si += 1
+    return pickle.loads(obj.payload, buffers=buffers)
+
+
+class LocalStore:
+    """Driver-resident object store with refcount-driven eviction."""
+
+    def __init__(self):
+        self._objects: dict[str, StoredObject] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def put_stored(self, obj: StoredObject) -> None:
+        with self._cv:
+            self._objects[obj.object_id] = obj
+            self._cv.notify_all()
+
+    def put(self, value: Any, object_id: Optional[str] = None) -> str:
+        obj = serialize(value, object_id)
+        self.put_stored(obj)
+        return obj.object_id
+
+    def contains(self, object_id: str) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get_stored(self, object_id: str,
+                   timeout: Optional[float] = None) -> Optional[StoredObject]:
+        with self._cv:
+            if timeout == 0:
+                return self._objects.get(object_id)
+            ok = self._cv.wait_for(lambda: object_id in self._objects,
+                                   timeout=timeout)
+            return self._objects.get(object_id) if ok else None
+
+    def wait_any(self, object_ids: list[str], num_returns: int,
+                 timeout: Optional[float]) -> list[str]:
+        """Block until >= num_returns of object_ids are local; return ready ids."""
+        with self._cv:
+            def ready():
+                return [o for o in object_ids if o in self._objects]
+            self._cv.wait_for(lambda: len(ready()) >= num_returns,
+                              timeout=timeout)
+            return ready()
+
+    def delete(self, object_id: str) -> None:
+        with self._lock:
+            obj = self._objects.pop(object_id, None)
+        if obj is not None:
+            for name in obj.shm_names:
+                unlink_segment(name)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_objects": len(self._objects),
+                "bytes": sum(o.nbytes for o in self._objects.values()),
+            }
+
+    def shutdown(self) -> None:
+        with self._lock:
+            ids = list(self._objects)
+        for oid in ids:
+            self.delete(oid)

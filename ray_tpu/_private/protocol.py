@@ -1,0 +1,213 @@
+"""Wire protocol for the ray_tpu runtime.
+
+Design: a single full-duplex, length-prefixed-frame protocol over TCP
+(localhost) or later unix sockets. Either endpoint may send *requests*
+(carry a fresh ``rid``) and *replies* (echo the ``rid``). A ``Connection``
+owns a reader thread that routes replies to waiting futures and hands
+requests to a handler callback, so both sides can issue RPCs concurrently
+(a worker blocked in a nested ``get()`` keeps receiving pushed tasks).
+
+This replaces the reference's per-service gRPC stack (reference
+src/ray/rpc/: gcs_server/, node_manager/, worker/) with one multiplexed
+channel per process pair — appropriate because our control plane is
+centralized in the driver process for the single-node runtime, and the
+bulk data plane is shared memory, not the socket.
+"""
+from __future__ import annotations
+
+import io
+import itertools
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Callable, Optional
+
+import cloudpickle
+
+_LEN = struct.Struct("<Q")
+
+# Message types (flat namespace; direction noted).
+REGISTER = "register"            # worker -> driver
+TASK = "task"                    # driver -> worker: run a normal task
+ACTOR_CREATE = "actor_create"    # driver -> worker: instantiate actor
+ACTOR_TASK = "actor_task"        # driver -> worker: run actor method
+TASK_DONE = "task_done"          # worker -> driver (reply to TASK/ACTOR_*)
+GET_OBJECT = "get_object"        # worker -> driver
+PUT_OBJECT = "put_object"        # worker -> driver
+WAIT = "wait"                    # worker -> driver
+SUBMIT = "submit"                # worker -> driver: nested task submission
+SUBMIT_ACTOR = "submit_actor"    # worker -> driver: nested actor creation
+SUBMIT_ACTOR_TASK = "submit_actor_task"  # worker -> driver
+KV_OP = "kv_op"                  # worker -> driver: internal KV get/put/del
+DECREF = "decref"                # worker -> driver: ref-count release
+ADDREF = "addref"                # worker -> driver
+SHUTDOWN = "shutdown"            # driver -> worker
+PING = "ping"                    # either
+REPLY = "reply"                  # either (generic reply)
+STATE_OP = "state_op"            # worker -> driver: state/metrics queries
+
+
+def dumps(obj: Any) -> bytes:
+    """Serialize a message. cloudpickle handles closures/lambdas in specs."""
+    buf = io.BytesIO()
+    cloudpickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+class Connection:
+    """Full-duplex framed-message channel with request/reply correlation."""
+
+    def __init__(self, sock: socket.socket,
+                 handler: Callable[["Connection", dict], None],
+                 on_close: Optional[Callable[["Connection"], None]] = None,
+                 name: str = ""):
+        self._sock = sock
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._handler = handler
+        self._on_close = on_close
+        self.name = name
+        self._send_lock = threading.Lock()
+        self._rid_counter = itertools.count(1)
+        self._pending: dict[int, _Future] = {}
+        self._pending_lock = threading.Lock()
+        self._closed = threading.Event()
+        self.meta: dict = {}  # endpoint-attached metadata (worker id, etc.)
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"ray-tpu-conn-{name}", daemon=True)
+
+    def start(self) -> None:
+        self._reader.start()
+
+    # ---- sending ----
+    def send(self, msg: dict) -> None:
+        data = dumps(msg)
+        header = _LEN.pack(len(data))
+        with self._send_lock:
+            try:
+                self._sock.sendall(header + data)
+            except OSError as e:
+                raise ConnectionClosed(str(e)) from e
+
+    def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
+        """Send a request and block for the matching reply."""
+        fut = self.request_async(msg)
+        return fut.result(timeout)
+
+    def request_async(self, msg: dict) -> "_Future":
+        rid = next(self._rid_counter)
+        msg["rid"] = rid
+        fut = _Future()
+        with self._pending_lock:
+            self._pending[rid] = fut
+        try:
+            self.send(msg)
+        except ConnectionClosed:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise
+        return fut
+
+    def reply(self, request_msg: dict, **fields) -> None:
+        self.send({"type": REPLY, "rid": request_msg["rid"], **fields})
+
+    # ---- receiving ----
+    def _read_exact(self, n: int) -> bytes:
+        chunks = []
+        remaining = n
+        while remaining:
+            chunk = self._sock.recv(min(remaining, 1 << 20))
+            if not chunk:
+                raise ConnectionClosed("peer closed")
+            chunks.append(chunk)
+            remaining -= len(chunk)
+        return b"".join(chunks)
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                header = self._read_exact(_LEN.size)
+                (length,) = _LEN.unpack(header)
+                msg = loads(self._read_exact(length))
+                if msg.get("type") == REPLY:
+                    with self._pending_lock:
+                        fut = self._pending.pop(msg["rid"], None)
+                    if fut is not None:
+                        fut.set(msg)
+                else:
+                    self._handler(self, msg)
+        except (ConnectionClosed, OSError):
+            pass
+        except Exception:  # handler bug; don't kill silently
+            import traceback
+            traceback.print_exc()
+        finally:
+            self._closed.set()
+            with self._pending_lock:
+                pending, self._pending = self._pending, {}
+            for fut in pending.values():
+                fut.set_error(ConnectionClosed("connection lost"))
+            if self._on_close is not None:
+                try:
+                    self._on_close(self)
+                except Exception:
+                    pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _Future:
+    """Minimal thread-safe future for reply correlation."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def set(self, value: Any) -> None:
+        self._value = value
+        self._event.set()
+
+    def set_error(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("rpc timed out")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+def connect(addr: tuple[str, int],
+            handler: Callable[[Connection, dict], None],
+            on_close: Optional[Callable[[Connection], None]] = None,
+            name: str = "") -> Connection:
+    sock = socket.create_connection(addr)
+    conn = Connection(sock, handler, on_close, name=name)
+    conn.start()
+    return conn

@@ -1,0 +1,100 @@
+"""ObjectRef: the distributed future handle.
+
+Parity: reference python/ray/_raylet.pyx ObjectRef + C++ reference counting
+(src/ray/core_worker/reference_count.cc). v0 protocol is centralized: the
+driver's controller owns all refcounts. Driver-held refs inc/dec; refs
+deserialized inside workers are *borrows* that do not decrement (the
+spec-pin held by the submitting side outlives the borrow), a simplification
+of the reference's borrower protocol (reference reference_count.h:115-117)
+that is safe because borrows cannot outlive the task that carries them
+unless returned — and returned refs re-enter driver tracking.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private import context as _context
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owned", "__weakref__")
+
+    def __init__(self, object_id: str, owned: bool = True):
+        self._id = object_id
+        self._owned = owned
+
+    @property
+    def object_id(self) -> str:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self._id})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __hash__(self) -> int:
+        return hash(self._id)
+
+    def __reduce__(self):
+        # Cross-process transfer: reconstruct as a borrowed (non-counting) ref.
+        return (_reconstruct_borrowed, (self._id,))
+
+    def __del__(self):
+        if self._owned:
+            ctx = _context.maybe_ctx()
+            if ctx is not None:
+                try:
+                    ctx.decref(self._id)
+                except Exception:
+                    pass
+
+    # `await ref` support inside async actors.
+    def __await__(self):
+        def _get():
+            ctx = _context.get_ctx()
+            return ctx.get_objects([self._id], timeout=None)[0]
+        yield
+        return _get()
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+        import threading
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        ref = self
+
+        def _run():
+            ctx = _context.get_ctx()
+            try:
+                fut.set_result(ctx.get_objects([ref._id], timeout=None)[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+        threading.Thread(target=_run, daemon=True).start()
+        return fut
+
+
+def _reconstruct_borrowed(object_id: str) -> ObjectRef:
+    return ObjectRef(object_id, owned=False)
+
+
+class ActorID:
+    __slots__ = ("_id",)
+
+    def __init__(self, actor_id: str):
+        self._id = actor_id
+
+    def hex(self) -> str:
+        return self._id
+
+    def __repr__(self) -> str:
+        return f"ActorID({self._id})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ActorID) and other._id == self._id
+
+    def __hash__(self) -> int:
+        return hash(self._id)

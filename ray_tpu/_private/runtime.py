@@ -1,0 +1,575 @@
+"""Driver-side runtime: the core-worker + head-node composition.
+
+This process plays three reference roles at once (single-node topology):
+- the driver's core worker (reference src/ray/core_worker/core_worker.cc:
+  SubmitTask:2166, CreateActor:2243, Put:1246, Get:1551),
+- the GCS head (tables live in ``Controller``),
+- the raylet (dispatch lives in ``Scheduler``).
+
+Multi-process reality is preserved where it matters — user tasks and actors
+always run in separate worker processes wired over the socket protocol, and
+bulk data rides shared memory — so the concurrency/failure semantics match
+the reference even though control-plane hops are function calls.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import socket
+import threading
+import time
+from typing import Any, Optional
+
+from ray_tpu._private import context as _context
+from ray_tpu._private import protocol
+from ray_tpu._private.controller import (ALIVE, DEAD, PENDING, RESTARTING,
+                                         Controller)
+from ray_tpu._private.object_store import LocalStore, StoredObject, deserialize
+from ray_tpu._private.refs import ObjectRef
+from ray_tpu._private.scheduler import Scheduler
+from ray_tpu._private.specs import ActorSpec, ActorTaskSpec, TaskSpec
+from ray_tpu.exceptions import (ActorDiedError, ActorError, GetTimeoutError,
+                                TaskCancelledError, TaskError,
+                                WorkerDiedError)
+
+
+def detect_num_tpu_chips() -> int:
+    """TPU chip detection, reference python/ray/_private/accelerators/tpu.py:98-117
+    (probes /dev/accel* then /dev/vfio), with an env override."""
+    env = os.environ.get("RAY_TPU_CHIPS")
+    if env is not None:
+        return int(env)
+    accel = glob.glob("/dev/accel*")
+    if accel:
+        return len(accel)
+    vfio = glob.glob("/dev/vfio/[0-9]*")
+    if vfio:
+        return len(vfio)
+    return 0
+
+
+class _ActorState:
+    """Driver-side actor-task routing state (actor_task_submitter.cc parity:
+    per-actor ordered queue while the actor is pending/restarting, inflight
+    tracking for failure handling)."""
+
+    def __init__(self):
+        self.queued: list[ActorTaskSpec] = []
+        self.inflight: dict[str, ActorTaskSpec] = {}
+        self.lock = threading.Lock()
+
+
+class Runtime(_context.BaseContext):
+    is_driver = True
+
+    def __init__(self, num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[dict] = None,
+                 max_workers: Optional[int] = None,
+                 namespace: str = "default"):
+        self.namespace = namespace
+        self.store = LocalStore()
+        self.controller = Controller()
+        self._shutdown = False
+        self._actor_states: dict[str, _ActorState] = {}
+        self._actor_lock = threading.Lock()
+
+        if num_cpus is None:
+            num_cpus = float(max(os.cpu_count() or 1, 4))
+        if num_tpus is None:
+            num_tpus = float(detect_num_tpu_chips())
+        node_res = {"CPU": float(num_cpus)}
+        if num_tpus:
+            node_res["TPU"] = float(num_tpus)
+        node_res["memory"] = float(os.environ.get(
+            "RAY_TPU_NODE_MEMORY", 8 * 1024 ** 3))
+        if resources:
+            node_res.update({k: float(v) for k, v in resources.items()})
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(128)
+        self.address = self._listener.getsockname()
+
+        self.scheduler = Scheduler(self, node_res, self.address, max_workers)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ray-tpu-accept", daemon=True)
+        self._accept_thread.start()
+        self.scheduler.start()
+
+    # ================= connection plumbing =================
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            conn = protocol.Connection(sock, self._handle_msg,
+                                       self._on_conn_closed, name="driver")
+            conn.start()
+
+    def _on_conn_closed(self, conn: protocol.Connection) -> None:
+        wid = conn.meta.get("worker_id")
+        if wid is None or self._shutdown:
+            return
+        task, actor_id = self.scheduler.on_worker_lost(wid)
+        if task is not None:
+            self._recover_task(task)
+        if actor_id is not None:
+            self._recover_actor(actor_id)
+
+    # ================= failure recovery =================
+    def _recover_task(self, spec: TaskSpec) -> None:
+        """Reference parity: task retries on worker failure
+        (task_manager.cc retry bookkeeping; max_retries option)."""
+        if spec.retries_used < spec.max_retries:
+            spec.retries_used += 1
+            self.controller.record_task_event(
+                spec.task_id, spec.name, "RETRYING")
+            self.scheduler.enqueue_front(spec)
+        else:
+            err = TaskError(WorkerDiedError(
+                f"worker died running task {spec.name or spec.task_id}"),
+                task_name=spec.name)
+            self._store_error(spec.return_ids, err)
+            self._unpin(spec.pinned_refs)
+            self.controller.record_task_event(
+                spec.task_id, spec.name, "FAILED", error="worker died")
+
+    def _recover_actor(self, actor_id: str) -> None:
+        """GcsActorManager restart-on-failure parity
+        (gcs_actor_manager.h:89-91 max_restarts bookkeeping)."""
+        rec = self.controller.get_actor(actor_id)
+        if rec is None or rec.state == DEAD:
+            return
+        st = self._actor_state(actor_id)
+        with st.lock:
+            inflight = list(st.inflight.values())
+            st.inflight.clear()
+        can_restart = (rec.spec.max_restarts < 0
+                       or rec.num_restarts < rec.spec.max_restarts)
+        if can_restart:
+            rec.num_restarts += 1
+            self.controller.set_actor_state(actor_id, RESTARTING)
+            retried = []
+            for t in inflight:           # preserve submission order
+                if t.retries_used < t.max_retries:
+                    t.retries_used += 1
+                    retried.append(t)
+                else:
+                    self._store_error(t.return_ids, TaskError(
+                        ActorError(actor_id, "actor restarting; task lost"),
+                        task_name=t.name))
+            with st.lock:
+                st.queued[:0] = retried
+            self.scheduler.enqueue_front(rec.spec)
+        else:
+            self.controller.set_actor_state(actor_id, DEAD,
+                                            death_cause="worker died")
+            with st.lock:
+                dead_tasks = inflight + st.queued
+                st.queued = []
+            for t in dead_tasks:
+                self._store_error(t.return_ids, TaskError(
+                    ActorDiedError(actor_id, f"Actor {actor_id} is dead"),
+                    task_name=t.name))
+
+    def _store_error(self, return_ids: list[str], err: BaseException) -> None:
+        for oid in return_ids:
+            self.store.put(err, object_id=oid)
+
+    def _unpin(self, object_ids: list[str]) -> None:
+        for oid in object_ids:
+            if self.controller.unpin(oid):
+                self.store.delete(oid)
+
+    # ================= scheduler callbacks =================
+    def on_task_dispatched(self, spec: TaskSpec, worker_id: str) -> None:
+        self.controller.record_task_event(
+            spec.task_id, spec.name, "RUNNING", worker_id=worker_id)
+
+    def on_actor_dispatched(self, spec: ActorSpec, worker_id: str) -> None:
+        self.controller.set_actor_state(spec.actor_id, PENDING,
+                                        worker_id=worker_id)
+
+    # ================= message handlers =================
+    def _handle_msg(self, conn: protocol.Connection, msg: dict) -> None:
+        mtype = msg["type"]
+        if mtype == protocol.REGISTER:
+            self.scheduler.on_worker_registered(msg["worker_id"], conn)
+        elif mtype == protocol.TASK_DONE:
+            self._on_task_done(conn, msg)
+        elif mtype == protocol.GET_OBJECT:
+            self._on_get_object(conn, msg)
+        elif mtype == protocol.WAIT:
+            self._on_wait(conn, msg)
+        elif mtype == protocol.PUT_OBJECT:
+            stored: StoredObject = msg["stored"]
+            self.store.put_stored(stored)
+            self.controller.addref(stored.object_id)
+            conn.reply(msg, ok=True)
+        elif mtype == protocol.SUBMIT:
+            spec: TaskSpec = msg["spec"]
+            if msg.get("func_bytes") is not None:
+                self.controller.put_function(spec.func_id, msg["func_bytes"])
+            self.submit_spec(spec)
+            conn.reply(msg, ok=True)
+        elif mtype == protocol.SUBMIT_ACTOR:
+            aspec: ActorSpec = msg["spec"]
+            if msg.get("class_bytes") is not None:
+                self.controller.put_function(aspec.class_id,
+                                             msg["class_bytes"])
+            self.create_actor_from_spec(aspec)
+            conn.reply(msg, ok=True)
+        elif mtype == protocol.SUBMIT_ACTOR_TASK:
+            self.submit_actor_task_spec(msg["actor_id"], msg["spec"])
+            conn.reply(msg, ok=True)
+        elif mtype == protocol.KV_OP:
+            conn.reply(msg, value=self._kv_dispatch(msg))
+        elif mtype == protocol.DECREF:
+            self.decref(msg["object_id"])
+        elif mtype == protocol.ADDREF:
+            self.controller.addref(msg["object_id"])
+        elif mtype == protocol.STATE_OP:
+            conn.reply(msg, value=self.state_op(msg["op"], **msg.get(
+                "kwargs", {})))
+        elif mtype == protocol.PING:
+            conn.reply(msg, ok=True)
+
+    def _on_task_done(self, conn: protocol.Connection, msg: dict) -> None:
+        results: list[StoredObject] = msg.get("results", [])
+        for stored in results:
+            self.store.put_stored(stored)
+            # Fire-and-forget results whose refs were already dropped must
+            # be evicted here, or they accumulate until shutdown.
+            if self.controller.unreferenced(stored.object_id):
+                self.store.delete(stored.object_id)
+        worker_id = conn.meta.get("worker_id", "")
+        if msg.get("is_actor_create"):
+            actor_id = msg["actor_id"]
+            self.scheduler.actor_ready(worker_id)
+            if msg.get("error"):
+                rec = self.controller.get_actor(actor_id)
+                if rec is not None:
+                    rec.spec.max_restarts = 0  # init failure is terminal
+                self.controller.set_actor_state(
+                    actor_id, DEAD, death_cause="creation failed")
+                st = self._actor_state(actor_id)
+                with st.lock:
+                    dead = st.queued
+                    st.queued = []
+                cause = msg.get("error_repr", "actor __init__ raised")
+                for t in dead:
+                    self._store_error(t.return_ids, TaskError(
+                        ActorDiedError(actor_id, cause), task_name=t.name))
+            else:
+                self.controller.set_actor_state(actor_id, ALIVE,
+                                                worker_id=worker_id)
+                self._flush_actor_queue(actor_id)
+            return
+        task_id = msg["task_id"]
+        if msg.get("is_actor_task"):
+            st = self._actor_states.get(msg.get("actor_id", ""))
+            if st is not None:
+                with st.lock:
+                    spec = st.inflight.pop(task_id, None)
+                if spec is not None:
+                    self._unpin(spec.pinned_refs)
+            state = "FAILED" if msg.get("error") else "FINISHED"
+            self.controller.record_task_event(task_id, msg.get("name", ""),
+                                              state, worker_id=worker_id)
+            return
+        spec = self.scheduler.task_finished(worker_id)
+        if spec is not None:
+            self._unpin(spec.pinned_refs)
+            state = "FAILED" if msg.get("error") else "FINISHED"
+            self.controller.record_task_event(spec.task_id, spec.name, state,
+                                              worker_id=worker_id)
+
+    def _on_get_object(self, conn: protocol.Connection, msg: dict) -> None:
+        oid = msg["object_id"]
+        stored = self.store.get_stored(oid, timeout=0)
+        if stored is not None:
+            conn.reply(msg, stored=stored)
+            return
+        wid = conn.meta.get("worker_id")
+
+        def waiter():
+            if wid:
+                self.scheduler.worker_blocked(wid)
+            try:
+                got = self.store.get_stored(oid, timeout=msg.get("timeout"))
+                if got is not None:
+                    conn.reply(msg, stored=got)
+                else:
+                    conn.reply(msg, stored=None, timeout=True)
+            except protocol.ConnectionClosed:
+                pass
+            finally:
+                if wid:
+                    self.scheduler.worker_unblocked(wid)
+        threading.Thread(target=waiter, daemon=True).start()
+
+    def _on_wait(self, conn: protocol.Connection, msg: dict) -> None:
+        ids, num_returns = msg["object_ids"], msg["num_returns"]
+        timeout = msg.get("timeout")
+        wid = conn.meta.get("worker_id")
+
+        def waiter():
+            if wid:
+                self.scheduler.worker_blocked(wid)
+            try:
+                ready = self.store.wait_any(ids, num_returns, timeout)
+                ready_set = set(ready)
+                capped = [o for o in ids if o in ready_set][:num_returns]
+                conn.reply(msg, ready=capped)
+            except protocol.ConnectionClosed:
+                pass
+            finally:
+                if wid:
+                    self.scheduler.worker_unblocked(wid)
+        threading.Thread(target=waiter, daemon=True).start()
+
+    def _kv_dispatch(self, msg: dict) -> Any:
+        op = msg["op"]
+        ns = msg.get("namespace", "default")
+        key = msg.get("key", "")
+        if op == "get":
+            return self.controller.kv_get(key, ns)
+        if op == "put":
+            return self.controller.kv_put(key, msg.get("value"), ns,
+                                          msg.get("overwrite", True))
+        if op == "del":
+            return self.controller.kv_del(key, ns)
+        if op == "exists":
+            return self.controller.kv_exists(key, ns)
+        if op == "keys":
+            return self.controller.kv_keys(key, ns)
+        if op == "func_get":
+            return self.controller.get_function(key)
+        raise ValueError(f"unknown kv op {op}")
+
+    # ================= BaseContext API (driver) =================
+    def put(self, value: Any) -> ObjectRef:
+        oid = self.store.put(value)
+        self.controller.addref(oid)
+        return ObjectRef(oid)
+
+    def get_objects(self, object_ids: list[str],
+                    timeout: Optional[float]) -> list[Any]:
+        deadline = None if timeout is None else time.time() + timeout
+        out = []
+        for oid in object_ids:
+            remaining = None if deadline is None else max(
+                0.0, deadline - time.time())
+            stored = self.store.get_stored(oid, timeout=remaining)
+            if stored is None:
+                raise GetTimeoutError(
+                    f"get() timed out waiting for {oid}")
+            value = deserialize(stored)
+            if stored.is_error:
+                raise value
+            out.append(value)
+        return out
+
+    def wait(self, object_ids: list[str], num_returns: int,
+             timeout: Optional[float]) -> tuple[list[str], list[str]]:
+        ready = self.store.wait_any(object_ids, num_returns, timeout)
+        # Contract: at most num_returns in the ready list (reference
+        # ray.wait semantics), in input order.
+        ready_set = set(ready)
+        ready_list = [o for o in object_ids if o in ready_set][:num_returns]
+        taken = set(ready_list)
+        not_ready = [o for o in object_ids if o not in taken]
+        return ready_list, not_ready
+
+    def addref(self, object_id: str) -> None:
+        self.controller.addref(object_id)
+
+    def decref(self, object_id: str) -> None:
+        if self._shutdown:
+            return
+        if self.controller.decref(object_id):
+            self.store.delete(object_id)
+
+    def submit_spec(self, spec: TaskSpec) -> list[str]:
+        for oid in spec.pinned_refs:
+            self.controller.pin(oid)
+        self.controller.record_task_event(spec.task_id, spec.name, "PENDING")
+        self.scheduler.enqueue(spec)
+        return spec.return_ids
+
+    submit_task = submit_spec
+
+    def register_function(self, func_id: str, data: bytes) -> None:
+        self.controller.put_function(func_id, data)
+
+    # ---- actors ----
+    def _actor_state(self, actor_id: str) -> _ActorState:
+        with self._actor_lock:
+            st = self._actor_states.get(actor_id)
+            if st is None:
+                st = self._actor_states[actor_id] = _ActorState()
+            return st
+
+    def create_actor_from_spec(self, spec: ActorSpec) -> str:
+        self.controller.register_actor(spec)
+        self._actor_state(spec.actor_id)
+        self.scheduler.enqueue(spec)
+        return spec.actor_id
+
+    create_actor = create_actor_from_spec
+
+    def submit_actor_task_spec(self, actor_id: str,
+                               spec: ActorTaskSpec) -> list[str]:
+        for oid in spec.pinned_refs:
+            self.controller.pin(oid)
+        rec = self.controller.get_actor(actor_id)
+        if rec is None:
+            self._store_error(spec.return_ids, TaskError(
+                ActorError(actor_id, "unknown actor"), task_name=spec.name))
+            return spec.return_ids
+        st = self._actor_state(actor_id)
+        with st.lock:
+            if rec.state == DEAD:
+                self._store_error(spec.return_ids, TaskError(
+                    ActorDiedError(actor_id,
+                                   f"Actor {actor_id} is dead: "
+                                   f"{rec.death_cause}"),
+                    task_name=spec.name))
+                return spec.return_ids
+            if rec.state != ALIVE or rec.worker_id is None:
+                st.queued.append(spec)
+                return spec.return_ids
+            st.inflight[spec.task_id] = spec
+            target = rec.worker_id
+        if not self.scheduler.send_actor_task(target, spec):
+            with st.lock:
+                # Requeue only if a concurrent _recover_actor didn't already
+                # claim it from inflight (else it would run twice).
+                if st.inflight.pop(spec.task_id, None) is not None:
+                    st.queued.append(spec)
+        return spec.return_ids
+
+    submit_actor_task = submit_actor_task_spec
+
+    def _flush_actor_queue(self, actor_id: str) -> None:
+        rec = self.controller.get_actor(actor_id)
+        if rec is None or rec.state != ALIVE:
+            return
+        st = self._actor_state(actor_id)
+        while True:
+            with st.lock:
+                if not st.queued:
+                    return
+                spec = st.queued.pop(0)
+                st.inflight[spec.task_id] = spec
+                target = rec.worker_id
+            if not self.scheduler.send_actor_task(target, spec):
+                with st.lock:
+                    st.inflight.pop(spec.task_id, None)
+                    st.queued.insert(0, spec)
+                return
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        rec = self.controller.get_actor(actor_id)
+        if rec is None:
+            return
+        if no_restart:
+            rec.spec.max_restarts = 0
+        wid = rec.worker_id
+        if wid is not None:
+            self.scheduler.kill_worker(wid)
+
+    def cancel_task(self, object_id: str, force: bool = False) -> None:
+        # v0: cancel only reaches queued (not yet running) tasks, matching
+        # the reference's non-force semantics for unscheduled tasks.
+        # Return ids are "<task_id>r<i>" and task ids are hex, so 'r' splits.
+        task_id = object_id.split("r", 1)[0]
+        spec = self.scheduler.cancel_pending(task_id)
+        if spec is not None:
+            err = TaskCancelledError(task_id)
+            self._store_error(spec.return_ids, TaskError(
+                err, task_name=spec.name))
+            self._unpin(spec.pinned_refs)
+            self.controller.record_task_event(task_id, spec.name,
+                                              "CANCELLED")
+
+    def get_actor_handle(self, name: str, namespace: str = "default"):
+        actor_id = self.controller.get_named_actor(name, namespace)
+        if actor_id is None:
+            raise ValueError(f"No actor named {name!r} in namespace "
+                             f"{namespace!r}")
+        rec = self.controller.get_actor(actor_id)
+        from ray_tpu.actor import ActorHandle
+        import pickle as _p
+        cls = _p.loads(self.controller.get_function(rec.spec.class_id))
+        return ActorHandle._from_class(actor_id, cls,
+                                       rec.spec.max_task_retries)
+
+    # ---- state / introspection ----
+    def state_op(self, op: str, **kwargs) -> Any:
+        if op == "list_actors":
+            return self.controller.list_actors()
+        if op == "list_tasks":
+            return self.controller.list_task_events(
+                kwargs.get("limit", 1000))
+        if op == "summarize_tasks":
+            return self.controller.summarize_tasks()
+        if op == "list_placement_groups":
+            return self.controller.list_pgs()
+        if op == "cluster_resources":
+            return dict(self.scheduler.total)
+        if op == "available_resources":
+            return dict(self.scheduler.avail)
+        if op == "scheduler_stats":
+            return self.scheduler.stats()
+        if op == "object_store_stats":
+            return self.store.stats()
+        if op == "kill_actor":
+            self.kill_actor(kwargs["actor_id"],
+                            kwargs.get("no_restart", True))
+            return True
+        raise ValueError(f"unknown state op {op}")
+
+    def node_resources(self) -> dict:
+        return dict(self.scheduler.total)
+
+    # ---- lifecycle ----
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        self.scheduler.shutdown()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.store.shutdown()
+
+
+# ================= module-level init/shutdown =================
+def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
+         resources: Optional[dict] = None, max_workers: Optional[int] = None,
+         namespace: str = "default",
+         ignore_reinit_error: bool = False) -> Runtime:
+    existing = _context.maybe_ctx()
+    if existing is not None:
+        if ignore_reinit_error:
+            return existing  # type: ignore[return-value]
+        if existing.is_driver:
+            raise RuntimeError("ray_tpu.init() called twice; pass "
+                               "ignore_reinit_error=True to allow this.")
+        return existing  # inside a worker: init is a no-op, like ray.init
+    rt = Runtime(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+                 max_workers=max_workers, namespace=namespace)
+    _context.set_ctx(rt)
+    return rt
+
+
+def shutdown() -> None:
+    ctx = _context.maybe_ctx()
+    if ctx is not None and isinstance(ctx, Runtime):
+        ctx.shutdown()
+        _context.set_ctx(None)

@@ -1,0 +1,365 @@
+"""Scheduler + worker pool: the raylet-equivalent per-node layer.
+
+Parity map (reference src/ray/raylet/):
+- ``Scheduler`` dispatch loop -> ClusterTaskManager::QueueAndScheduleTask +
+  LocalTaskManager::DispatchScheduledTasksToWorkers
+  (cluster_task_manager.cc:44, local_task_manager.cc:122) collapsed into one
+  loop because the v0 cluster is one logical node owned by the driver.
+- ``WorkerPool`` -> raylet WorkerPool (worker_pool.h:366 PopWorker): spawns
+  `python -m ray_tpu._private.worker_main` subprocesses on demand up to a
+  cap, reuses idle ones keyed by nothing (no runtime-env keying yet).
+- blocked-worker resource release mirrors the reference's behavior where a
+  worker blocked in `ray.get` releases its CPU so the node can oversubscribe
+  (avoids the classic nested-task deadlock).
+- resource accounting -> ClusterResourceScheduler fixed-point math
+  (common/scheduling/) simplified to float math on dicts.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ray_tpu._private import protocol
+from ray_tpu._private.specs import ActorSpec, ActorTaskSpec, TaskSpec
+
+IDLE = "idle"
+BUSY = "busy"
+ACTOR = "actor"
+STARTING = "starting"
+DEAD = "dead"
+
+_SPAWN_TIMEOUT_S = 60.0
+
+
+@dataclass
+class WorkerRec:
+    worker_id: str
+    proc: Optional[subprocess.Popen] = None
+    conn: Optional[protocol.Connection] = None
+    state: str = STARTING
+    task: Optional[TaskSpec] = None
+    actor_id: Optional[str] = None
+    acquired: dict[str, float] = field(default_factory=dict)
+    blocked_depth: int = 0
+    started_at: float = field(default_factory=time.time)
+
+
+def fits(avail: dict[str, float], need: dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in need.items() if v)
+
+
+def acquire(avail: dict[str, float], need: dict[str, float]) -> None:
+    for k, v in need.items():
+        if v:
+            avail[k] = avail.get(k, 0.0) - v
+
+
+def release(avail: dict[str, float], got: dict[str, float]) -> None:
+    for k, v in got.items():
+        if v:
+            avail[k] = avail.get(k, 0.0) + v
+
+
+class Scheduler:
+    """Single-node scheduler: task queue, resource ledger, worker pool."""
+
+    def __init__(self, runtime, node_resources: dict[str, float],
+                 listen_addr: tuple[str, int], max_workers: Optional[int] = None):
+        self._rt = runtime
+        self.node_id = "node_" + uuid.uuid4().hex[:8]
+        self.total = dict(node_resources)
+        self.avail = dict(node_resources)
+        self._addr = listen_addr
+        self._max_workers = max_workers or max(
+            int(node_resources.get("CPU", 4)) * 2, 8)
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: deque = deque()           # TaskSpec | ActorSpec
+        self._workers: dict[str, WorkerRec] = {}
+        self._running = True
+        self._spawning = 0
+        self._thread = threading.Thread(
+            target=self._loop, name="ray-tpu-scheduler", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    # ---- submission ----
+    def enqueue(self, spec) -> None:
+        with self._cv:
+            self._pending.append(spec)
+            self._cv.notify_all()
+
+    def enqueue_front(self, spec) -> None:
+        with self._cv:
+            self._pending.appendleft(spec)
+            self._cv.notify_all()
+
+    def cancel_pending(self, task_id: str) -> Optional[TaskSpec]:
+        with self._cv:
+            for spec in list(self._pending):
+                if isinstance(spec, TaskSpec) and spec.task_id == task_id:
+                    self._pending.remove(spec)
+                    return spec
+        return None
+
+    # ---- worker lifecycle ----
+    def spawn_worker(self) -> WorkerRec:
+        wid = "w_" + uuid.uuid4().hex[:8]
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RAY_TPU_WORKER_ID"] = wid
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main",
+             "--addr", f"{self._addr[0]}:{self._addr[1]}",
+             "--worker-id", wid],
+            env=env)
+        rec = WorkerRec(worker_id=wid, proc=proc)
+        with self._cv:
+            self._workers[wid] = rec
+            self._spawning += 1
+        return rec
+
+    def on_worker_registered(self, worker_id: str,
+                             conn: protocol.Connection) -> None:
+        with self._cv:
+            rec = self._workers.get(worker_id)
+            if rec is None:             # worker from a previous epoch
+                conn.close()
+                return
+            rec.conn = conn
+            if rec.state == STARTING:
+                rec.state = IDLE
+                self._spawning = max(0, self._spawning - 1)
+            conn.meta["worker_id"] = worker_id
+            self._cv.notify_all()
+
+    def on_worker_lost(self, worker_id: str):
+        """Returns (task, actor_id) that were running there, for recovery."""
+        with self._cv:
+            rec = self._workers.get(worker_id)
+            if rec is None or rec.state == DEAD:
+                return None, None
+            if rec.state == STARTING:
+                self._spawning = max(0, self._spawning - 1)
+            task, actor_id = rec.task, rec.actor_id
+            if rec.acquired and rec.blocked_depth == 0:
+                release(self.avail, rec.acquired)
+            rec.state = DEAD
+            rec.task = None
+            rec.acquired = {}
+            self._cv.notify_all()
+            return task, actor_id
+
+    def kill_worker(self, worker_id: str) -> None:
+        with self._lock:
+            rec = self._workers.get(worker_id)
+        if rec is None:
+            return
+        if rec.conn is not None:
+            try:
+                rec.conn.send({"type": protocol.SHUTDOWN})
+            except Exception:
+                pass
+        if rec.proc is not None:
+            try:
+                rec.proc.terminate()
+            except Exception:
+                pass
+
+    # ---- blocked-worker accounting ----
+    def worker_blocked(self, worker_id: str) -> None:
+        with self._cv:
+            rec = self._workers.get(worker_id)
+            if rec is None:
+                return
+            rec.blocked_depth += 1
+            if rec.blocked_depth == 1 and rec.acquired:
+                release(self.avail, rec.acquired)
+            self._cv.notify_all()
+
+    def worker_unblocked(self, worker_id: str) -> None:
+        with self._cv:
+            rec = self._workers.get(worker_id)
+            if rec is None:
+                return
+            rec.blocked_depth = max(0, rec.blocked_depth - 1)
+            if rec.blocked_depth == 0 and rec.acquired and rec.state != DEAD:
+                # Re-acquire (may oversubscribe transiently, as the reference
+                # raylet does when a blocked worker resumes).
+                acquire(self.avail, rec.acquired)
+
+    # ---- completion ----
+    def task_finished(self, worker_id: str) -> Optional[TaskSpec]:
+        with self._cv:
+            rec = self._workers.get(worker_id)
+            if rec is None:
+                return None
+            task = rec.task
+            rec.task = None
+            if rec.state == BUSY:
+                if rec.blocked_depth == 0 and rec.acquired:
+                    release(self.avail, rec.acquired)
+                rec.acquired = {}
+                rec.state = IDLE
+            elif rec.state == ACTOR:
+                pass                      # actor keeps its resources
+            self._cv.notify_all()
+            return task
+
+    def actor_ready(self, worker_id: str) -> None:
+        with self._cv:
+            rec = self._workers.get(worker_id)
+            if rec is not None:
+                rec.task = None
+            self._cv.notify_all()
+
+    # ---- dispatch loop ----
+    def _pick_worker(self) -> Optional[WorkerRec]:
+        for rec in self._workers.values():
+            if rec.state == IDLE and rec.conn is not None:
+                return rec
+        return None
+
+    def _alive_count(self) -> int:
+        return sum(1 for r in self._workers.values() if r.state != DEAD)
+
+    def _effective_need(self, spec) -> dict[str, float]:
+        res = dict(spec.resources) if spec.resources else {}
+        if "CPU" not in res and not res.get("_pg_reserved"):
+            res.setdefault("CPU", 1.0)
+        res.pop("_pg_reserved", None)
+        return res
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                if not self._running:
+                    return
+                self._reap_failed_spawns_locked()
+                dispatched = self._try_dispatch_locked()
+                if not dispatched:
+                    self._cv.wait(timeout=0.25)
+
+    def _reap_failed_spawns_locked(self) -> None:
+        """A worker that exits (or hangs) before registering would otherwise
+        hold a _spawning slot forever and stall dispatch permanently."""
+        now = time.time()
+        for rec in self._workers.values():
+            if rec.state != STARTING:
+                continue
+            exited = rec.proc is not None and rec.proc.poll() is not None
+            timed_out = now - rec.started_at > _SPAWN_TIMEOUT_S
+            if exited or timed_out:
+                rec.state = DEAD
+                self._spawning = max(0, self._spawning - 1)
+                sys.stderr.write(
+                    f"ray_tpu: worker {rec.worker_id} failed to start "
+                    f"({'exited' if exited else 'timed out'})\n")
+                if timed_out and rec.proc is not None:
+                    try:
+                        rec.proc.kill()
+                    except Exception:
+                        pass
+
+    def _try_dispatch_locked(self) -> bool:
+        for spec in list(self._pending):
+            need = self._effective_need(spec)
+            if not fits(self.avail, need):
+                continue
+            worker = self._pick_worker()
+            if worker is None:
+                blocked = sum(1 for r in self._workers.values()
+                              if r.blocked_depth > 0 and r.state != DEAD)
+                # Spawn only for unmet demand: never more in-flight spawns
+                # than pending work items (raylet WorkerPool prestart logic,
+                # worker_pool.cc PrestartWorkers, is demand-capped the same
+                # way).
+                if (self._alive_count() - blocked < self._max_workers
+                        and self._spawning < min(len(self._pending), 4)):
+                    self._cv.release()
+                    try:
+                        self.spawn_worker()
+                    finally:
+                        self._cv.acquire()
+                return False              # wait for registration
+            self._pending.remove(spec)
+            acquire(self.avail, need)
+            worker.acquired = need
+            if isinstance(spec, ActorSpec):
+                worker.state = ACTOR
+                worker.actor_id = spec.actor_id
+                self._rt.on_actor_dispatched(spec, worker.worker_id)
+                worker.conn.send({"type": protocol.ACTOR_CREATE,
+                                  "spec": spec})
+            else:
+                worker.state = BUSY
+                worker.task = spec
+                self._rt.on_task_dispatched(spec, worker.worker_id)
+                worker.conn.send({"type": protocol.TASK, "spec": spec})
+            return True
+        return False
+
+    # ---- actor task routing (bypasses the queue: direct to its worker) ----
+    def send_actor_task(self, actor_worker_id: str,
+                        spec: ActorTaskSpec) -> bool:
+        with self._lock:
+            rec = self._workers.get(actor_worker_id)
+            if rec is None or rec.state == DEAD or rec.conn is None:
+                return False
+            try:
+                rec.conn.send({"type": protocol.ACTOR_TASK, "spec": spec})
+                return True
+            except protocol.ConnectionClosed:
+                return False
+
+    def worker_for_actor(self, actor_id: str) -> Optional[str]:
+        with self._lock:
+            for rec in self._workers.values():
+                if rec.actor_id == actor_id and rec.state != DEAD:
+                    return rec.worker_id
+        return None
+
+    # ---- introspection ----
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "node_id": self.node_id,
+                "total_resources": dict(self.total),
+                "available_resources": dict(self.avail),
+                "num_workers": self._alive_count(),
+                "num_pending_tasks": len(self._pending),
+                "workers": {
+                    w: {"state": r.state, "actor_id": r.actor_id,
+                        "blocked": r.blocked_depth}
+                    for w, r in self._workers.items() if r.state != DEAD},
+            }
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._running = False
+            workers = list(self._workers.values())
+            self._cv.notify_all()
+        for rec in workers:
+            if rec.conn is not None:
+                try:
+                    rec.conn.send({"type": protocol.SHUTDOWN})
+                except Exception:
+                    pass
+        deadline = time.time() + 3.0
+        for rec in workers:
+            if rec.proc is not None:
+                try:
+                    rec.proc.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    rec.proc.kill()

@@ -1,0 +1,332 @@
+"""Worker process entry point + worker-side context.
+
+Parity: the reference's `default_worker.py` + worker-side core worker
+(reference python/ray/_private/workers/default_worker.py and
+src/ray/core_worker/core_worker.cc RunTaskExecutionLoop:2840 /
+ExecuteTask:2914). Execution flows through a thread pool whose width is the
+actor's ``max_concurrency`` (concurrency-group parity,
+core_worker/transport/concurrency_group_manager.cc, width only), so the
+socket reader thread never runs user code and a worker blocked in a nested
+``get`` keeps draining pushed messages.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import inspect
+import os
+import pickle
+import sys
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu._private import context as _context
+from ray_tpu._private import protocol
+from ray_tpu._private.object_store import StoredObject, deserialize, serialize
+from ray_tpu._private.refs import ObjectRef
+from ray_tpu._private.specs import (ActorSpec, ActorTaskSpec, RefMarker,
+                                    TaskSpec, extract_ref_args, function_id,
+                                    new_actor_id, new_task_id)
+from ray_tpu.exceptions import (GetTimeoutError, TaskError, format_exception)
+
+
+class WorkerContext(_context.BaseContext):
+    is_driver = False
+
+    def __init__(self, conn: protocol.Connection, worker_id: str):
+        self.conn = conn
+        self.worker_id = worker_id
+        self._sent_funcs: set[str] = set()
+
+    # ---- object plane ----
+    def put(self, value: Any) -> ObjectRef:
+        stored = serialize(value)
+        self.conn.request({"type": protocol.PUT_OBJECT, "stored": stored})
+        return ObjectRef(stored.object_id, owned=True)
+
+    def get_objects(self, object_ids: list[str],
+                    timeout: Optional[float]) -> list[Any]:
+        out = []
+        for oid in object_ids:
+            reply = self.conn.request(
+                {"type": protocol.GET_OBJECT, "object_id": oid,
+                 "timeout": timeout})
+            if reply.get("timeout") or reply.get("stored") is None:
+                raise GetTimeoutError(f"get() timed out waiting for {oid}")
+            stored: StoredObject = reply["stored"]
+            value = deserialize(stored)
+            if stored.is_error:
+                raise value
+            out.append(value)
+        return out
+
+    def wait(self, object_ids: list[str], num_returns: int,
+             timeout: Optional[float]):
+        reply = self.conn.request(
+            {"type": protocol.WAIT, "object_ids": object_ids,
+             "num_returns": num_returns, "timeout": timeout})
+        ready = set(reply.get("ready", []))
+        return ([o for o in object_ids if o in ready],
+                [o for o in object_ids if o not in ready])
+
+    def decref(self, object_id: str) -> None:
+        try:
+            self.conn.send({"type": protocol.DECREF, "object_id": object_id})
+        except protocol.ConnectionClosed:
+            pass
+
+    def addref(self, object_id: str) -> None:
+        try:
+            self.conn.send({"type": protocol.ADDREF, "object_id": object_id})
+        except protocol.ConnectionClosed:
+            pass
+
+    # ---- task plane (nested submission) ----
+    def submit_task(self, spec: TaskSpec, func_bytes: bytes = None) -> list[str]:
+        fb = None
+        if spec.func_id not in self._sent_funcs:
+            fb = func_bytes
+            self._sent_funcs.add(spec.func_id)
+        self.conn.request({"type": protocol.SUBMIT, "spec": spec,
+                           "func_bytes": fb})
+        return spec.return_ids
+
+    def create_actor(self, spec: ActorSpec, class_bytes: bytes = None) -> str:
+        fb = None
+        if spec.class_id not in self._sent_funcs:
+            fb = class_bytes
+            self._sent_funcs.add(spec.class_id)
+        self.conn.request({"type": protocol.SUBMIT_ACTOR, "spec": spec,
+                           "class_bytes": fb})
+        return spec.actor_id
+
+    def submit_actor_task(self, actor_id: str,
+                          spec: ActorTaskSpec) -> list[str]:
+        self.conn.request({"type": protocol.SUBMIT_ACTOR_TASK,
+                           "actor_id": actor_id, "spec": spec})
+        return spec.return_ids
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self.state_op("kill_actor", actor_id=actor_id)
+
+    def cancel_task(self, object_id: str, force: bool = False) -> None:
+        pass
+
+    # ---- control plane ----
+    def kv_op(self, op: str, key: str, value: Any = None,
+              namespace: str = "default", **kw) -> Any:
+        reply = self.conn.request({"type": protocol.KV_OP, "op": op,
+                                   "key": key, "value": value,
+                                   "namespace": namespace, **kw})
+        return reply.get("value")
+
+    def get_function(self, func_id: str) -> bytes:
+        return self.kv_op("func_get", func_id)
+
+    def state_op(self, op: str, **kwargs) -> Any:
+        reply = self.conn.request({"type": protocol.STATE_OP, "op": op,
+                                   "kwargs": kwargs})
+        return reply.get("value")
+
+    def get_actor_handle(self, name: str, namespace: str = "default"):
+        actors = self.state_op("list_actors")
+        for a in actors:
+            if a["name"] == name and a["state"] != "DEAD":
+                cls = pickle.loads(self.get_function(a["class_id"]))
+                from ray_tpu.actor import ActorHandle
+                return ActorHandle._from_class(a["actor_id"], cls, 0)
+        raise ValueError(f"No actor named {name!r}")
+
+    def node_resources(self) -> dict:
+        return self.state_op("cluster_resources")
+
+
+class WorkerExecutor:
+    def __init__(self, ctx: WorkerContext):
+        self.ctx = ctx
+        self._fn_cache: dict[str, Any] = {}
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="rtpu-exec")
+        self._actor: Any = None
+        self._actor_spec: Optional[ActorSpec] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.stop_event = threading.Event()
+
+    # ---- message entry (called on reader thread) ----
+    def handle(self, conn: protocol.Connection, msg: dict) -> None:
+        mtype = msg["type"]
+        if mtype == protocol.TASK:
+            self._pool.submit(self._run_task, msg["spec"])
+        elif mtype == protocol.ACTOR_CREATE:
+            spec: ActorSpec = msg["spec"]
+            if spec.max_concurrency > 1:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=spec.max_concurrency,
+                    thread_name_prefix="rtpu-actor")
+            self._pool.submit(self._create_actor, spec)
+        elif mtype == protocol.ACTOR_TASK:
+            aspec: ActorTaskSpec = msg["spec"]
+            method = getattr(type(self._actor), aspec.method_name, None) \
+                if self._actor is not None else None
+            if method is not None and inspect.iscoroutinefunction(method):
+                self._ensure_loop()
+                asyncio.run_coroutine_threadsafe(
+                    self._run_actor_task_async(aspec), self._loop)
+            else:
+                self._pool.submit(self._run_actor_task, aspec)
+        elif mtype == protocol.SHUTDOWN:
+            self.stop_event.set()
+        elif mtype == protocol.PING:
+            conn.reply(msg, ok=True)
+
+    def _ensure_loop(self) -> None:
+        if self._loop is None:
+            self._loop = asyncio.new_event_loop()
+            threading.Thread(target=self._loop.run_forever,
+                             name="rtpu-actor-loop", daemon=True).start()
+
+    # ---- execution ----
+    def _load_function(self, func_id: str):
+        fn = self._fn_cache.get(func_id)
+        if fn is None:
+            data = self.ctx.get_function(func_id)
+            if data is None:
+                raise RuntimeError(f"function {func_id} not found in store")
+            fn = cloudpickle.loads(data)
+            self._fn_cache[func_id] = fn
+        return fn
+
+    def _resolve_args(self, args, kwargs):
+        ref_ids = [a.object_id for a in args if isinstance(a, RefMarker)]
+        ref_ids += [v.object_id for v in kwargs.values()
+                    if isinstance(v, RefMarker)]
+        values = {}
+        if ref_ids:
+            got = self.ctx.get_objects(ref_ids, timeout=None)
+            values = dict(zip(ref_ids, got))
+        conv = lambda v: values[v.object_id] if isinstance(v, RefMarker) else v
+        return tuple(conv(a) for a in args), {
+            k: conv(v) for k, v in kwargs.items()}
+
+    def _send_results(self, task_id: str, return_ids: list[str],
+                      result: Any, num_returns: int, error: bool,
+                      **extra) -> None:
+        if not error and num_returns > 1:
+            if not isinstance(result, (tuple, list)) or \
+                    len(result) != num_returns:
+                error = True
+                result = TaskError(ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{type(result).__name__}"))
+        stored_list = []
+        if error or num_returns <= 1:
+            values = [result] * len(return_ids)
+        else:
+            values = list(result)
+        for oid, value in zip(return_ids, values):
+            stored = serialize(value, object_id=oid)
+            stored.is_error = error
+            stored_list.append(stored)
+        self.ctx.conn.send({"type": protocol.TASK_DONE,
+                            "task_id": task_id, "results": stored_list,
+                            "error": error, **extra})
+
+    def _run_task(self, spec: TaskSpec) -> None:
+        try:
+            fn = self._load_function(spec.func_id)
+            args, kwargs = self._resolve_args(spec.args, spec.kwargs)
+            result = fn(*args, **kwargs)
+            error = False
+        except BaseException as e:  # noqa: BLE001
+            result = e if isinstance(e, TaskError) else TaskError(
+                e, format_exception(e), task_name=spec.name)
+            error = True
+        self._send_results(spec.task_id, spec.return_ids, result,
+                           spec.num_returns, error, name=spec.name)
+
+    def _create_actor(self, spec: ActorSpec) -> None:
+        try:
+            cls = self._load_function(spec.class_id)
+            args, kwargs = self._resolve_args(spec.init_args,
+                                              spec.init_kwargs)
+            self._actor = cls(*args, **kwargs)
+            self._actor_spec = spec
+            err = False
+            err_repr = ""
+        except BaseException as e:  # noqa: BLE001
+            err = True
+            err_repr = format_exception(e)
+            sys.stderr.write(f"actor creation failed:\n{err_repr}")
+        self.ctx.conn.send({"type": protocol.TASK_DONE,
+                            "task_id": f"create:{spec.actor_id}",
+                            "results": [], "error": err,
+                            "error_repr": err_repr,
+                            "is_actor_create": True,
+                            "actor_id": spec.actor_id})
+
+    def _invoke_actor_method(self, spec: ActorTaskSpec):
+        method = getattr(self._actor, spec.method_name)
+        args, kwargs = self._resolve_args(spec.args, spec.kwargs)
+        return method(*args, **kwargs)
+
+    def _run_actor_task(self, spec: ActorTaskSpec) -> None:
+        try:
+            result = self._invoke_actor_method(spec)
+            error = False
+        except BaseException as e:  # noqa: BLE001
+            result = TaskError(e, format_exception(e), task_name=spec.name)
+            error = True
+        self._send_results(spec.task_id, spec.return_ids, result,
+                           spec.num_returns, error, is_actor_task=True,
+                           actor_id=spec.actor_id, name=spec.name)
+
+    async def _run_actor_task_async(self, spec: ActorTaskSpec) -> None:
+        try:
+            method = getattr(self._actor, spec.method_name)
+            args, kwargs = self._resolve_args(spec.args, spec.kwargs)
+            result = await method(*args, **kwargs)
+            error = False
+        except BaseException as e:  # noqa: BLE001
+            result = TaskError(e, format_exception(e), task_name=spec.name)
+            error = True
+        self._send_results(spec.task_id, spec.return_ids, result,
+                           spec.num_returns, error, is_actor_task=True,
+                           actor_id=spec.actor_id, name=spec.name)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", required=True)
+    parser.add_argument("--worker-id", required=True)
+    args = parser.parse_args()
+    host, port = args.addr.rsplit(":", 1)
+
+    executor_box: dict = {}
+
+    def handler(conn, msg):
+        executor_box["exec"].handle(conn, msg)
+
+    def on_close(conn):
+        # Driver went away: nothing useful left to do.
+        os._exit(0)
+
+    conn = protocol.connect((host, int(port)), handler, on_close,
+                            name=f"worker-{args.worker_id}")
+    ctx = WorkerContext(conn, args.worker_id)
+    _context.set_ctx(ctx)
+    executor = WorkerExecutor(ctx)
+    executor_box["exec"] = executor
+    conn.send({"type": protocol.REGISTER, "worker_id": args.worker_id,
+               "pid": os.getpid()})
+    executor.stop_event.wait()
+    conn.close()
+    # Daemonic pool threads may be mid-task; hard-exit like the reference's
+    # worker does on graceful shutdown after draining.
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Test harness config.
+
+Forces JAX onto a virtual 8-device CPU platform *before* any jax import so
+sharding/mesh tests exercise real multi-device paths without TPU hardware —
+the analogue of the reference's same-host multi-raylet trick
+(reference python/ray/cluster_utils.py:135) per SURVEY.md §4.5.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep XLA/CPU thread pools small on tiny CI boxes.
+os.environ.setdefault("XLA_CPU_MULTI_THREAD_EIGEN", "false")
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    """Shared runtime for cheap tests (worker spawn costs ~2s each)."""
+    import ray_tpu
+    rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def fresh_cluster():
+    """Isolated runtime for failure-injection tests."""
+    import ray_tpu
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()

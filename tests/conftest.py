@@ -7,7 +7,10 @@ the analogue of the reference's same-host multi-raylet trick
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even if the environment points at real TPU hardware
+# (JAX_PLATFORMS=axon in the driver env): unit tests always run on the
+# virtual 8-device CPU mesh; only bench.py touches the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,7 +18,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 # Keep XLA/CPU thread pools small on tiny CI boxes.
 os.environ.setdefault("XLA_CPU_MULTI_THREAD_EIGEN", "false")
 
-import pytest
+# A site hook re-registers the axon TPU platform and rewrites
+# jax_platforms to "axon,cpu"; pin it back to cpu-only for tests.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,144 @@
+"""Mesh/sharding/collective tests on the virtual 8-device CPU platform."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ray_tpu.parallel import (MeshSpec, prepare_mesh, collectives,
+                              logical_sharding, param_shardings,
+                              shard_pytree, with_logical_constraint)
+from ray_tpu.parallel.sharding import logical_spec
+
+
+def test_mesh_resolve_wildcard():
+    assert MeshSpec(dp=-1, tp=2).resolve(8) == (1, 4, 1, 1, 1, 2)
+    assert MeshSpec(dp=2, fsdp=2, tp=2).resolve(8) == (1, 2, 2, 1, 1, 2)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, fsdp=-1).resolve(8)
+
+
+def test_prepare_mesh_axes():
+    mesh = prepare_mesh(dp=4, tp=2)
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+    assert mesh.devices.size == 8
+
+
+def test_logical_spec_drops_trivial_axes():
+    mesh = prepare_mesh(dp=8)
+    # tp has size 1 -> mlp axis replicates
+    assert logical_spec(("embed", "mlp"), mesh=mesh) == P(None, None)
+    assert logical_spec(("batch", "seq"), mesh=mesh) == P("dp", None)
+
+
+def test_param_shardings_and_placement():
+    mesh = prepare_mesh(dp=2, fsdp=2, tp=2)
+    logical = {"w": ("embed", "mlp"), "b": ("mlp",)}
+    sh = param_shardings(mesh, logical)
+    assert isinstance(sh["w"], NamedSharding)
+    assert sh["w"].spec == P("fsdp", "tp")
+    params = {"w": np.ones((8, 16), np.float32), "b": np.zeros(16, np.float32)}
+    placed = shard_pytree(params, sh)
+    assert placed["w"].sharding.spec == P("fsdp", "tp")
+    np.testing.assert_allclose(np.asarray(placed["w"]), params["w"])
+
+
+def test_collectives_in_shard_map():
+    mesh = prepare_mesh(dp=8)
+    x = jnp.arange(8.0)
+
+    def body(x):
+        s = collectives.allreduce(x, "dp")
+        g = collectives.allgather(x, "dp")
+        r = collectives.ppermute_ring(x, "dp", shift=1)
+        b = collectives.broadcast(x, "dp", root=3)
+        return s, g, r, b
+
+    f = shard_map(body, mesh=mesh,
+                  in_specs=P("dp"),
+                  out_specs=(P("dp"), P(), P("dp"), P("dp")),
+                  check_vma=False)
+    s, g, r, b = jax.jit(f)(x)
+    np.testing.assert_allclose(np.asarray(s), np.full(8, 28.0))
+    np.testing.assert_allclose(np.asarray(g), np.arange(8.0))
+    # ring shift: device i receives from i-1 (src i sends to i+1)
+    np.testing.assert_allclose(np.asarray(r), np.roll(np.arange(8.0), 1))
+    np.testing.assert_allclose(np.asarray(b), np.full(8, 3.0))
+
+
+def test_reducescatter():
+    mesh = prepare_mesh(dp=8)
+    x = jnp.arange(64.0)
+
+    f = shard_map(lambda x: collectives.reducescatter(x, "dp"),
+                  mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    out = jax.jit(f)(x)
+    assert out.shape == (8,)
+    # element d = sum_k x[8k + d] = 8*28 + 8d
+    np.testing.assert_allclose(np.asarray(out), 224.0 + 8.0 * np.arange(8))
+
+
+def test_with_logical_constraint_in_jit():
+    mesh = prepare_mesh(dp=4, tp=2)
+
+    @jax.jit
+    def f(x):
+        return with_logical_constraint(x * 2, ("batch", "mlp"), mesh=mesh)
+
+    x = jnp.ones((8, 4))
+    out = f(x)
+    assert out.sharding.spec == P(("dp",), "tp") or out.sharding.spec == P("dp", "tp")
+
+
+def test_broadcast_ignores_nonroot_nan():
+    mesh = prepare_mesh(dp=8)
+    x = jnp.arange(8.0).at[5].set(jnp.nan)
+    f = shard_map(lambda x: collectives.broadcast(x, "dp", root=3),
+                  mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    np.testing.assert_allclose(np.asarray(jax.jit(f)(x)), np.full(8, 3.0))
+
+
+def test_send_recv_nonparticipants_keep_buffers():
+    mesh = prepare_mesh(dp=8)
+    x = jnp.arange(10.0, 18.0)
+    f = shard_map(lambda x: collectives.send_recv(x, "dp", [(0, 1)]),
+                  mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    expect = np.arange(10.0, 18.0)
+    expect[1] = 10.0
+    np.testing.assert_allclose(np.asarray(jax.jit(f)(x)), expect)
+
+
+def test_barrier_threads_value():
+    mesh = prepare_mesh(dp=8)
+    x = jnp.arange(8.0)
+    f = shard_map(lambda x: collectives.barrier("dp", x),
+                  mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    out = jax.jit(f)(x)
+    np.testing.assert_allclose(np.asarray(out), np.arange(8.0))
+    hlo = jax.jit(f).lower(x).compile().as_text()
+    assert "all-reduce" in hlo  # fence not dead-code-eliminated
+
+
+def test_unknown_logical_axis_raises():
+    with pytest.raises(ValueError, match="unknown logical axis"):
+        logical_spec(("embd",))
+
+
+def test_all_to_all_ulysses():
+    # seq-sharded -> head-sharded re-layout, the Ulysses primitive.
+    mesh = prepare_mesh(sp=8)
+    x = jnp.arange(8 * 16 * 4.0).reshape(8, 16, 4)  # (seq, heads, d)
+
+    def body(x):  # local (1, 16, 4) -> (8, 2, 4)
+        return collectives.all_to_all(x, "sp", split_dim=1, concat_dim=0)
+
+    f = shard_map(body, mesh=mesh, in_specs=P("sp", None, None),
+                  out_specs=P(None, "sp", None))
+    out = jax.jit(f)(x)
+    assert out.shape == (8, 16, 4)
+    # content preserved under permutation of (seq, head) blocks
+    np.testing.assert_allclose(np.sort(np.asarray(out).ravel()),
+                               np.sort(np.asarray(x).ravel()))

@@ -1,0 +1,273 @@
+"""Flash attention: Pallas TPU kernel (forward) + blockwise JAX backward.
+
+The hot op of the model zoo. Forward is an online-softmax kernel that
+streams K/V blocks through VMEM on a (batch, head, q-block, k-block)
+grid — O(seq) memory, MXU-shaped matmuls, causal blocks above the
+diagonal skipped. Backward is the standard flash recomputation written
+as a `lax.scan` over K blocks in plain JAX (XLA pipelines it well); a
+Pallas backward kernel is a later optimisation.
+
+Layout: (batch, num_heads, seq, head_dim). GQA supported: K/V may have
+fewer heads (num_kv_heads must divide num_heads) — the kernel maps query
+head h to kv head h // (num_heads // num_kv_heads) in the BlockSpec
+index map, no materialised repeat.
+
+On non-TPU backends the public `flash_attention` falls back to the
+reference einsum implementation; the kernel itself still runs anywhere
+via the Pallas interpreter (used by tests).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+# ------------------------------------------------------------- reference
+def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = True,
+                  sm_scale: Optional[float] = None,
+                  bias: Optional[jax.Array] = None) -> jax.Array:
+    """Plain einsum attention; ground truth + CPU path.
+
+    q: (b, h, s, d); k/v: (b, kvh, s, d) with kvh | h.
+    """
+    b, h, sq, d = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        qi = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        ki = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        logits = jnp.where(qi >= ki, logits, DEFAULT_MASK_VALUE)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# ----------------------------------------------------------- forward krn
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *,
+                      sm_scale: float, causal: bool,
+                      block_q: int, block_k: int, seq_k: int):
+    i = pl.program_id(2)           # q block
+    j = pl.program_id(3)           # k block
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: skip blocks strictly above the diagonal.
+    run = (not causal) or (j * block_k <= i * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+        ki = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            qi = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(qi >= ki, s, DEFAULT_MASK_VALUE)
+        if seq_k % block_k:
+            # tail K block: mask padding columns past the true length,
+            # and zero V's padding rows — they hold garbage and p=0
+            # does not neutralise NaN (0 * NaN = NaN).
+            s = jnp.where(ki < seq_k, s, DEFAULT_MASK_VALUE)
+            vrows = j * block_k + lax.broadcasted_iota(
+                jnp.int32, v.shape, 0)
+            v = jnp.where(vrows < seq_k, v, 0)
+        m_prev = m_ref[:, :1]                      # (bq, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)            # rescale factor
+        p = jnp.exp(s - m_new)                     # (bq, bk)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _final():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(safe_l)
+        lse_ref[0, 0, :] = lse[:, 0]
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    group = h // kvh
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (b, h, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_k=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b_, h_, i, j: (b_, h_, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),     # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ------------------------------------------------------------- backward
+def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_k):
+    """Blockwise flash backward: scan over K blocks; O(seq·block) memory."""
+    b, h, sq, d = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    group = h // kvh
+    if group != 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (b,h,sq)
+
+    block_k = min(block_k, sk)
+    sk_pad = ((sk + block_k - 1) // block_k) * block_k
+    if sk_pad != sk:
+        pad = [(0, 0), (0, 0), (0, sk_pad - sk), (0, 0)]
+        kf = jnp.pad(kf, pad)
+        vf = jnp.pad(vf, pad)
+    nk = sk_pad // block_k
+    kb = kf.reshape(b, h, nk, block_k, d).transpose(2, 0, 1, 3, 4)
+    vb = vf.reshape(b, h, nk, block_k, d).transpose(2, 0, 1, 3, 4)
+    qi = lax.broadcasted_iota(jnp.int32, (sq, block_k), 0)
+
+    def step(dq, blk):
+        j, k_j, v_j = blk                                  # (b,h,bk,d)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_j,
+                       preferred_element_type=jnp.float32) * sm_scale
+        ki = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (sq, block_k), 1)
+        valid = ki < sk
+        if causal:
+            valid = valid & (qi >= ki)
+        if causal or sk_pad != sk:
+            s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse[..., None])                    # (b,h,sq,bk)
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_j)
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_j)
+        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dkb, dvb) = lax.scan(
+        step, dq0, (jnp.arange(nk), kb, vb))
+    dk = dkb.transpose(1, 2, 0, 3, 4).reshape(b, h, sk_pad, d)[:, :, :sk]
+    dv = dvb.transpose(1, 2, 0, 3, 4).reshape(b, h, sk_pad, d)[:, :, :sk]
+    if group != 1:
+        dk = dk.reshape(b, kvh, group, sk, d).sum(axis=2)
+        dv = dv.reshape(b, kvh, group, sk, d).sum(axis=2)
+    return dq.astype(q.dtype), dk.astype(q.dtype), dv.astype(q.dtype)
+
+
+# ----------------------------------------------------------- public API
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    """Returns (out, lse); lse has stop-gradient semantics (its cotangent
+    is ignored by the VJP — it is an auxiliary statistic, not a loss
+    term)."""
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                      interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
+    do, _g_lse = g  # lse cotangent dropped by design (see _flash docstring)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, do, causal, sm_scale, block_k)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    return_lse: bool = False):
+    """Dispatching entry point: Pallas on TPU, reference elsewhere.
+
+    Shapes: q (b, h, s, d); k/v (b, kvh, s, d), kvh | h.
+    """
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    on_tpu = jax.default_backend() == "tpu"
+    if return_lse:
+        return _flash(q, k, v, causal, sm_scale, block_q, block_k,
+                      not on_tpu)
+    if not on_tpu:
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, False)[0]
+
+
+def flash_attention_kernel(q, k, v, causal=True, sm_scale=None,
+                           block_q=128, block_k=128):
+    """Force the Pallas kernel path (interpreter off-TPU) — test hook."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k,
+                  jax.default_backend() != "tpu")[0]

@@ -1,0 +1,67 @@
+"""Loss ops. Cross-entropy is computed in f32 with the max-subtracted
+log-sum-exp; supports a vocab-sharded (tp) variant where each shard holds
+a slice of the logits and the reduction runs over the mesh axis.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
+                          mask: Optional[jax.Array] = None,
+                          z_loss: float = 0.0):
+    """Token-level CE. logits (..., vocab) f32/bf16; labels int (...,).
+
+    Returns (mean_loss, per_token_loss). `mask` (same shape as labels,
+    1=count) excludes padding from the mean. `z_loss` adds the standard
+    logsumexp^2 regulariser (stabilises f32->bf16 logits drift).
+    """
+    logits = logits.astype(jnp.float32)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    shifted = logits - lax.stop_gradient(m)
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0]
+    label_logit = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1)[..., 0]
+    per_token = lse - label_logit
+    if z_loss:
+        per_token = per_token + z_loss * jnp.square(lse)
+    if mask is None:
+        return jnp.mean(per_token), per_token
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(per_token * mask) / denom, per_token
+
+
+def sharded_softmax_cross_entropy(local_logits: jax.Array,
+                                  labels: jax.Array,
+                                  axis: str,
+                                  vocab_shard_size: int,
+                                  mask: Optional[jax.Array] = None):
+    """CE when the vocab dim is sharded over mesh `axis` (inside shard_map).
+
+    Each device holds logits[..., lo:lo+shard]; the logsumexp and the
+    label-logit gather are psum-reduced so no device materialises the
+    full vocab — the tp-sharded LM head never all-gathers its output.
+    """
+    local_logits = local_logits.astype(jnp.float32)
+    lo = lax.axis_index(axis) * vocab_shard_size
+    gmax = lax.pmax(jnp.max(local_logits, axis=-1), axis)
+    shifted = local_logits - gmax[..., None]
+    sumexp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis)
+    lse = jnp.log(sumexp) + gmax
+    local_label = labels - lo
+    in_shard = (local_label >= 0) & (local_label < vocab_shard_size)
+    safe = jnp.clip(local_label, 0, vocab_shard_size - 1)
+    picked = jnp.take_along_axis(local_logits, safe[..., None],
+                                 axis=-1)[..., 0]
+    label_logit = lax.psum(jnp.where(in_shard, picked, 0.0), axis)
+    per_token = lse - label_logit
+    if mask is None:
+        return jnp.mean(per_token), per_token
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(per_token * mask) / denom, per_token

@@ -1,0 +1,40 @@
+"""Rotary position embeddings (RoPE).
+
+Pure JAX: RoPE is elementwise sin/cos mul-add and XLA fuses it into the
+surrounding QK projections; a hand kernel buys nothing here. Supports an
+absolute `positions` argument so sequence-parallel shards (each holding a
+seq slice) rotate with their *global* positions — required for ring
+attention (ray_tpu/ops/ring_attention.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 10000.0) -> jax.Array:
+    """Inverse frequencies for each rotated pair, shape (head_dim//2,)."""
+    if head_dim % 2:
+        raise ValueError(f"head_dim must be even, got {head_dim}")
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponent)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float = 10000.0) -> jax.Array:
+    """Rotate x of shape (..., seq, heads, head_dim) by per-token angles.
+
+    positions: integer array broadcastable to x.shape[:-2] + (seq,) —
+    usually (batch, seq) or (seq,). Split-halves convention (llama):
+    the first half of head_dim pairs with the second half.
+    """
+    head_dim = x.shape[-1]
+    inv_freq = rope_frequencies(head_dim, theta)  # (hd/2,)
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (..., seq, hd/2)
+    # insert heads axis: (..., seq, 1, hd/2)
+    angles = angles[..., None, :]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)

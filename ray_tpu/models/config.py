@@ -1,0 +1,101 @@
+"""Transformer configuration + presets.
+
+Presets cover the reference's LLM workloads (Llama-2-7B fine-tune is the
+headline release test, reference release/release_tests.yaml:963-1010) and
+small debug models for CI.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: Optional[int] = None      # None = MHA
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: str = "bfloat16"               # activation/compute dtype
+    param_dtype: str = "float32"
+    remat: bool = True                    # checkpoint each layer in scan
+    use_ring_attention: bool = False      # seq-parallel attention (sp axis)
+    attn_block_q: int = 128
+    attn_block_k: int = 128
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def activation_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def parameter_dtype(self):
+        return jnp.dtype(self.param_dtype)
+
+    def num_params(self) -> int:
+        """Exact parameter count (embeddings + layers + head)."""
+        e, f, hd = self.d_model, self.d_ff, self.head_dim
+        per_layer = (e * self.n_heads * hd          # wq
+                     + 2 * e * self.kv_heads * hd   # wk, wv
+                     + self.n_heads * hd * e        # wo
+                     + 3 * e * f                    # gate, up, down
+                     + 2 * e)                       # two norms
+        total = self.vocab_size * e + self.n_layers * per_layer + e
+        if not self.tie_embeddings:
+            total += e * self.vocab_size
+        return total
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs/token (fwd+bwd ≈ 6·N + attention)."""
+        n = self.num_params()
+        attn = 12 * self.n_layers * self.d_model * self.max_seq_len
+        return 6.0 * n + attn
+
+
+def tiny(vocab_size: int = 256) -> TransformerConfig:
+    """CI/debug model: runs on the 8-device CPU mesh in seconds."""
+    return TransformerConfig(
+        vocab_size=vocab_size, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, d_ff=128, max_seq_len=128, remat=False,
+        dtype="float32", param_dtype="float32")
+
+
+def llama2_7b() -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=None, d_ff=11008, max_seq_len=4096)
+
+
+def llama2_13b() -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=32000, d_model=5120, n_layers=40, n_heads=40,
+        n_kv_heads=None, d_ff=13824, max_seq_len=4096)
+
+
+def llama3_8b() -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14336, max_seq_len=8192, rope_theta=500000.0)
+
+
+PRESETS = {
+    "tiny": tiny,
+    "llama2-7b": llama2_7b,
+    "llama2-13b": llama2_13b,
+    "llama3-8b": llama3_8b,
+}

@@ -1,0 +1,187 @@
+"""Llama-family decoder: GQA + RoPE + SwiGLU on ray_tpu.ops kernels.
+
+Pure-pytree parameters (no module framework): `init` builds the tree,
+`param_logical_axes` mirrors it with logical axis names consumed by
+ray_tpu.parallel.sharding, `apply`/`loss` are jit-friendly functions.
+Layers are stacked on a leading "layers" axis and executed with
+`lax.scan` so XLA compiles one layer body regardless of depth; with
+`config.remat` the body is wrapped in `jax.checkpoint` trading FLOPs
+for HBM (SURVEY.md §7 hardware notes).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from ray_tpu.models.config import TransformerConfig
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.losses import softmax_cross_entropy
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.ring_attention import ring_attention_sharded
+from ray_tpu.parallel.sharding import with_logical_constraint
+
+Params = Dict[str, Any]
+
+# Activation logical axes (all optional constraints; params use the
+# rules in parallel.sharding directly).
+_ACT_RULES_EXTRA = {"act_embed": None}
+
+
+def _rules():
+    from ray_tpu.parallel.sharding import LOGICAL_AXIS_RULES
+    rules = dict(LOGICAL_AXIS_RULES)
+    rules.update(_ACT_RULES_EXTRA)
+    return rules
+
+
+class Transformer:
+    """Functional model bundle for one TransformerConfig."""
+
+    def __init__(self, config: TransformerConfig,
+                 mesh: Optional[Mesh] = None):
+        self.config = config
+        self.mesh = mesh
+
+    # ------------------------------------------------------------ init
+    def init(self, key: jax.Array) -> Params:
+        c = self.config
+        pd = c.parameter_dtype
+        e, f, hd = c.d_model, c.d_ff, c.head_dim
+        qd, kvd = c.n_heads * hd, c.kv_heads * hd
+        k = iter(jax.random.split(key, 16))
+        std = 0.02
+        out_std = std / math.sqrt(2 * c.n_layers)
+
+        def w(key, shape, scale):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * scale).astype(pd)
+
+        L = c.n_layers
+        params: Params = {
+            "embed": w(next(k), (c.vocab_size, e), std),
+            "layers": {
+                "attn_norm": jnp.zeros((L, e), pd),
+                "wq": w(next(k), (L, e, qd), std),
+                "wk": w(next(k), (L, e, kvd), std),
+                "wv": w(next(k), (L, e, kvd), std),
+                "wo": w(next(k), (L, qd, e), out_std),
+                "mlp_norm": jnp.zeros((L, e), pd),
+                "gate": w(next(k), (L, e, f), std),
+                "up": w(next(k), (L, e, f), std),
+                "down": w(next(k), (L, f, e), out_std),
+            },
+            "final_norm": jnp.zeros((e,), pd),
+        }
+        if not c.tie_embeddings:
+            params["lm_head"] = w(next(k), (e, c.vocab_size), std)
+        return params
+
+    def param_logical_axes(self) -> Params:
+        axes = {
+            "embed": ("vocab", "embed"),
+            "layers": {
+                "attn_norm": ("layers", "embed"),
+                "wq": ("layers", "embed", "heads"),
+                "wk": ("layers", "embed", "kv_heads"),
+                "wv": ("layers", "embed", "kv_heads"),
+                "wo": ("layers", "heads", "embed"),
+                "mlp_norm": ("layers", "embed"),
+                "gate": ("layers", "embed", "mlp"),
+                "up": ("layers", "embed", "mlp"),
+                "down": ("layers", "mlp", "embed"),
+            },
+            "final_norm": ("embed",),
+        }
+        if not self.config.tie_embeddings:
+            axes["lm_head"] = ("embed", "vocab")
+        return axes
+
+    # --------------------------------------------------------- forward
+    def _attention(self, q, k, v):
+        c = self.config
+        if (c.use_ring_attention and self.mesh is not None
+                and self.mesh.shape.get("sp", 1) > 1):
+            return ring_attention_sharded(q, k, v, self.mesh, causal=True)
+        return flash_attention(q, k, v, causal=True,
+                               block_q=c.attn_block_q,
+                               block_k=c.attn_block_k)
+
+    def _constrain(self, x, axes):
+        if self.mesh is None:
+            return x
+        return with_logical_constraint(x, axes, mesh=self.mesh,
+                                       rules=_rules())
+
+    def _layer(self, x, layer: Params, positions):
+        c = self.config
+        ad = c.activation_dtype
+        b, s, e = x.shape
+        hd = c.head_dim
+
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q = (h @ layer["wq"].astype(ad)).reshape(b, s, c.n_heads, hd)
+        k = (h @ layer["wk"].astype(ad)).reshape(b, s, c.kv_heads, hd)
+        v = (h @ layer["wv"].astype(ad)).reshape(b, s, c.kv_heads, hd)
+        from ray_tpu.ops.rope import apply_rope
+        q = apply_rope(q, positions, c.rope_theta)
+        k = apply_rope(k, positions, c.rope_theta)
+        q = q.transpose(0, 2, 1, 3)   # (b, h, s, hd)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        q = self._constrain(q, ("batch", "heads", "seq", "head_dim"))
+        attn = self._attention(q, k, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, c.n_heads * hd)
+        x = x + attn @ layer["wo"].astype(ad)
+        x = self._constrain(x, ("batch", "seq", "act_embed"))
+
+        h = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+        gate = jax.nn.silu(h @ layer["gate"].astype(ad))
+        up = h @ layer["up"].astype(ad)
+        mlp = self._constrain(gate * up, ("batch", "seq", "mlp"))
+        x = x + mlp @ layer["down"].astype(ad)
+        return self._constrain(x, ("batch", "seq", "act_embed"))
+
+    def apply(self, params: Params, tokens: jax.Array,
+              positions: Optional[jax.Array] = None) -> jax.Array:
+        """tokens (b, s) int32 -> logits (b, s, vocab) in f32."""
+        c = self.config
+        ad = c.activation_dtype
+        b, s = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        x = params["embed"].astype(ad)[tokens]
+        x = self._constrain(x, ("batch", "seq", "act_embed"))
+
+        def body(carry, layer):
+            return self._layer(carry, layer, positions), None
+
+        if c.remat:
+            body = jax.checkpoint(body)
+        x, _ = lax.scan(body, x, params["layers"])
+
+        x = rms_norm(x, params["final_norm"], c.norm_eps)
+        head = (params["embed"].T if c.tie_embeddings
+                else params["lm_head"])
+        logits = x @ head.astype(ad)
+        logits = self._constrain(logits, ("batch", "seq", "vocab"))
+        return logits.astype(jnp.float32)
+
+    # ------------------------------------------------------------ loss
+    def loss(self, params: Params, batch: Dict[str, jax.Array]):
+        """Causal LM loss. batch: tokens (b, s); optional loss_mask
+        (b, s) aligned with tokens-as-labels: loss_mask[i] = 0 excludes
+        token i from being counted as a prediction target (use 0 on
+        prompt/padding tokens, 1 on completion tokens)."""
+        tokens = batch["tokens"]
+        logits = self.apply(params, tokens)[:, :-1]
+        labels = tokens[:, 1:]
+        mask = batch.get("loss_mask")
+        if mask is not None:
+            mask = mask[:, 1:]
+        loss, _ = softmax_cross_entropy(logits, labels, mask=mask)
+        return loss

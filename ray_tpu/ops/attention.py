@@ -117,13 +117,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_ref[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0, :, :] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse = m_ref[:, :1] + jnp.log(safe_l)
-        lse_ref[0, 0, :] = lse[:, 0]
+        lse = m_ref[:, :1] + jnp.log(safe_l)            # (bq, 1)
+        # lse laid out (b, h, 8, sq): an (8, block_q) block keeps the
+        # last-two-dims (8, 128) Mosaic tiling rule; sublanes broadcast.
+        lse_ref[0, 0, :, :] = jnp.broadcast_to(lse[:, 0][None, :],
+                                               (8, lse.shape[0]))
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     b, h, sq, d = q.shape
     kvh, sk = k.shape[1], k.shape[2]
+    if h % kvh:
+        raise ValueError(
+            f"num_heads ({h}) must be a multiple of num_kv_heads ({kvh})")
     group = h // kvh
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
@@ -145,12 +151,12 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b_, h_, i, j: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b_, h_, i, j: (b_, h_, i)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b_, h_, i, j: (b_, h_, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 8, sq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),     # acc
@@ -159,7 +165,7 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
-    return out, lse
+    return out, lse[:, :, 0, :]
 
 
 # ------------------------------------------------------------- backward

@@ -21,8 +21,11 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
     logsumexp^2 regulariser (stabilises f32->bf16 logits drift).
     """
     logits = logits.astype(jnp.float32)
+    # No stop_gradient on the max: the two m-terms must cancel in the
+    # VJP (a half-stopped max adds a spurious one_hot(argmax) to the
+    # gradient of every token).
     m = jnp.max(logits, axis=-1, keepdims=True)
-    shifted = logits - lax.stop_gradient(m)
+    shifted = logits - m
     lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0]
     label_logit = jnp.take_along_axis(
         logits, labels[..., None], axis=-1)[..., 0]

@@ -88,6 +88,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     h, kvh = q.shape[1], k.shape[1]
+    if h % kvh:
+        raise ValueError(
+            f"num_heads ({h}) must be a multiple of num_kv_heads ({kvh})")
     if kvh != h:
         k = jnp.repeat(k, h // kvh, axis=1)
         v = jnp.repeat(v, h // kvh, axis=1)
@@ -100,11 +103,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
     lse = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
     kr, vr = k, v
+    # Remat each chunk so backward recomputes the (s_local, s_local)
+    # scores instead of saving them per ring step — keeps the O(seq/n)
+    # memory claim true under jax.grad.
+    chunk = jax.checkpoint(_chunk_attention, static_argnums=(5, 6))
     for r in range(n):
         # chunk currently held arrived from device (idx - r) mod n
         k_off = ((idx - r) % n) * s_local
-        o_r, lse_r = _chunk_attention(q, kr, vr, q_off, k_off, causal,
-                                      sm_scale)
+        o_r, lse_r = chunk(q, kr, vr, q_off, k_off, causal, sm_scale)
         o, lse = _merge(o, lse, o_r, lse_r)
         if r != n - 1:
             kr = lax.ppermute(kr, axis, perm)

@@ -216,6 +216,16 @@ def test_softmax_cross_entropy():
     assert loss.shape == ()
 
 
+def test_softmax_cross_entropy_grad_matches_autodiff():
+    logits = jax.random.normal(jax.random.PRNGKey(2), (4, 8, 32)) * 3
+    labels = jax.random.randint(jax.random.PRNGKey(3), (4, 8), 0, 32)
+    g1 = jax.grad(lambda lg: softmax_cross_entropy(lg, labels)[0])(logits)
+    g2 = jax.grad(lambda lg: -jnp.mean(jnp.take_along_axis(
+        jax.nn.log_softmax(lg), labels[..., None], axis=-1)))(logits)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               atol=1e-6, rtol=1e-5)
+
+
 def test_softmax_cross_entropy_mask():
     logits = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 16))
     labels = jnp.zeros((2, 4), jnp.int32)

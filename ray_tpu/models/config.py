@@ -30,6 +30,7 @@ class TransformerConfig:
     use_ring_attention: bool = False      # seq-parallel attention (sp axis)
     attn_block_q: int = 128
     attn_block_k: int = 128
+    loss_chunk: int = 0                   # >0: chunked LM loss (seq chunks)
 
     @property
     def kv_heads(self) -> int:

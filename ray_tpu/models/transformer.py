@@ -117,7 +117,7 @@ class Transformer:
         return with_logical_constraint(x, axes, mesh=self.mesh,
                                        rules=_rules())
 
-    def _layer(self, x, layer: Params, positions):
+    def _layer(self, x, layer: Params, rope):
         c = self.config
         ad = c.activation_dtype
         b, s, e = x.shape
@@ -127,9 +127,10 @@ class Transformer:
         q = (h @ layer["wq"].astype(ad)).reshape(b, s, c.n_heads, hd)
         k = (h @ layer["wk"].astype(ad)).reshape(b, s, c.kv_heads, hd)
         v = (h @ layer["wv"].astype(ad)).reshape(b, s, c.kv_heads, hd)
-        from ray_tpu.ops.rope import apply_rope
-        q = apply_rope(q, positions, c.rope_theta)
-        k = apply_rope(k, positions, c.rope_theta)
+        from ray_tpu.ops.rope import apply_rope_cached
+        cos, sin = rope
+        q = apply_rope_cached(q, cos, sin)
+        k = apply_rope_cached(k, cos, sin)
         q = q.transpose(0, 2, 1, 3)   # (b, h, s, hd)
         k = k.transpose(0, 2, 1, 3)
         v = v.transpose(0, 2, 1, 3)
@@ -146,9 +147,9 @@ class Transformer:
         x = x + mlp @ layer["down"].astype(ad)
         return self._constrain(x, ("batch", "seq", "act_embed"))
 
-    def apply(self, params: Params, tokens: jax.Array,
-              positions: Optional[jax.Array] = None) -> jax.Array:
-        """tokens (b, s) int32 -> logits (b, s, vocab) in f32."""
+    def hidden(self, params: Params, tokens: jax.Array,
+               positions: Optional[jax.Array] = None) -> jax.Array:
+        """Trunk: tokens (b, s) -> post-final-norm hidden states (b, s, e)."""
         c = self.config
         ad = c.activation_dtype
         b, s = tokens.shape
@@ -157,17 +158,31 @@ class Transformer:
         x = params["embed"].astype(ad)[tokens]
         x = self._constrain(x, ("batch", "seq", "act_embed"))
 
+        # cos/sin computed once; identical for every layer and cheap to
+        # hold across remat (transcendentals dominate their recompute).
+        from ray_tpu.ops.rope import rope_cos_sin
+        rope = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+
         def body(carry, layer):
-            return self._layer(carry, layer, positions), None
+            return self._layer(carry, layer, rope), None
 
         if c.remat:
-            body = jax.checkpoint(body)
+            # prevent_cse=False: scan's loop structure already blocks the
+            # CSE hazard; keeping it True inserts unfusable barriers.
+            body = jax.checkpoint(body, prevent_cse=False)
         x, _ = lax.scan(body, x, params["layers"])
+        return rms_norm(x, params["final_norm"], c.norm_eps)
 
-        x = rms_norm(x, params["final_norm"], c.norm_eps)
-        head = (params["embed"].T if c.tie_embeddings
+    def _head(self, params: Params) -> jax.Array:
+        return (params["embed"].T if self.config.tie_embeddings
                 else params["lm_head"])
-        logits = x @ head.astype(ad)
+
+    def apply(self, params: Params, tokens: jax.Array,
+              positions: Optional[jax.Array] = None) -> jax.Array:
+        """tokens (b, s) int32 -> logits (b, s, vocab) in f32."""
+        c = self.config
+        x = self.hidden(params, tokens, positions)
+        logits = x @ self._head(params).astype(c.activation_dtype)
         logits = self._constrain(logits, ("batch", "seq", "vocab"))
         return logits.astype(jnp.float32)
 
@@ -177,10 +192,24 @@ class Transformer:
         (b, s) aligned with tokens-as-labels: loss_mask[i] = 0 excludes
         token i from being counted as a prediction target (use 0 on
         prompt/padding tokens, 1 on completion tokens)."""
+        c = self.config
         tokens = batch["tokens"]
+        mask = batch.get("loss_mask")
+        if c.loss_chunk:
+            # Full-length formulation (keeps seq divisible by the chunk):
+            # labels[i] = tokens[i+1], with the final position masked out.
+            from ray_tpu.ops.losses import chunked_lm_loss
+            b, s = tokens.shape
+            x = self.hidden(params, tokens)
+            labels = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+            m = (jnp.ones((b, s), jnp.float32) if mask is None
+                 else mask.astype(jnp.float32))
+            m = jnp.concatenate([m[:, 1:], jnp.zeros((b, 1))], axis=1)
+            head = self._head(params).astype(c.activation_dtype)
+            return chunked_lm_loss(x, head, labels, m,
+                                   chunk_size=c.loss_chunk)
         logits = self.apply(params, tokens)[:, :-1]
         labels = tokens[:, 1:]
-        mask = batch.get("loss_mask")
         if mask is not None:
             mask = mask[:, 1:]
         loss, _ = softmax_cross_entropy(logits, labels, mask=mask)

@@ -177,11 +177,11 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_k):
     if group != 1:
         k = jnp.repeat(k, group, axis=1)
         v = jnp.repeat(v, group, axis=1)
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (b,h,sq)
+    # Keep matmul operands in the input dtype (bf16 on TPU) with f32
+    # accumulation — upcasting operands would force f32 MXU passes.
+    kf, vf = k, v
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)  # (b,h,sq)
 
     block_k = min(block_k, sk)
     sk_pad = ((sk + block_k - 1) // block_k) * block_k
@@ -196,7 +196,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_k):
 
     def step(dq, blk):
         j, k_j, v_j = blk                                  # (b,h,bk,d)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_j,
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_j,
                        preferred_element_type=jnp.float32) * sm_scale
         ki = j * block_k + lax.broadcasted_iota(
             jnp.int32, (sq, block_k), 1)
@@ -205,15 +205,20 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_k):
             valid = valid & (qi >= ki)
         if causal or sk_pad != sk:
             s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
-        p = jnp.exp(s - lse[..., None])                    # (b,h,sq,bk)
-        dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_j)
-        ds = p * (dp - delta[..., None]) * sm_scale
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_j)
-        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        p = jnp.exp(s - lse[..., None])                    # (b,h,sq,bk) f32
+        pc = p.astype(q.dtype)
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", pc, do,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do, v_j,
+                        preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[..., None]) * sm_scale).astype(q.dtype)
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_j,
+                             preferred_element_type=jnp.float32)
+        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, q,
+                          preferred_element_type=jnp.float32)
         return dq, (dk_j, dv_j)
 
-    dq0 = jnp.zeros_like(qf)
+    dq0 = jnp.zeros(q.shape, jnp.float32)  # f32 accumulator across blocks
     dq, (dkb, dvb) = lax.scan(
         step, dq0, (jnp.arange(nk), kb, vb))
     dk = dkb.transpose(1, 2, 0, 3, 4).reshape(b, h, sk_pad, d)[:, :, :sk]

@@ -39,6 +39,46 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
     return jnp.sum(per_token * mask) / denom, per_token
 
 
+def chunked_lm_loss(x: jax.Array, head: jax.Array, labels: jax.Array,
+                    mask: Optional[jax.Array] = None,
+                    chunk_size: int = 512):
+    """LM head projection + CE, scanned over sequence chunks with remat.
+
+    Avoids materialising the full (b, s, vocab) f32 logits (the dominant
+    activation on 30k+ vocabs): each chunk's logits exist only inside a
+    rematerialised scan step, cutting peak memory by s/chunk_size.
+    x: (b, s, e) final hidden states; head: (e, vocab); labels (b, s).
+    Returns mean loss over unmasked positions.
+    """
+    b, s, e = x.shape
+    if mask is None:
+        mask = jnp.ones((b, s), jnp.float32)
+    if s % chunk_size:
+        # pad the tail chunk (mask 0 excludes padding from the loss)
+        pad = chunk_size - s % chunk_size
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+        s += pad
+    n = s // chunk_size
+    xs = x.reshape(b, n, chunk_size, e).transpose(1, 0, 2, 3)
+    ls = labels.reshape(b, n, chunk_size).transpose(1, 0, 2)
+    ms = mask.astype(jnp.float32).reshape(
+        b, n, chunk_size).transpose(1, 0, 2)
+
+    def body(carry, blk):
+        xc, lc, mc = blk
+        logits = (xc @ head).astype(jnp.float32)
+        _, per_token = softmax_cross_entropy(logits, lc)
+        return (carry[0] + jnp.sum(per_token * mc),
+                carry[1] + jnp.sum(mc)), None
+
+    (total, denom), _ = lax.scan(
+        jax.checkpoint(body, prevent_cse=False), (0.0, 0.0),
+        (xs, ls, ms))
+    return total / jnp.maximum(denom, 1.0)
+
+
 def sharded_softmax_cross_entropy(local_logits: jax.Array,
                                   labels: jax.Array,
                                   axis: str,

@@ -20,6 +20,28 @@ def rope_frequencies(head_dim: int, theta: float = 10000.0) -> jax.Array:
     return 1.0 / (theta ** exponent)
 
 
+def rope_cos_sin(positions: jax.Array, head_dim: int,
+                 theta: float = 10000.0):
+    """Precompute (cos, sin), each (..., seq, 1, head_dim//2) f32.
+
+    Compute once per forward pass and reuse across layers/remat passes —
+    the transcendentals are VPU-expensive and identical for every layer.
+    """
+    inv_freq = rope_frequencies(head_dim, theta)
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    angles = angles[..., None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope_cached(x: jax.Array, cos: jax.Array,
+                      sin: jax.Array) -> jax.Array:
+    """Rotate x (..., seq, heads, head_dim) by precomputed cos/sin."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
 def apply_rope(x: jax.Array, positions: jax.Array,
                theta: float = 10000.0) -> jax.Array:
     """Rotate x of shape (..., seq, heads, head_dim) by per-token angles.
@@ -28,13 +50,5 @@ def apply_rope(x: jax.Array, positions: jax.Array,
     usually (batch, seq) or (seq,). Split-halves convention (llama):
     the first half of head_dim pairs with the second half.
     """
-    head_dim = x.shape[-1]
-    inv_freq = rope_frequencies(head_dim, theta)  # (hd/2,)
-    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (..., seq, hd/2)
-    # insert heads axis: (..., seq, 1, hd/2)
-    angles = angles[..., None, :]
-    cos, sin = jnp.cos(angles), jnp.sin(angles)
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
-                          axis=-1)
-    return out.astype(x.dtype)
+    cos, sin = rope_cos_sin(positions, x.shape[-1], theta)
+    return apply_rope_cached(x, cos, sin)

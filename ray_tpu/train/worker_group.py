@@ -1,0 +1,115 @@
+"""WorkerGroup: N train-worker actors with env fanout and session control.
+
+Parity: reference train/_internal/worker_group.py (WorkerGroup:102,
+RayTrainWorker:19) + the accelerator-visibility env sharing of
+backend_executor.py:271-351. Each worker is one process that will become
+one jax.distributed participant (SURVEY.md §7 hard part 3: the SPMD/actor
+impedance is resolved by making each actor a JAX process).
+"""
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import TrainContext, _TrainSession
+
+
+class RayTrainWorker:
+    """Actor running one training session (one per host)."""
+
+    def __init__(self, rank: int, world_size: int):
+        self._rank = rank
+        self._world_size = world_size
+        self._session: Optional[_TrainSession] = None
+
+    # ------------------------------------------------------------ setup
+    def set_env(self, env: Dict[str, str]) -> None:
+        os.environ.update(env)
+
+    def get_address(self) -> str:
+        return socket.gethostbyname(socket.gethostname())
+
+    def find_free_port(self) -> int:
+        with socket.socket() as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
+
+    def run(self, fn_bytes: bytes, args: tuple, kwargs: dict) -> Any:
+        """Execute an arbitrary callable on the worker (utility fanout)."""
+        fn = cloudpickle.loads(fn_bytes)
+        return fn(*args, **kwargs)
+
+    # --------------------------------------------------------- training
+    def init_session(self, fn_bytes: bytes, config: Dict[str, Any],
+                     restore_path: Optional[str]) -> None:
+        fn = cloudpickle.loads(fn_bytes)
+        ctx = TrainContext(
+            world_rank=self._rank, world_size=self._world_size,
+            local_rank=0, local_world_size=1, node_rank=self._rank)
+        restore = Checkpoint(restore_path) if restore_path else None
+        self._session = _TrainSession(fn, config, ctx, restore)
+        self._session.start()
+
+    def next_result(self):
+        """(metrics, checkpoint_path|None) or None when the loop ends."""
+        assert self._session is not None, "init_session first"
+        item = self._session.next_result()
+        if item is None:
+            return None
+        metrics, ckpt = item
+        return metrics, (ckpt.path if ckpt is not None else None)
+
+    def finished(self) -> bool:
+        return self._session is None or self._session.finished
+
+    def ping(self) -> str:
+        return "ok"
+
+
+class WorkerGroup:
+    """Owns the actor handles; all-or-nothing lifecycle."""
+
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Optional[Dict[str, float]] = None):
+        self.num_workers = num_workers
+        self._resources = dict(resources_per_worker or {"CPU": 1.0})
+        self.workers: List[Any] = []
+
+    def start(self) -> None:
+        cls = ray_tpu.remote(**{
+            "num_cpus": self._resources.get("CPU", 1.0),
+            "num_tpus": self._resources.get("TPU", 0) or None,
+            "resources": {k: v for k, v in self._resources.items()
+                          if k not in ("CPU", "TPU")} or None,
+        })(RayTrainWorker)
+        self.workers = [cls.remote(rank, self.num_workers)
+                        for rank in range(self.num_workers)]
+        # fail fast if any worker failed to start
+        ray_tpu.get([w.ping.remote() for w in self.workers], timeout=60)
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+
+    # ------------------------------------------------------------ fanout
+    def run_on_all(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        fn_bytes = cloudpickle.dumps(fn)
+        return ray_tpu.get([w.run.remote(fn_bytes, args, kwargs)
+                            for w in self.workers])
+
+    def run_on_rank(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        fn_bytes = cloudpickle.dumps(fn)
+        return ray_tpu.get(
+            self.workers[rank].run.remote(fn_bytes, args, kwargs))
+
+    def set_env_on_all(self, env: Dict[str, str]) -> None:
+        ray_tpu.get([w.set_env.remote(env) for w in self.workers])

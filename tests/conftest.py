@@ -27,19 +27,30 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture()
 def ray_cluster():
-    """Shared runtime for cheap tests (worker spawn costs ~2s each)."""
+    """Shared runtime: reuses a live runtime if present, (re)creates one
+    otherwise (a prior fresh_cluster may have torn it down). No teardown
+    — the session finalizer below shuts it down once."""
     import ray_tpu
-    rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
-    yield rt
-    ray_tpu.shutdown()
+    yield ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _shutdown_at_end():
+    yield
+    import ray_tpu
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
 
 
 @pytest.fixture()
 def fresh_cluster():
-    """Isolated runtime for failure-injection tests."""
+    """Isolated runtime for failure-injection tests. Tears down any
+    module-scoped shared runtime first (one runtime per process)."""
     import ray_tpu
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
     rt = ray_tpu.init(num_cpus=4)
     yield rt
     ray_tpu.shutdown()

@@ -47,6 +47,15 @@ class Transformer:
         self.config = config
         self.mesh = mesh
 
+    def _platform(self):
+        """Platform the forward will actually run on: the mesh's devices
+        when bound to a mesh (may differ from the default backend — e.g.
+        a virtual CPU mesh on a TPU host), else the default backend."""
+        if self.mesh is None:
+            return None
+        from ray_tpu.ops.dispatch import mesh_platform
+        return mesh_platform(self.mesh)
+
     # ------------------------------------------------------------ init
     def init(self, key: jax.Array) -> Params:
         c = self.config
@@ -150,6 +159,12 @@ class Transformer:
     def hidden(self, params: Params, tokens: jax.Array,
                positions: Optional[jax.Array] = None) -> jax.Array:
         """Trunk: tokens (b, s) -> post-final-norm hidden states (b, s, e)."""
+        from ray_tpu.ops.dispatch import compute_platform
+        with compute_platform(self._platform()):
+            return self._hidden(params, tokens, positions)
+
+    def _hidden(self, params: Params, tokens: jax.Array,
+                positions: Optional[jax.Array] = None) -> jax.Array:
         c = self.config
         ad = c.activation_dtype
         b, s = tokens.shape

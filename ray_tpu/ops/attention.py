@@ -266,7 +266,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     d = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    on_tpu = jax.default_backend() == "tpu"
+    from ray_tpu.ops.dispatch import on_tpu as _on_tpu
+    on_tpu = _on_tpu()
     if return_lse:
         return _flash(q, k, v, causal, sm_scale, block_q, block_k,
                       not on_tpu)
@@ -278,7 +279,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def flash_attention_kernel(q, k, v, causal=True, sm_scale=None,
                            block_q=128, block_k=128):
     """Force the Pallas kernel path (interpreter off-TPU) — test hook."""
+    from ray_tpu.ops.dispatch import on_tpu as _on_tpu
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     return _flash(q, k, v, causal, sm_scale, block_q, block_k,
-                  jax.default_backend() != "tpu")[0]
+                  not _on_tpu())[0]

@@ -17,7 +17,8 @@ _LANE = 128
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ray_tpu.ops.dispatch import on_tpu
+    return not on_tpu()
 
 
 # ---------------------------------------------------------------- rmsnorm

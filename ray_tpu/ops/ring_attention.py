@@ -29,21 +29,26 @@ NEG_INF = -jnp.inf
 def _chunk_attention(q, k, v, q_off, k_off, causal, sm_scale):
     """Attention of a Q shard against one K/V chunk; returns (o, lse) f32.
 
-    Offsets are *global* token positions of the shard starts, so the
-    causal mask is exact across ring steps. Fully-masked rows yield
-    lse = -inf and a zero output, which the merge treats as "no mass".
+    GQA-aware: q has (b, h, sq, d) with h = g * kvh; k/v stay at their
+    raw kv-head count and are matched via a grouped einsum, so the ring
+    never transfers or stores repeated K/V. Offsets are *global* token
+    positions of the shard starts, so the causal mask is exact across
+    ring steps. Fully-masked rows yield lse = -inf and a zero output,
+    which the merge treats as "no mass".
     """
-    qf = q.astype(jnp.float32)
+    b, h, sq, d = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    g = h // kvh
+    qf = q.astype(jnp.float32).reshape(b, kvh, g, sq, d)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf,
+    s = jnp.einsum("bngqd,bnkd->bngqk", qf, kf,
                    preferred_element_type=jnp.float32) * sm_scale
     if causal:
-        sq, sk = q.shape[2], k.shape[2]
         qi = q_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         ki = k_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         s = jnp.where(qi >= ki, s, DEFAULT_MASK_VALUE)
-    m = jnp.max(s, axis=-1)                                   # (b,h,sq)
+    m = jnp.max(s, axis=-1)                                 # (b,n,g,sq)
     # Rows with every entry masked: treat as zero mass.
     dead = m <= DEFAULT_MASK_VALUE / 2
     m_safe = jnp.where(dead, 0.0, m)
@@ -52,11 +57,11 @@ def _chunk_attention(q, k, v, q_off, k_off, causal, sm_scale):
     l = jnp.sum(p, axis=-1)
     # Normalised partial output: _merge expects each partial to be a
     # proper softmax-weighted average with its mass carried in lse.
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, vf) / jnp.maximum(
+    o = jnp.einsum("bngqk,bnkd->bngqd", p, vf) / jnp.maximum(
         l, 1e-37)[..., None]
     lse = jnp.where(dead | (l == 0.0), NEG_INF, m_safe + jnp.log(
         jnp.maximum(l, 1e-37)))
-    return o, lse
+    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
 
 
 def _merge(o1, lse1, o2, lse2):
@@ -91,9 +96,6 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if h % kvh:
         raise ValueError(
             f"num_heads ({h}) must be a multiple of num_kv_heads ({kvh})")
-    if kvh != h:
-        k = jnp.repeat(k, h // kvh, axis=1)
-        v = jnp.repeat(v, h // kvh, axis=1)
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     s_local = q.shape[2]
@@ -121,22 +123,49 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def ring_attention_sharded(q, k, v, mesh: Mesh, causal: bool = True,
                            sm_scale: Optional[float] = None,
                            axis: str = "sp") -> jax.Array:
-    """jit-level wrapper: shards seq over `axis`, batch over (dp, fsdp),
-    heads over tp, and runs the ring. Falls back to flash/reference
-    attention when the sequence axis is trivial."""
+    """jit-level wrapper: shards seq over `axis`, batch over the data
+    axes present in the mesh (dp/fsdp), heads over tp when present, and
+    runs the ring. Falls back to flash/reference attention when the
+    sequence axis is trivial.
+
+    Works on any user-built Mesh: specs are assembled from the axes the
+    mesh actually has, so a mesh lacking dp/fsdp/tp (e.g. a bare
+    ``Mesh(devs, ("sp",))``) shards only the sequence axis.
+    """
     if mesh.shape.get(axis, 1) == 1:
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
-    tp = mesh.shape.get("tp", 1)
-    if k.shape[1] % tp:
-        # kv heads not shardable over tp: materialise the GQA repeat so
-        # K/V carry the same head spec as Q.
-        rep = q.shape[1] // k.shape[1]
+    # Only reference axes that exist in the mesh AND are nontrivial —
+    # a spec naming an absent axis raises inside shard_map.
+    batch_axes = tuple(a for a in ("dp", "fsdp")
+                       if a != axis and mesh.shape.get(a, 1) > 1)
+    head_axis = "tp" if (axis != "tp"
+                         and mesh.shape.get("tp", 1) > 1) else None
+    tp = mesh.shape[head_axis] if head_axis else 1
+    h, kvh = q.shape[1], k.shape[1]
+    spec_q = P(batch_axes or None, head_axis, axis, None)
+    if kvh % tp == 0:
+        # kv heads shard over tp alongside q heads.
+        spec_kv = spec_q
+    elif kvh == 1:
+        # MQA: the single kv head replicates over tp; every query head
+        # maps to it, so the local-shape grouping in _chunk_attention is
+        # trivially correct. (General kvh>1 replication is NOT safe:
+        # spec_q gives each tp device a contiguous global head block,
+        # and the chunk kernel's local grouping would misalign q groups
+        # to kv heads — so any other non-divisible case falls through to
+        # the explicit repeat below.)
+        spec_kv = P(batch_axes or None, None, axis, None)
+    else:
+        # Last resort: materialise the GQA repeat so K/V carry Q's head
+        # spec. Costs n_heads/kv_heads x in K/V memory and ring-transfer
+        # volume — prefer kv_heads % tp == 0 configs on real workloads.
+        rep = h // kvh
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
-    spec_q = P(("dp", "fsdp"), "tp", axis, None)
+        spec_kv = spec_q
     fn = jax.shard_map(
         lambda q_, k_, v_: ring_attention(q_, k_, v_, axis=axis,
                                           causal=causal, sm_scale=sm_scale),
-        mesh=mesh, in_specs=(spec_q, spec_q, spec_q), out_specs=spec_q,
+        mesh=mesh, in_specs=(spec_q, spec_kv, spec_kv), out_specs=spec_q,
         check_vma=False)
     return fn(q, k, v)

@@ -93,17 +93,27 @@ def prepare_mesh(spec: MeshSpec | None = None,
     return Mesh(dev_array, AXIS_ORDER)
 
 
+# Axes allowed to span DCN (slice boundaries). tp/sp/ep are ICI-only:
+# their collectives are latency/bandwidth-critical per-layer, and landing
+# them on DCN silently would be a performance cliff, so we refuse.
+_DCN_AXES = frozenset({"pp", "dp", "fsdp"})
+
+
 def _split_hybrid(shape: Tuple[int, ...], n_slices: int,
                   per_slice: int) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
-    """Factor each axis into (dcn, ici) parts, consuming slices outermost-first."""
+    """Factor each axis into (dcn, ici) parts, consuming slices outermost-first.
+
+    Only pp/dp/fsdp may absorb the slice factor — the inner model axes
+    (sp/ep/tp) always stay within a slice (ICI)."""
     dcn, ici = [], []
     remaining = n_slices
-    for size in shape:
-        if remaining > 1 and size % remaining == 0:
+    for axis, size in zip(AXIS_ORDER, shape):
+        allowed = axis in _DCN_AXES
+        if allowed and remaining > 1 and size % remaining == 0:
             dcn.append(remaining)
             ici.append(size // remaining)
             remaining = 1
-        elif remaining > 1 and remaining % size == 0 and size > 1:
+        elif allowed and remaining > 1 and remaining % size == 0 and size > 1:
             dcn.append(size)
             ici.append(1)
             remaining //= size

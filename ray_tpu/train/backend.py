@@ -54,11 +54,17 @@ class JaxConfig(BackendConfig):
 
 
 def _pin_platform(platform: str):
+    """Pin JAX to `platform` WITHOUT initializing the XLA backend.
+
+    This must stay side-effect-free with respect to backend state:
+    `jax.distributed.initialize` (run later for distributed groups)
+    requires that no prior JAX call initialized a backend, so nothing
+    here may touch `jax.default_backend()` / `jax.devices()`.
+    """
     import os
     os.environ["JAX_PLATFORMS"] = platform
     import jax
     jax.config.update("jax_platforms", platform)
-    return jax.default_backend()
 
 
 def _join_distributed(coordinator: str, num_processes: int, rank: int,
@@ -78,18 +84,21 @@ class JaxBackend(Backend):
 
         import ray_tpu
         w = worker_group.num_workers
-        if backend_config.env:
-            worker_group.set_env_on_all(backend_config.env)
-        if backend_config.platform:
-            # pin on every worker regardless of distributed mode — a
-            # site hook can rewrite jax_platforms, so env alone is not
-            # enough; this import happens before the user loop's.
-            platform = backend_config.platform
-            worker_group.set_env_on_all({"JAX_PLATFORMS": platform})
-            worker_group.run_on_all(_pin_platform, platform)
         distributed = backend_config.distributed
         if distributed is None:
             distributed = w > 1
+        if backend_config.env:
+            worker_group.set_env_on_all(backend_config.env)
+        if backend_config.platform:
+            # pin on every worker — a site hook can rewrite
+            # jax_platforms, so env alone is not enough; in distributed
+            # mode the pin instead happens inside _join_distributed,
+            # immediately before jax.distributed.initialize, so no
+            # worker touches JAX state before joining.
+            platform = backend_config.platform
+            worker_group.set_env_on_all({"JAX_PLATFORMS": platform})
+            if not distributed:
+                worker_group.run_on_all(_pin_platform, platform)
         if not distributed:
             return
         addr = ray_tpu.get(worker_group.workers[0].get_address.remote())

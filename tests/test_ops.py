@@ -205,6 +205,45 @@ def test_ring_sharded_gqa_with_tp():
                                atol=1e-5, rtol=1e-4)
 
 
+def test_ring_sharded_custom_mesh_without_standard_axes():
+    """ADVICE r1: specs must be built from axes the mesh actually has —
+    a bare Mesh(devs, ("sp",)) used to raise on the hard-coded dp/fsdp/tp
+    PartitionSpec."""
+    import numpy as _np
+    from jax.sharding import Mesh
+    from ray_tpu.ops.ring_attention import ring_attention_sharded
+    b, h, s, d = 1, 2, 64, 16
+    ks = jax.random.split(jax.random.PRNGKey(19), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.float32)
+    mesh = Mesh(_np.array(jax.devices()[:4]), ("sp",))
+    got = jax.jit(lambda *a: ring_attention_sharded(*a, mesh))(q, k, v)
+    want = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("h,kvh", [
+    (8, 1),    # MQA: replicated-KV fast path
+    (12, 3),   # kvh % tp != 0, kvh > 1: must take the repeat path —
+               # replication would misalign contiguous q-head blocks to
+               # kv heads (caught in r2 review)
+])
+def test_ring_sharded_gqa_nondivisible_tp(h, kvh):
+    from ray_tpu.ops.ring_attention import ring_attention_sharded
+    b, s, d = 2, 32, 8
+    ks = jax.random.split(jax.random.PRNGKey(23), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, kvh, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, kvh, s, d), jnp.float32)
+    mesh = prepare_mesh(tp=2, sp=2, dp=2)
+    got = jax.jit(lambda *a: ring_attention_sharded(*a, mesh))(q, k, v)
+    want = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-4)
+
+
 def test_softmax_cross_entropy():
     logits = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 32))
     labels = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 32)

@@ -142,3 +142,43 @@ def test_all_to_all_ulysses():
     # content preserved under permutation of (seq, head) blocks
     np.testing.assert_allclose(np.sort(np.asarray(out).ravel()),
                                np.sort(np.asarray(x).ravel()))
+
+
+# ---------------------------------------------------------- hybrid DCN mesh
+def test_split_hybrid_factors_outer_axis():
+    from ray_tpu.parallel.mesh import _split_hybrid
+    # (pp, dp, fsdp, sp, ep, tp) = (1, 4, 2, 1, 1, 1), 2 slices of 4.
+    dcn, ici = _split_hybrid((1, 4, 2, 1, 1, 1), 2, 4)
+    assert dcn == (1, 2, 1, 1, 1, 1)
+    assert ici == (1, 2, 2, 1, 1, 1)
+
+
+def test_split_hybrid_rejects_inner_only_mesh():
+    from ray_tpu.parallel.mesh import _split_hybrid
+    with pytest.raises(ValueError, match="slices"):
+        # All axes trivial except tp (innermost, ICI-only): the 2 slices
+        # have nowhere to go.
+        _split_hybrid((1, 1, 1, 1, 1, 2), 2, 1)
+
+
+def test_prepare_mesh_hybrid_path_with_fake_slices(monkeypatch):
+    """Devices carrying distinct slice_index route through
+    create_hybrid_device_mesh with the (dcn, ici) factorisation."""
+    from ray_tpu.parallel import mesh as mesh_mod
+
+    calls = {}
+
+    def fake_hybrid(ici_shape, dcn_shape, devices=None):
+        calls["ici"] = tuple(ici_shape)
+        calls["dcn"] = tuple(dcn_shape)
+        from jax.experimental import mesh_utils
+        full = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
+        return mesh_utils.create_device_mesh(full, devices=devices)
+
+    monkeypatch.setattr(mesh_mod, "_num_slices", lambda devs: 2)
+    monkeypatch.setattr(mesh_mod.mesh_utils, "create_hybrid_device_mesh",
+                        fake_hybrid)
+    m = mesh_mod.prepare_mesh(MeshSpec(dp=4, tp=2))
+    assert calls["dcn"] == (1, 2, 1, 1, 1, 1)   # dp axis split over DCN
+    assert calls["ici"] == (1, 2, 1, 1, 1, 2)
+    assert m.shape["dp"] == 4 and m.shape["tp"] == 2

@@ -44,15 +44,15 @@ class ActorRecord:
 
 
 @dataclass
-class PlacementGroupRecord:
-    pg_id: str
-    bundles: list[dict]
-    strategy: str
-    state: str = "PENDING"            # PENDING/CREATED/REMOVED
-    name: str = ""
-    # node each bundle was reserved on (single-node v0: all "local")
-    bundle_nodes: list[str] = field(default_factory=list)
-    created_at: float = field(default_factory=time.time)
+class NodeTableRecord:
+    """GcsNodeManager node-table entry (gcs_node_manager.h:62)."""
+    node_id: str
+    resources: dict
+    is_head: bool = False
+    alive: bool = True
+    death_cause: str = ""
+    labels: dict = field(default_factory=dict)
+    registered_at: float = field(default_factory=time.time)
 
 
 class Controller:
@@ -63,7 +63,8 @@ class Controller:
         self._named_actors: dict[tuple[str, str], str] = {}
         self._refcounts: dict[str, int] = {}
         self._pins: dict[str, int] = collections.defaultdict(int)
-        self._pgs: dict[str, PlacementGroupRecord] = {}
+        self._pgs: dict[str, dict] = {}
+        self._nodes: dict[str, NodeTableRecord] = {}
         self._task_events: collections.deque = collections.deque(
             maxlen=task_event_capacity)
         self._job_start = time.time()
@@ -186,21 +187,40 @@ class Controller:
                 "death_cause": r.death_cause,
             } for aid, r in self._actors.items()]
 
-    # ---- placement groups ----
-    def register_pg(self, rec: PlacementGroupRecord) -> None:
+    # ---- placement groups (view pushed by the ClusterTaskManager) ----
+    def register_pg_view(self, entry: dict) -> None:
         with self._lock:
-            self._pgs[rec.pg_id] = rec
-
-    def get_pg(self, pg_id: str) -> Optional[PlacementGroupRecord]:
-        with self._lock:
-            return self._pgs.get(pg_id)
+            self._pgs[entry["placement_group_id"]] = dict(entry)
 
     def list_pgs(self) -> list[dict]:
         with self._lock:
+            return [dict(e) for e in self._pgs.values()]
+
+    # ---- node table (GcsNodeManager parity) ----
+    def register_node(self, node_id: str, resources: dict,
+                      is_head: bool = False,
+                      labels: Optional[dict] = None) -> None:
+        with self._lock:
+            self._nodes[node_id] = NodeTableRecord(
+                node_id=node_id, resources=dict(resources),
+                is_head=is_head, labels=dict(labels or {}))
+
+    def set_node_state(self, node_id: str, alive: bool,
+                       cause: str = "") -> None:
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is not None:
+                rec.alive = alive
+                if cause:
+                    rec.death_cause = cause
+
+    def list_nodes(self) -> list[dict]:
+        with self._lock:
             return [{
-                "placement_group_id": pid, "state": r.state,
-                "bundles": r.bundles, "strategy": r.strategy, "name": r.name,
-            } for pid, r in self._pgs.items()]
+                "node_id": r.node_id, "alive": r.alive,
+                "is_head": r.is_head, "resources": dict(r.resources),
+                "death_cause": r.death_cause, "labels": dict(r.labels),
+            } for r in self._nodes.values()]
 
     # ---- task events (GcsTaskManager parity) ----
     def record_task_event(self, task_id: str, name: str, state: str,

@@ -92,11 +92,23 @@ class Runtime(_context.BaseContext):
         self._listener.listen(128)
         self.address = self._listener.getsockname()
 
-        self.scheduler = Scheduler(self, node_res, self.address, max_workers)
+        from ray_tpu._private.cluster import ClusterTaskManager
+        self.cluster = ClusterTaskManager(self)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ray-tpu-accept", daemon=True)
         self._accept_thread.start()
-        self.scheduler.start()
+        head = self.cluster.add_node(node_res, max_workers=max_workers,
+                                     is_head=True)
+        self.head_node_id = head.node_id
+
+    @property
+    def scheduler(self):
+        """The head node's scheduler (single-node compatibility view)."""
+        rec = self.cluster.get_node(self.head_node_id)
+        return rec.scheduler if rec else None
+
+    def _scheduler_for_worker(self, worker_id: str):
+        return self.cluster.scheduler_for_worker(worker_id)
 
     # ================= connection plumbing =================
     def _accept_loop(self) -> None:
@@ -113,7 +125,10 @@ class Runtime(_context.BaseContext):
         wid = conn.meta.get("worker_id")
         if wid is None or self._shutdown:
             return
-        task, actor_id = self.scheduler.on_worker_lost(wid)
+        sched = self._scheduler_for_worker(wid)
+        if sched is None:
+            return
+        task, actor_id = sched.on_worker_lost(wid)
         if task is not None:
             self._recover_task(task)
         if actor_id is not None:
@@ -127,7 +142,7 @@ class Runtime(_context.BaseContext):
             spec.retries_used += 1
             self.controller.record_task_event(
                 spec.task_id, spec.name, "RETRYING")
-            self.scheduler.enqueue_front(spec)
+            self.cluster.submit(spec)
         else:
             err = TaskError(WorkerDiedError(
                 f"worker died running task {spec.name or spec.task_id}"),
@@ -163,7 +178,7 @@ class Runtime(_context.BaseContext):
                         task_name=t.name))
             with st.lock:
                 st.queued[:0] = retried
-            self.scheduler.enqueue_front(rec.spec)
+            self.cluster.submit(rec.spec)
         else:
             self.controller.set_actor_state(actor_id, DEAD,
                                             death_cause="worker died")
@@ -178,6 +193,30 @@ class Runtime(_context.BaseContext):
     def _store_error(self, return_ids: list[str], err: BaseException) -> None:
         for oid in return_ids:
             self.store.put(err, object_id=oid)
+
+    def on_unplaceable(self, spec, reason: str) -> None:
+        """Cluster callback: a spec can never be placed (e.g. hard node
+        affinity to a dead node). Fail fast rather than hang."""
+        from ray_tpu._private.specs import ActorSpec as _ActorSpec
+        if isinstance(spec, _ActorSpec):
+            self.controller.set_actor_state(spec.actor_id, DEAD,
+                                            death_cause=reason)
+            st = self._actor_state(spec.actor_id)
+            with st.lock:
+                dead = st.queued + list(st.inflight.values())
+                st.queued = []
+                st.inflight.clear()
+            for t in dead:
+                self._store_error(t.return_ids, TaskError(
+                    ActorDiedError(spec.actor_id, reason),
+                    task_name=t.name))
+            return
+        self._store_error(spec.return_ids, TaskError(
+            WorkerDiedError(f"task unplaceable: {reason}"),
+            task_name=spec.name))
+        self._unpin(spec.pinned_refs)
+        self.controller.record_task_event(spec.task_id, spec.name,
+                                          "FAILED", error=reason)
 
     def _unpin(self, object_ids: list[str]) -> None:
         for oid in object_ids:
@@ -197,7 +236,11 @@ class Runtime(_context.BaseContext):
     def _handle_msg(self, conn: protocol.Connection, msg: dict) -> None:
         mtype = msg["type"]
         if mtype == protocol.REGISTER:
-            self.scheduler.on_worker_registered(msg["worker_id"], conn)
+            sched = self._scheduler_for_worker(msg["worker_id"])
+            if sched is not None:
+                sched.on_worker_registered(msg["worker_id"], conn)
+            else:
+                conn.close()              # worker from a dead/old node
         elif mtype == protocol.TASK_DONE:
             self._on_task_done(conn, msg)
         elif mtype == protocol.GET_OBJECT:
@@ -246,9 +289,11 @@ class Runtime(_context.BaseContext):
             if self.controller.unreferenced(stored.object_id):
                 self.store.delete(stored.object_id)
         worker_id = conn.meta.get("worker_id", "")
+        wsched = self._scheduler_for_worker(worker_id)
         if msg.get("is_actor_create"):
             actor_id = msg["actor_id"]
-            self.scheduler.actor_ready(worker_id)
+            if wsched is not None:
+                wsched.actor_ready(worker_id)
             if msg.get("error"):
                 rec = self.controller.get_actor(actor_id)
                 if rec is not None:
@@ -280,7 +325,8 @@ class Runtime(_context.BaseContext):
             self.controller.record_task_event(task_id, msg.get("name", ""),
                                               state, worker_id=worker_id)
             return
-        spec = self.scheduler.task_finished(worker_id)
+        spec = (wsched.task_finished(worker_id)
+                if wsched is not None else None)
         if spec is not None:
             self._unpin(spec.pinned_refs)
             state = "FAILED" if msg.get("error") else "FINISHED"
@@ -294,10 +340,11 @@ class Runtime(_context.BaseContext):
             conn.reply(msg, stored=stored)
             return
         wid = conn.meta.get("worker_id")
+        wsched = self._scheduler_for_worker(wid) if wid else None
 
         def waiter():
-            if wid:
-                self.scheduler.worker_blocked(wid)
+            if wsched is not None:
+                wsched.worker_blocked(wid)
             try:
                 got = self.store.get_stored(oid, timeout=msg.get("timeout"))
                 if got is not None:
@@ -307,18 +354,19 @@ class Runtime(_context.BaseContext):
             except protocol.ConnectionClosed:
                 pass
             finally:
-                if wid:
-                    self.scheduler.worker_unblocked(wid)
+                if wsched is not None:
+                    wsched.worker_unblocked(wid)
         threading.Thread(target=waiter, daemon=True).start()
 
     def _on_wait(self, conn: protocol.Connection, msg: dict) -> None:
         ids, num_returns = msg["object_ids"], msg["num_returns"]
         timeout = msg.get("timeout")
         wid = conn.meta.get("worker_id")
+        wsched = self._scheduler_for_worker(wid) if wid else None
 
         def waiter():
-            if wid:
-                self.scheduler.worker_blocked(wid)
+            if wsched is not None:
+                wsched.worker_blocked(wid)
             try:
                 ready = self.store.wait_any(ids, num_returns, timeout)
                 ready_set = set(ready)
@@ -327,8 +375,8 @@ class Runtime(_context.BaseContext):
             except protocol.ConnectionClosed:
                 pass
             finally:
-                if wid:
-                    self.scheduler.worker_unblocked(wid)
+                if wsched is not None:
+                    wsched.worker_unblocked(wid)
         threading.Thread(target=waiter, daemon=True).start()
 
     def _kv_dispatch(self, msg: dict) -> Any:
@@ -397,7 +445,7 @@ class Runtime(_context.BaseContext):
         for oid in spec.pinned_refs:
             self.controller.pin(oid)
         self.controller.record_task_event(spec.task_id, spec.name, "PENDING")
-        self.scheduler.enqueue(spec)
+        self.cluster.submit(spec)
         return spec.return_ids
 
     submit_task = submit_spec
@@ -416,7 +464,7 @@ class Runtime(_context.BaseContext):
     def create_actor_from_spec(self, spec: ActorSpec) -> str:
         self.controller.register_actor(spec)
         self._actor_state(spec.actor_id)
-        self.scheduler.enqueue(spec)
+        self.cluster.submit(spec)
         return spec.actor_id
 
     create_actor = create_actor_from_spec
@@ -444,7 +492,7 @@ class Runtime(_context.BaseContext):
                 return spec.return_ids
             st.inflight[spec.task_id] = spec
             target = rec.worker_id
-        if not self.scheduler.send_actor_task(target, spec):
+        if not self._send_actor_task(target, spec):
             with st.lock:
                 # Requeue only if a concurrent _recover_actor didn't already
                 # claim it from inflight (else it would run twice).
@@ -453,6 +501,12 @@ class Runtime(_context.BaseContext):
         return spec.return_ids
 
     submit_actor_task = submit_actor_task_spec
+
+    def _send_actor_task(self, worker_id: str, spec: ActorTaskSpec) -> bool:
+        sched = self._scheduler_for_worker(worker_id)
+        if sched is None:
+            return False
+        return sched.send_actor_task(worker_id, spec)
 
     def _flush_actor_queue(self, actor_id: str) -> None:
         rec = self.controller.get_actor(actor_id)
@@ -466,7 +520,7 @@ class Runtime(_context.BaseContext):
                 spec = st.queued.pop(0)
                 st.inflight[spec.task_id] = spec
                 target = rec.worker_id
-            if not self.scheduler.send_actor_task(target, spec):
+            if not self._send_actor_task(target, spec):
                 with st.lock:
                     st.inflight.pop(spec.task_id, None)
                     st.queued.insert(0, spec)
@@ -480,7 +534,9 @@ class Runtime(_context.BaseContext):
             rec.spec.max_restarts = 0
         wid = rec.worker_id
         if wid is not None:
-            self.scheduler.kill_worker(wid)
+            sched = self._scheduler_for_worker(wid)
+            if sched is not None:
+                sched.kill_worker(wid)
 
     def cancel_task(self, object_id: str, force: bool = False) -> None:
         # v0: cancel only reaches queued (not yet running) tasks, matching
@@ -518,13 +574,17 @@ class Runtime(_context.BaseContext):
         if op == "summarize_tasks":
             return self.controller.summarize_tasks()
         if op == "list_placement_groups":
-            return self.controller.list_pgs()
+            return self.cluster.pg_table()
+        if op == "list_nodes":
+            return self.controller.list_nodes()
         if op == "cluster_resources":
-            return dict(self.scheduler.total)
+            return self.cluster.total_resources()
         if op == "available_resources":
-            return dict(self.scheduler.avail)
+            return self.cluster.available_resources()
         if op == "scheduler_stats":
             return self.scheduler.stats()
+        if op == "cluster_stats":
+            return self.cluster.stats()
         if op == "object_store_stats":
             return self.store.stats()
         if op == "kill_actor":
@@ -541,7 +601,7 @@ class Runtime(_context.BaseContext):
         if self._shutdown:
             return
         self._shutdown = True
-        self.scheduler.shutdown()
+        self.cluster.shutdown()
         try:
             self._listener.close()
         except OSError:

@@ -47,6 +47,9 @@ class WorkerRec:
     task: Optional[TaskSpec] = None
     actor_id: Optional[str] = None
     acquired: dict[str, float] = field(default_factory=dict)
+    # (pg_id, bundle_index) whose ledger `acquired` was charged against,
+    # or None when charged against the node's free pool.
+    pg_key: Optional[tuple] = None
     blocked_depth: int = 0
     started_at: float = field(default_factory=time.time)
 
@@ -67,13 +70,22 @@ def release(avail: dict[str, float], got: dict[str, float]) -> None:
             avail[k] = avail.get(k, 0.0) + v
 
 
+_SPILL_DELAY_S = 1.0
+
+
 class Scheduler:
-    """Single-node scheduler: task queue, resource ledger, worker pool."""
+    """Per-node scheduler: task queue, resource ledger, worker pool.
+
+    One instance per (simulated or real) node; the ClusterTaskManager
+    routes work between instances and monitors their heartbeats."""
 
     def __init__(self, runtime, node_resources: dict[str, float],
-                 listen_addr: tuple[str, int], max_workers: Optional[int] = None):
+                 listen_addr: tuple[str, int],
+                 max_workers: Optional[int] = None,
+                 node_id: Optional[str] = None, cluster=None):
         self._rt = runtime
-        self.node_id = "node_" + uuid.uuid4().hex[:8]
+        self.node_id = node_id or ("node_" + uuid.uuid4().hex[:8])
+        self._cluster = cluster
         self.total = dict(node_resources)
         self.avail = dict(node_resources)
         self._addr = listen_addr
@@ -82,24 +94,70 @@ class Scheduler:
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
         self._pending: deque = deque()           # TaskSpec | ActorSpec
+        self._queued_at: dict[int, float] = {}   # id(spec) -> enqueue time
         self._workers: dict[str, WorkerRec] = {}
+        # (pg_id, bundle_index) -> {"total": {...}, "avail": {...}}
+        self._bundles: dict[tuple, dict] = {}
         self._running = True
         self._spawning = 0
         self._thread = threading.Thread(
-            target=self._loop, name="ray-tpu-scheduler", daemon=True)
+            target=self._loop, name=f"ray-tpu-sched-{self.node_id}",
+            daemon=True)
 
     def start(self) -> None:
         self._thread.start()
+
+    # ---- placement-group bundle ledgers ----
+    def reserve_bundle(self, pg_id: str, index: int,
+                       resources: dict[str, float]) -> bool:
+        """Phase-1 reserve: carve the bundle out of the node free pool."""
+        with self._cv:
+            if not fits(self.avail, resources):
+                return False
+            acquire(self.avail, resources)
+            self._bundles[(pg_id, index)] = {
+                "total": dict(resources), "avail": dict(resources)}
+            return True
+
+    def release_bundle(self, pg_id: str, index: int) -> None:
+        """Return a bundle's unused capacity to the free pool. Resources
+        held by still-running bundle workers rejoin the pool when those
+        workers finish (their pg_key no longer resolves)."""
+        with self._cv:
+            led = self._bundles.pop((pg_id, index), None)
+            if led is not None:
+                release(self.avail, led["avail"])
+            self._cv.notify_all()
+
+    def _bundle_for(self, spec) -> Optional[tuple]:
+        pg_id = getattr(spec, "placement_group_id", None)
+        if not pg_id:
+            return None
+        idx = getattr(spec, "placement_group_bundle_index", -1)
+        if idx is not None and idx >= 0:
+            return (pg_id, idx)
+        # index -1: any bundle of this pg on this node that fits.
+        need = self.need_of(spec)
+        for key, led in self._bundles.items():
+            if key[0] == pg_id and fits(led["avail"], need):
+                return key
+        # fall back to any bundle of the pg (task waits for capacity)
+        for key in self._bundles:
+            if key[0] == pg_id:
+                return key
+        return None
 
     # ---- submission ----
     def enqueue(self, spec) -> None:
         with self._cv:
             self._pending.append(spec)
+            self._queued_at[id(spec)] = time.monotonic()
             self._cv.notify_all()
 
     def enqueue_front(self, spec) -> None:
         with self._cv:
             self._pending.appendleft(spec)
+            self._queued_at[id(spec)] = time.monotonic()
             self._cv.notify_all()
 
     def cancel_pending(self, task_id: str) -> Optional[TaskSpec]:
@@ -154,10 +212,11 @@ class Scheduler:
                 self._spawning = max(0, self._spawning - 1)
             task, actor_id = rec.task, rec.actor_id
             if rec.acquired and rec.blocked_depth == 0:
-                release(self.avail, rec.acquired)
+                release(self._ledger(rec), rec.acquired)
             rec.state = DEAD
             rec.task = None
             rec.acquired = {}
+            rec.pg_key = None
             self._cv.notify_all()
             return task, actor_id
 
@@ -185,7 +244,7 @@ class Scheduler:
                 return
             rec.blocked_depth += 1
             if rec.blocked_depth == 1 and rec.acquired:
-                release(self.avail, rec.acquired)
+                release(self._ledger(rec), rec.acquired)
             self._cv.notify_all()
 
     def worker_unblocked(self, worker_id: str) -> None:
@@ -197,7 +256,7 @@ class Scheduler:
             if rec.blocked_depth == 0 and rec.acquired and rec.state != DEAD:
                 # Re-acquire (may oversubscribe transiently, as the reference
                 # raylet does when a blocked worker resumes).
-                acquire(self.avail, rec.acquired)
+                acquire(self._ledger(rec), rec.acquired)
 
     # ---- completion ----
     def task_finished(self, worker_id: str) -> Optional[TaskSpec]:
@@ -209,8 +268,9 @@ class Scheduler:
             rec.task = None
             if rec.state == BUSY:
                 if rec.blocked_depth == 0 and rec.acquired:
-                    release(self.avail, rec.acquired)
+                    release(self._ledger(rec), rec.acquired)
                 rec.acquired = {}
+                rec.pg_key = None
                 rec.state = IDLE
             elif rec.state == ACTOR:
                 pass                      # actor keeps its resources
@@ -234,22 +294,97 @@ class Scheduler:
     def _alive_count(self) -> int:
         return sum(1 for r in self._workers.values() if r.state != DEAD)
 
-    def _effective_need(self, spec) -> dict[str, float]:
+    @staticmethod
+    def need_of(spec) -> dict[str, float]:
         res = dict(spec.resources) if spec.resources else {}
         if "CPU" not in res and not res.get("_pg_reserved"):
             res.setdefault("CPU", 1.0)
         res.pop("_pg_reserved", None)
         return res
 
+    def _effective_need(self, spec) -> dict[str, float]:
+        return self.need_of(spec)
+
+    def effective_avail(self) -> dict[str, float]:
+        """Availability minus demand already queued here but not yet
+        dispatched (workers take seconds to spawn, so `avail` alone
+        wildly overstates capacity during placement bursts)."""
+        with self._lock:
+            eff = dict(self.avail)
+            for spec in self._pending:
+                for k, v in self._effective_need(spec).items():
+                    eff[k] = eff.get(k, 0.0) - v
+            return eff
+
+    def utilization(self) -> float:
+        """Max per-resource utilization fraction incl. queued demand
+        (hybrid-policy input; may exceed 1.0 under backlog)."""
+        eff = self.effective_avail()
+        u = 0.0
+        for k, tot in self.total.items():
+            if tot > 0:
+                u = max(u, 1.0 - eff.get(k, 0.0) / tot)
+        return u
+
+    def owns_worker(self, worker_id: str) -> bool:
+        with self._lock:
+            return worker_id in self._workers
+
+    def _ledger(self, rec: WorkerRec) -> dict[str, float]:
+        """The availability pool `rec.acquired` was charged against. A
+        bundle released while its workers still run falls back to the
+        node pool (the bundle's ledger is gone)."""
+        if rec.pg_key is not None:
+            led = self._bundles.get(rec.pg_key)
+            if led is not None:
+                return led["avail"]
+        return self.avail
+
     def _loop(self) -> None:
         while True:
             with self._cv:
                 if not self._running:
                     return
+                if self._cluster is not None:
+                    self._cluster.heartbeat(self.node_id)
                 self._reap_failed_spawns_locked()
+                self._spill_aged_locked()
                 dispatched = self._try_dispatch_locked()
                 if not dispatched:
                     self._cv.wait(timeout=0.25)
+
+    def _spill_aged_locked(self) -> None:
+        """Spillback (stage-1 redirect): hand unconstrained tasks that
+        aged past _SPILL_DELAY_S without resources back to the cluster
+        for re-placement on a node with room."""
+        if self._cluster is None:
+            return
+        now = time.monotonic()
+        for spec in list(self._pending):
+            if fits(self.avail, self._effective_need(spec)):
+                continue
+            t0 = self._queued_at.get(id(spec))
+            if t0 is None or now - t0 < _SPILL_DELAY_S:
+                continue
+            spilled = getattr(spec, "_spill_count", 0)
+            if spilled >= 3:
+                continue
+            # Release the lock around the cluster call (it takes the
+            # cluster lock; cluster->node calls take node locks).
+            self._pending.remove(spec)
+            self._queued_at.pop(id(spec), None)
+            self._cv.release()
+            try:
+                try:
+                    spec._spill_count = spilled + 1
+                except AttributeError:
+                    pass
+                moved = self._cluster.try_spill(spec, self.node_id)
+            finally:
+                self._cv.acquire()
+            if not moved:
+                self._pending.appendleft(spec)
+                self._queued_at[id(spec)] = t0
 
     def _reap_failed_spawns_locked(self) -> None:
         """A worker that exits (or hangs) before registering would otherwise
@@ -275,7 +410,12 @@ class Scheduler:
     def _try_dispatch_locked(self) -> bool:
         for spec in list(self._pending):
             need = self._effective_need(spec)
-            if not fits(self.avail, need):
+            pg_key = self._bundle_for(spec)
+            if getattr(spec, "placement_group_id", None) and pg_key is None:
+                continue                  # bundle not (yet) on this node
+            pool = (self._bundles[pg_key]["avail"] if pg_key is not None
+                    else self.avail)
+            if not fits(pool, need):
                 continue
             worker = self._pick_worker()
             if worker is None:
@@ -294,8 +434,10 @@ class Scheduler:
                         self._cv.acquire()
                 return False              # wait for registration
             self._pending.remove(spec)
-            acquire(self.avail, need)
+            self._queued_at.pop(id(spec), None)
+            acquire(pool, need)
             worker.acquired = need
+            worker.pg_key = pg_key
             if isinstance(spec, ActorSpec):
                 worker.state = ACTOR
                 worker.actor_id = spec.actor_id
@@ -363,3 +505,60 @@ class Scheduler:
                     rec.proc.wait(timeout=max(0.1, deadline - time.time()))
                 except subprocess.TimeoutExpired:
                     rec.proc.kill()
+
+    # ---- node-death paths (ClusterTaskManager hooks) ----
+    def die_silently(self) -> None:
+        """Simulated abrupt node failure: SIGKILL every worker, stop the
+        dispatch loop (and with it the heartbeat) WITHOUT telling anyone.
+        The cluster health monitor must detect the death."""
+        with self._cv:
+            self._running = False
+            workers = list(self._workers.values())
+            self._cv.notify_all()
+        for rec in workers:
+            if rec.proc is not None:
+                try:
+                    rec.proc.kill()
+                except Exception:
+                    pass
+            if rec.conn is not None:
+                # Detach the connection so worker-lost callbacks don't fire
+                # per-worker; recovery happens in one pass at node death.
+                rec.conn.meta.pop("worker_id", None)
+                try:
+                    rec.conn.close()
+                except Exception:
+                    pass
+
+    def drain_for_death(self):
+        """Collect (queued specs, running tasks, actor ids on this node)
+        and tear everything down. Called by the cluster after the node is
+        marked dead."""
+        with self._cv:
+            self._running = False
+            queued = list(self._pending)
+            self._pending.clear()
+            self._queued_at.clear()
+            workers = list(self._workers.values())
+            self._cv.notify_all()
+        running_tasks, actor_ids = [], []
+        for rec in workers:
+            if rec.state == DEAD:
+                continue
+            if rec.task is not None and isinstance(rec.task, TaskSpec):
+                running_tasks.append(rec.task)
+            if rec.actor_id is not None:
+                actor_ids.append(rec.actor_id)
+            rec.state = DEAD
+            if rec.conn is not None:
+                rec.conn.meta.pop("worker_id", None)
+                try:
+                    rec.conn.close()
+                except Exception:
+                    pass
+            if rec.proc is not None:
+                try:
+                    rec.proc.kill()
+                except Exception:
+                    pass
+        return queued, running_tasks, actor_ids

@@ -53,6 +53,7 @@ class TaskSpec:
     placement_group_bundle_index: int = -1
     scheduling_strategy: Any = None
     node_id: Optional[str] = None     # node affinity (cluster sim)
+    affinity_soft: bool = False       # soft affinity falls back anywhere
     runtime_env: Optional[dict] = None
     # bookkeeping (filled by runtime)
     pinned_refs: list[str] = field(default_factory=list)
@@ -75,6 +76,7 @@ class ActorSpec:
     placement_group_bundle_index: int = -1
     scheduling_strategy: Any = None
     node_id: Optional[str] = None
+    affinity_soft: bool = False
     runtime_env: Optional[dict] = None
 
 

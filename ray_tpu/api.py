@@ -53,6 +53,7 @@ def _apply_scheduling(spec, opts: dict) -> None:
     if strategy is not None and type(strategy).__name__ == \
             "NodeAffinitySchedulingStrategy":
         spec.node_id = strategy.node_id
+        spec.affinity_soft = bool(getattr(strategy, "soft", False))
     if pg is not None:
         spec.placement_group_id = getattr(pg, "id", pg)
         spec.placement_group_bundle_index = (

@@ -1,0 +1,23 @@
+"""RLlib-equivalent: TPU-native reinforcement learning on ray_tpu.
+
+Component layout mirrors the reference's new API stack (SURVEY.md §2.3):
+ActorCriticModule ~ RLModule, PPOLearner/LearnerGroup ~ Learner stack,
+SingleAgentEnvRunner/EnvRunnerGroup ~ EnvRunner stack, and
+FaultTolerantActorManager as the shared actor-fleet substrate.
+"""
+from ray_tpu.rllib.actor_manager import (CallResult,
+                                         FaultTolerantActorManager,
+                                         RemoteCallResults)
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.learner import (LearnerGroup, PPOLearner,
+                                        PPOLearnerConfig)
+from ray_tpu.rllib.core.rl_module import ActorCriticModule, Categorical
+from ray_tpu.rllib.env.env_runner import EnvRunnerConfig, SingleAgentEnvRunner
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+
+__all__ = [
+    "PPO", "PPOConfig", "PPOLearner", "PPOLearnerConfig", "LearnerGroup",
+    "ActorCriticModule", "Categorical", "SingleAgentEnvRunner",
+    "EnvRunnerConfig", "EnvRunnerGroup", "FaultTolerantActorManager",
+    "RemoteCallResults", "CallResult",
+]

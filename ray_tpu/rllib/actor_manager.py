@@ -31,6 +31,17 @@ _SYSTEM_ERRORS = (ActorDiedError, ActorUnavailableError, WorkerDiedError,
                   ConnectionError, TimeoutError)
 
 
+def _is_system_error(e: BaseException) -> bool:
+    """Actor-death errors surface wrapped in TaskError at the get()
+    site; classify by the CAUSE, not the wrapper (a user-code exception
+    also arrives as a TaskError but leaves the actor healthy)."""
+    from ray_tpu.exceptions import TaskError
+    if isinstance(e, TaskError):
+        cause = e.cause
+        return cause is not None and isinstance(cause, _SYSTEM_ERRORS)
+    return isinstance(e, _SYSTEM_ERRORS)
+
+
 @dataclasses.dataclass
 class CallResult:
     actor_id: int

@@ -1,0 +1,121 @@
+"""RLModule-equivalent: the neural net + action-distribution bundle.
+
+Parity: reference rllib/core/rl_module/rl_module.py (framework-agnostic
+module with forward_inference/forward_train) — re-done as pure JAX
+pytrees + functions (no torch Module): `init` builds the param tree,
+`forward` returns (logits, value), and the distribution helpers are
+static functions usable inside jit on both the learner (TPU mesh) and
+the env-runner (CPU) side.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = dict
+
+
+class Categorical:
+    """Minimal categorical distribution over logits, jit-friendly."""
+
+    @staticmethod
+    def sample(logits: jax.Array, key: jax.Array) -> jax.Array:
+        return jax.random.categorical(key, logits, axis=-1)
+
+    @staticmethod
+    def log_prob(logits: jax.Array, actions: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.take_along_axis(
+            logp, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    @staticmethod
+    def entropy(logits: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class ActorCriticModule:
+    """MLP torso with separate policy/value heads (discrete actions).
+
+    Mirrors the reference's default RLModule for classic-control tasks
+    (rllib/core/rl_module/default_model_config.py): tanh MLP encoder,
+    categorical action head, scalar value head.
+    """
+
+    obs_dim: int
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+    def init(self, key: jax.Array) -> Params:
+        keys = jax.random.split(key, 2 * len(self.hidden) + 2)
+        ki = iter(keys)
+
+        def dense(key, din, dout, scale):
+            w = jax.random.orthogonal(key, max(din, dout))[:din, :dout]
+            return {"w": (w * scale).astype(jnp.float32),
+                    "b": jnp.zeros((dout,), jnp.float32)}
+
+        params: Params = {"pi": [], "vf": []}
+        for head, out_dim, out_scale in (("pi", self.num_actions, 0.01),
+                                         ("vf", 1, 1.0)):
+            din = self.obs_dim
+            layers = []
+            for h in self.hidden:
+                layers.append(dense(next(ki), din, h, jnp.sqrt(2.0)))
+                din = h
+            layers.append(dense(next(ki), din, out_dim, out_scale))
+            params[head] = layers
+        return params
+
+    @staticmethod
+    def _mlp(layers, x):
+        for layer in layers[:-1]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        last = layers[-1]
+        return x @ last["w"] + last["b"]
+
+    def forward(self, params: Params, obs: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+        """obs (..., obs_dim) -> (logits (..., A), value (...))."""
+        logits = self._mlp(params["pi"], obs)
+        value = self._mlp(params["vf"], obs)[..., 0]
+        return logits, value
+
+    def action_logp(self, params: Params, obs: jax.Array, key: jax.Array
+                    ) -> Tuple[jax.Array, jax.Array]:
+        logits, _ = self.forward(params, obs)
+        action = Categorical.sample(logits, key)
+        return action, Categorical.log_prob(logits, action)
+
+    # ----------------------------------------------- numpy (env runner)
+    @staticmethod
+    def forward_policy_np(params_np: Params, obs):
+        """Pure-numpy policy logits for env-runner-side inference.
+
+        Tiny classic-control MLPs are dominated by per-call dispatch
+        overhead under jit; the env runner therefore samples with plain
+        numpy (mathematically identical to `forward`'s policy head) and
+        keeps JAX for the learner, where the batch is big enough for XLA
+        to win."""
+        import numpy as np
+        x = obs
+        layers = params_np["pi"]
+        for layer in layers[:-1]:
+            x = np.tanh(x @ layer["w"] + layer["b"])
+        return x @ layers[-1]["w"] + layers[-1]["b"]
+
+    @staticmethod
+    def sample_np(logits, rng):
+        """Categorical sample + log-prob in numpy (Gumbel-max trick)."""
+        import numpy as np
+        z = logits - logits.max(axis=-1, keepdims=True)
+        logp_all = z - np.log(np.exp(z).sum(axis=-1, keepdims=True))
+        g = rng.gumbel(size=logits.shape)
+        action = np.argmax(logits + g, axis=-1)
+        logp = np.take_along_axis(
+            logp_all, action[..., None], axis=-1)[..., 0]
+        return action.astype(np.int32), logp.astype(np.float32)

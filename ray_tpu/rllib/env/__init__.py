@@ -1,0 +1,4 @@
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+
+__all__ = ["SingleAgentEnvRunner", "EnvRunnerGroup"]

@@ -28,13 +28,45 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     mesh: Optional[MeshSpec] = None     # global mesh over all workers
     placement_strategy: str = "PACK"
+    # TPU pod-slice mode: topology (e.g. "v4-32") makes the trainer
+    # reserve the whole slice as a STRICT_SPREAD placement group (one
+    # worker per slice host, head bundle on rank 0 — the reference's
+    # pod-slice scheduling, _private/accelerators/tpu.py:334-397).
+    topology: Optional[str] = None
+    pod_name: Optional[str] = None
+
+    def __post_init__(self):
+        if self.topology is not None:
+            from ray_tpu._private.accelerators.tpu import num_hosts
+            hosts = num_hosts(self.topology)
+            if self.num_workers not in (1, hosts):
+                raise ValueError(
+                    f"num_workers={self.num_workers} contradicts "
+                    f"topology {self.topology} ({hosts} hosts)")
+            self.num_workers = hosts
+            self.use_tpu = True
+            self.placement_strategy = "STRICT_SPREAD"
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})
         res.setdefault("CPU", 1.0)
         if self.use_tpu:
-            res.setdefault("TPU", float(self.chips_per_worker or 1))
+            if self.topology is not None:
+                from ray_tpu._private.accelerators.tpu import chips_per_host
+                res.setdefault("TPU", float(chips_per_host(self.topology)))
+            else:
+                res.setdefault("TPU", float(self.chips_per_worker or 1))
         return res
+
+    def worker_bundles(self) -> Optional[list]:
+        """Explicit per-rank bundles for pod-slice mode (else None)."""
+        if self.topology is None:
+            return None
+        from ray_tpu.util.accelerators.tpu import slice_bundles
+        base = self.worker_resources()
+        bundles = slice_bundles(self.topology, self.pod_name,
+                                cpus_per_host=base.get("CPU", 1.0))
+        return bundles
 
 
 @dataclasses.dataclass

@@ -72,23 +72,56 @@ class RayTrainWorker:
 
 
 class WorkerGroup:
-    """Owns the actor handles; all-or-nothing lifecycle."""
+    """Owns the actor handles; all-or-nothing lifecycle.
+
+    The group schedules through a placement group (one bundle per
+    worker, reference backend_executor.py:219) so worker placement is
+    atomic: either every rank gets its bundle or the PG creation raises
+    — no half-started SPMD group holding chips."""
 
     def __init__(self, num_workers: int,
-                 resources_per_worker: Optional[Dict[str, float]] = None):
+                 resources_per_worker: Optional[Dict[str, float]] = None,
+                 placement_strategy: str = "PACK",
+                 bundles: Optional[List[Dict[str, float]]] = None):
         self.num_workers = num_workers
         self._resources = dict(resources_per_worker or {"CPU": 1.0})
+        self._strategy = placement_strategy
+        # Explicit per-rank bundles (TPU pod-slice mode: rank 0's bundle
+        # carries the TPU-<gen>-head resource).
+        self._bundles = bundles
+        if bundles is not None and len(bundles) != num_workers:
+            raise ValueError(f"{len(bundles)} bundles != "
+                             f"{num_workers} workers")
         self.workers: List[Any] = []
+        self._pg = None
 
     def start(self) -> None:
-        cls = ray_tpu.remote(**{
-            "num_cpus": self._resources.get("CPU", 1.0),
-            "num_tpus": self._resources.get("TPU", 0) or None,
-            "resources": {k: v for k, v in self._resources.items()
-                          if k not in ("CPU", "TPU")} or None,
-        })(RayTrainWorker)
-        self.workers = [cls.remote(rank, self.num_workers)
-                        for rank in range(self.num_workers)]
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+        self._pg = placement_group(
+            self._bundles or
+            [dict(self._resources) for _ in range(self.num_workers)],
+            strategy=self._strategy, name="train_worker_group")
+        if not self._pg.wait(timeout_seconds=60):
+            pg, self._pg = self._pg, None
+            remove_placement_group(pg)
+            raise TimeoutError(
+                f"placement group for {self.num_workers} train workers "
+                f"({self._resources} each, {self._strategy}) not ready "
+                f"within 60s — cluster lacks free capacity")
+        self.workers = []
+        for rank in range(self.num_workers):
+            res = dict(self._bundles[rank] if self._bundles
+                       else self._resources)
+            cls = ray_tpu.remote(**{
+                "num_cpus": res.pop("CPU", 1.0),
+                "num_tpus": res.pop("TPU", 0) or None,
+                "resources": res or None,
+            })(RayTrainWorker)
+            self.workers.append(
+                cls.options(placement_group=self._pg,
+                            placement_group_bundle_index=rank)
+                .remote(rank, self.num_workers))
         # fail fast if any worker failed to start
         ray_tpu.get([w.ping.remote() for w in self.workers], timeout=60)
 
@@ -99,6 +132,13 @@ class WorkerGroup:
             except Exception:
                 pass
         self.workers = []
+        if self._pg is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None
 
     # ------------------------------------------------------------ fanout
     def run_on_all(self, fn: Callable, *args, **kwargs) -> List[Any]:

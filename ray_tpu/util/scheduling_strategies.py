@@ -1,0 +1,30 @@
+"""Scheduling strategies (reference python/ray/util/scheduling_strategies.py).
+
+Consumed by api._apply_scheduling via duck-typed class names, so these
+plain dataclasses are the full contract.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: object
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclasses.dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: str
+    soft: bool = False
+
+
+@dataclasses.dataclass
+class SpreadSchedulingStrategy:
+    """Best-effort spread across nodes (reference \"SPREAD\")."""
+
+
+DEFAULT = "DEFAULT"

@@ -1,0 +1,210 @@
+"""RLlib-equivalent tests: actor manager, env runner, PPO learning gate.
+
+Mirrors the reference's test strategy (SURVEY.md §4.3): unit tests per
+component plus a learning-regression gate (tuned_examples/ppo/
+cartpole_ppo.py's reward-threshold stop criterion).
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (ActorCriticModule, Categorical, EnvRunnerConfig,
+                           EnvRunnerGroup, FaultTolerantActorManager,
+                           PPOConfig, PPOLearner, PPOLearnerConfig,
+                           SingleAgentEnvRunner)
+
+
+# ------------------------------------------------------------ rl_module
+def test_module_forward_shapes():
+    import jax
+    m = ActorCriticModule(obs_dim=4, num_actions=2)
+    params = m.init(jax.random.PRNGKey(0))
+    obs = np.zeros((7, 4), np.float32)
+    logits, value = m.forward(params, obs)
+    assert logits.shape == (7, 2) and value.shape == (7,)
+    a, logp = m.action_logp(params, obs, jax.random.PRNGKey(1))
+    assert a.shape == (7,) and logp.shape == (7,)
+    assert np.all(np.asarray(logp) <= 0)
+
+
+def test_categorical_log_prob_matches_softmax():
+    import jax
+    logits = jax.random.normal(jax.random.PRNGKey(2), (5, 3))
+    actions = np.array([0, 1, 2, 1, 0])
+    logp = Categorical.log_prob(logits, actions)
+    ref = np.log(np.asarray(jax.nn.softmax(logits, axis=-1)))[
+        np.arange(5), actions]
+    np.testing.assert_allclose(np.asarray(logp), ref, rtol=1e-5)
+
+
+# ------------------------------------------------------------ env runner
+def test_env_runner_sample_shapes_and_autoreset_mask():
+    r = SingleAgentEnvRunner(EnvRunnerConfig(
+        env="CartPole-v1", num_envs=4, rollout_length=64, seed=3))
+    batch = r.sample()
+    assert batch["obs"].shape == (65, 4, 4)
+    for k in ("actions", "logp", "rewards", "dones", "mask"):
+        assert batch[k].shape == (64, 4)
+    # Every done step must be followed by a masked filler transition.
+    dones = batch["dones"][:-1].astype(bool)
+    nxt_mask = batch["mask"][1:]
+    assert np.all(nxt_mask[dones] == 0.0)
+    # A random policy on CartPole ends episodes within 64 steps.
+    assert dones.any()
+    metrics = r.get_metrics()
+    assert metrics["num_episodes"] > 0
+    assert metrics["episode_return_mean"] > 0
+    r.stop()
+
+
+def test_env_runner_weight_sync_roundtrip():
+    import jax
+    r = SingleAgentEnvRunner(EnvRunnerConfig(num_envs=2,
+                                             rollout_length=8))
+    w = r.get_weights()
+    w2 = jax.tree_util.tree_map(lambda x: x * 0, w)
+    r.set_weights(w2)
+    got = r.get_weights()
+    assert all(np.all(np.asarray(leaf) == 0)
+               for leaf in jax.tree_util.tree_leaves(got))
+    r.stop()
+
+
+# --------------------------------------------------------------- learner
+def test_learner_update_improves_objective_on_fixed_batch():
+    cfg = PPOLearnerConfig(obs_dim=4, num_actions=2, num_epochs=2,
+                           num_minibatches=2)
+    learner = PPOLearner(cfg)
+    rng = np.random.default_rng(0)
+    T, N = 32, 4
+    batch = {
+        "obs": rng.normal(size=(T + 1, N, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, size=(T, N)).astype(np.int32),
+        "logp": np.full((T, N), -0.69, np.float32),
+        "rewards": rng.normal(size=(T, N)).astype(np.float32),
+        "terminateds": np.zeros((T, N), np.float32),
+        "dones": np.zeros((T, N), np.float32),
+        "mask": np.ones((T, N), np.float32),
+    }
+    m1 = learner.update(batch)
+    for k in ("policy_loss", "vf_loss", "entropy", "kl", "clip_frac"):
+        assert np.isfinite(m1[k]), (k, m1)
+    m2 = learner.update(batch)
+    # Same batch again: value loss must drop as the critic fits it.
+    assert m2["vf_loss"] < m1["vf_loss"]
+    thr = learner.sgd_throughput()
+    assert thr["minibatch_updates_per_s"] > 0
+
+
+# ---------------------------------------------------- actor manager (FT)
+def test_actor_manager_sync_and_user_errors(ray_cluster):
+    @ray_tpu.remote
+    class Worker:
+        def __init__(self, i):
+            self.i = i
+
+        def ping(self):
+            return "pong"
+
+        def work(self, x):
+            if self.i == 1:
+                raise ValueError("boom")
+            return self.i * x
+
+    mgr = FaultTolerantActorManager(
+        [Worker.remote(i) for i in range(3)])
+    res = mgr.foreach_actor("work", args=(10,))
+    assert len(res) == 3
+    assert res.num_errors == 1
+    assert sorted(res.values()) == [0, 20]
+    # User error does NOT mark the actor unhealthy.
+    assert mgr.num_healthy_actors == 3
+
+
+def test_actor_manager_async_fetch(ray_cluster):
+    @ray_tpu.remote
+    class Slow:
+        def ping(self):
+            return "pong"
+
+        def job(self, x):
+            return x + 1
+
+    mgr = FaultTolerantActorManager([Slow.remote() for _ in range(2)])
+    n = mgr.foreach_actor_async("job", args=(41,), tag="t")
+    assert n == 2
+    got = []
+    import time
+    deadline = time.time() + 20
+    while len(got) < 2 and time.time() < deadline:
+        got += mgr.fetch_ready_async_reqs(timeout_seconds=1.0,
+                                          tags=["t"]).values()
+    assert sorted(got) == [42, 42]
+
+
+def test_actor_manager_detects_death_and_factory_restores(ray_cluster):
+    @ray_tpu.remote(max_restarts=0)
+    class Mortal:
+        def ping(self):
+            return "pong"
+
+        def die(self):
+            import os
+            os._exit(1)
+
+        def val(self):
+            return 7
+
+    def factory(idx):
+        return Mortal.remote()
+
+    mgr = FaultTolerantActorManager([Mortal.remote() for _ in range(2)],
+                                    actor_factory=factory)
+    res = mgr.foreach_actor("die", remote_actor_ids=[0],
+                            timeout_seconds=30)
+    assert res.num_errors == 1
+    assert mgr.num_healthy_actors == 1
+    restored = mgr.probe_unhealthy_actors()
+    assert restored == [0]
+    assert mgr.num_healthy_actors == 2
+    res = mgr.foreach_actor("val")
+    assert sorted(res.values()) == [7, 7]
+
+
+# ----------------------------------------------------- env runner group
+def test_env_runner_group_remote_sampling(ray_cluster):
+    grp = EnvRunnerGroup(
+        EnvRunnerConfig(num_envs=2, rollout_length=16, seed=11),
+        num_env_runners=2)
+    batches = grp.sample()
+    assert len(batches) == 2
+    assert batches[0]["obs"].shape == (17, 2, 4)
+    import jax
+    w = jax.tree_util.tree_map(
+        lambda x: x * 0,
+        grp.manager.actor(0).get_weights.remote()
+        and ray_tpu.get(grp.manager.actor(0).get_weights.remote()))
+    grp.sync_weights(w)
+    got = ray_tpu.get(grp.manager.actor(1).get_weights.remote())
+    assert all(np.all(np.asarray(leaf) == 0)
+               for leaf in jax.tree_util.tree_leaves(got))
+    grp.stop()
+
+
+# ------------------------------------------------- learning regression
+@pytest.mark.slow
+def test_ppo_cartpole_learning_gate():
+    """Parity with reference rllib/tuned_examples/ppo/cartpole_ppo.py:
+    PPO must reach >=450 mean episode return on CartPole-v1."""
+    algo = PPOConfig().environment("CartPole-v1").training(
+        seed=0).build()
+    best = 0.0
+    for i in range(250):
+        m = algo.train()
+        r = m.get("episode_return_mean", float("nan"))
+        if r == r:
+            best = max(best, r)
+        if best >= 450:
+            break
+    algo.stop()
+    assert best >= 450, f"PPO failed to learn CartPole: best={best}"

@@ -135,7 +135,11 @@ class Scheduler:
             return None
         idx = getattr(spec, "placement_group_bundle_index", -1)
         if idx is not None and idx >= 0:
-            return (pg_id, idx)
+            # The bundle may have left this node (remove_placement_group /
+            # reschedule during the seconds-long worker spawn); returning
+            # the key unconditionally would KeyError in dispatch and kill
+            # the scheduler thread.
+            return (pg_id, idx) if (pg_id, idx) in self._bundles else None
         # index -1: any bundle of this pg on this node that fits.
         need = self.need_of(spec)
         for key, led in self._bundles.items():
@@ -165,6 +169,7 @@ class Scheduler:
             for spec in list(self._pending):
                 if isinstance(spec, TaskSpec) and spec.task_id == task_id:
                     self._pending.remove(spec)
+                    self._queued_at.pop(id(spec), None)
                     return spec
         return None
 
@@ -361,6 +366,10 @@ class Scheduler:
             return
         now = time.monotonic()
         for spec in list(self._pending):
+            # The lock is dropped around try_spill below, so a concurrent
+            # cancel_pending may have removed a later snapshot entry.
+            if spec not in self._pending:
+                continue
             if fits(self.avail, self._effective_need(spec)):
                 continue
             t0 = self._queued_at.get(id(spec))
@@ -409,9 +418,12 @@ class Scheduler:
 
     def _try_dispatch_locked(self) -> bool:
         for spec in list(self._pending):
+            if spec not in self._pending:  # removed while lock was dropped
+                continue
             need = self._effective_need(spec)
             pg_key = self._bundle_for(spec)
             if getattr(spec, "placement_group_id", None) and pg_key is None:
+                self._fail_if_pg_removed(spec)
                 continue                  # bundle not (yet) on this node
             pool = (self._bundles[pg_key]["avail"] if pg_key is not None
                     else self.avail)
@@ -451,6 +463,34 @@ class Scheduler:
                 worker.conn.send({"type": protocol.TASK, "spec": spec})
             return True
         return False
+
+    def _fail_if_pg_removed(self, spec) -> None:
+        """A queued spec whose placement group was removed can never run;
+        surface the error instead of parking it forever. Called with the
+        node lock held; the lock is DROPPED around the cluster query and
+        the runtime callback (cluster holds its lock while taking node
+        locks in scheduler_for_worker, so calling into it lock-held is an
+        ABBA deadlock)."""
+        if self._cluster is None:
+            return
+        pg_id = spec.placement_group_id
+        self._cv.release()
+        try:
+            pg = self._cluster.get_pg(pg_id)
+            removed = pg is None or pg.state == "REMOVED"
+        finally:
+            self._cv.acquire()
+        if not removed or spec not in self._pending:
+            return
+        self._pending.remove(spec)
+        self._queued_at.pop(id(spec), None)
+        reason = (f"placement group {pg_id} was removed before "
+                  f"{getattr(spec, 'name', spec)!r} could be scheduled")
+        self._cv.release()
+        try:
+            self._rt.on_unplaceable(spec, reason)
+        finally:
+            self._cv.acquire()
 
     # ---- actor task routing (bypasses the queue: direct to its worker) ----
     def send_actor_task(self, actor_worker_id: str,

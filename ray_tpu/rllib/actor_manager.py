@@ -26,16 +26,20 @@ from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
 logger = logging.getLogger(__name__)
 
 # Exception types that mean "the actor process is gone", as opposed to a
-# user-code error that leaves the actor healthy.
+# user-code error that leaves the actor healthy. A get() timeout is NOT
+# fatal: a slow-but-healthy actor (e.g. a long sample() under
+# timeout_seconds) must keep its health, matching the reference manager.
 _SYSTEM_ERRORS = (ActorDiedError, ActorUnavailableError, WorkerDiedError,
-                  ConnectionError, TimeoutError)
+                  ConnectionError)
 
 
 def _is_system_error(e: BaseException) -> bool:
     """Actor-death errors surface wrapped in TaskError at the get()
     site; classify by the CAUSE, not the wrapper (a user-code exception
     also arrives as a TaskError but leaves the actor healthy)."""
-    from ray_tpu.exceptions import TaskError
+    from ray_tpu.exceptions import GetTimeoutError, TaskError
+    if isinstance(e, GetTimeoutError):
+        return False
     if isinstance(e, TaskError):
         cause = e.cause
         return cause is not None and isinstance(cause, _SYSTEM_ERRORS)
@@ -190,21 +194,17 @@ class FaultTolerantActorManager:
         ready, _ = ray_tpu.wait(
             [r.ref for r in pending], num_returns=len(pending),
             timeout=timeout_seconds)
-        ready_set = {id(r) for r in ready}
-        # Map ready refs back to requests (identity on the ref object).
-        done = [r for r in pending
-                if any(r.ref.object_id == rr.object_id for rr in ready)]
-        del ready_set
+        ready_ids = {r.object_id for r in ready}
+        done = [r for r in pending if r.ref.object_id in ready_ids]
         results = RemoteCallResults()
         for req in done:
             self._in_flight.remove(req)
             try:
                 results.append(CallResult(
                     req.actor_id, True, ray_tpu.get(req.ref, timeout=0.1)))
-            except _SYSTEM_ERRORS as e:
-                self._mark_unhealthy(req.actor_id, e)
-                results.append(CallResult(req.actor_id, False, error=e))
-            except BaseException as e:  # user error: actor stays healthy
+            except BaseException as e:
+                if _is_system_error(e):
+                    self._mark_unhealthy(req.actor_id, e)
                 results.append(CallResult(req.actor_id, False, error=e))
         return results
 
@@ -264,7 +264,9 @@ class FaultTolerantActorManager:
             if hasattr(actor, "apply"):
                 return actor.apply.remote(fn_or_name, *args, **kwargs)
             return fn_or_name(actor, *args, **kwargs)
-        except _SYSTEM_ERRORS as e:
+        except BaseException as e:
+            if not _is_system_error(e):
+                raise
             self._mark_unhealthy(aid, e)
             return None
 
@@ -274,10 +276,9 @@ class FaultTolerantActorManager:
             try:
                 results.append(CallResult(
                     aid, True, ray_tpu.get(ref, timeout=timeout)))
-            except _SYSTEM_ERRORS as e:
-                self._mark_unhealthy(aid, e)
-                results.append(CallResult(aid, False, error=e))
             except BaseException as e:
+                if _is_system_error(e):
+                    self._mark_unhealthy(aid, e)
                 results.append(CallResult(aid, False, error=e))
         return results
 

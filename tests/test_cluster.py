@@ -161,6 +161,31 @@ def test_pg_unschedulable_raises(three_node_cluster):
         placement_group([{"CPU": 1}] * 5, strategy="STRICT_SPREAD")
 
 
+def test_pg_removed_while_task_queued_fails_fast(three_node_cluster):
+    """A task parked on a full PG bundle must fail (not hang forever)
+    when the PG is removed out from under it."""
+    @ray_tpu.remote(num_cpus=1)
+    def _sleeper(sec):
+        time.sleep(sec)
+        return "done"
+
+    @ray_tpu.remote(num_cpus=1)
+    def _queued():
+        return "ran"
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+    blocker = _sleeper.options(
+        placement_group=pg, placement_group_bundle_index=0).remote(20)
+    time.sleep(1.0)  # let the blocker occupy the bundle
+    ref = _queued.options(
+        placement_group=pg, placement_group_bundle_index=0).remote()
+    remove_placement_group(pg)
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=30)
+    del blocker
+
+
 def test_pg_reschedules_after_node_death(three_node_cluster):
     c, n2, _ = three_node_cluster
     pg = placement_group([{"CPU": 1}] * 2, strategy="SPREAD")

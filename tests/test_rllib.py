@@ -171,6 +171,58 @@ def test_actor_manager_detects_death_and_factory_restores(ray_cluster):
     assert sorted(res.values()) == [7, 7]
 
 
+def test_actor_manager_async_death_detection(ray_cluster):
+    """Death must also be detected on the ASYNC path
+    (foreach_actor_async -> fetch_ready_async_reqs), where errors arrive
+    wrapped in TaskError from get()."""
+    @ray_tpu.remote(max_restarts=0)
+    class Mortal:
+        def ping(self):
+            return "pong"
+
+        def die(self):
+            import os
+            os._exit(1)
+
+    def factory(idx):
+        return Mortal.remote()
+
+    mgr = FaultTolerantActorManager([Mortal.remote() for _ in range(2)],
+                                    actor_factory=factory)
+    n = mgr.foreach_actor_async("die", remote_actor_ids=[0], tag="d")
+    assert n == 1
+    import time
+    deadline = time.time() + 30
+    errors = []
+    while not errors and time.time() < deadline:
+        res = mgr.fetch_ready_async_reqs(timeout_seconds=1.0, tags=["d"])
+        errors += [r for r in res if not r.ok]
+    assert len(errors) == 1
+    assert mgr.num_healthy_actors == 1
+    restored = mgr.probe_unhealthy_actors()
+    assert restored == [0]
+    assert mgr.num_healthy_actors == 2
+
+
+def test_actor_manager_timeout_not_fatal(ray_cluster):
+    """A get() timeout from a slow-but-healthy actor must NOT mark it
+    unhealthy (reference manager treats timeouts as non-fatal)."""
+    @ray_tpu.remote
+    class Slow:
+        def ping(self):
+            return "pong"
+
+        def napcall(self):
+            import time
+            time.sleep(3.0)
+            return 1
+
+    mgr = FaultTolerantActorManager([Slow.remote()])
+    res = mgr.foreach_actor("napcall", timeout_seconds=0.2)
+    assert res.num_errors == 1
+    assert mgr.num_healthy_actors == 1
+
+
 # ----------------------------------------------------- env runner group
 def test_env_runner_group_remote_sampling(ray_cluster):
     grp = EnvRunnerGroup(

@@ -1,0 +1,19 @@
+"""ray_tpu.data: streaming datasets feeding TPU training.
+
+Parity target: reference python/ray/data/ (Dataset dataset.py:141,
+streaming executor _internal/execution/streaming_executor.py:48) — the
+subset SURVEY.md §7 step 7 calls for: read → map_batches → shuffle →
+iter_batches yielding sharded jax.Arrays, executed as bounded-window
+remote tasks over the ray_tpu runtime.
+"""
+from ray_tpu.data.block import Block, BlockMetadata
+from ray_tpu.data.dataset import (DataIterator, Dataset, from_items,
+                                  from_numpy, range, read_csv, read_json,
+                                  read_parquet)
+from ray_tpu.data.jax_iter import iter_jax_batches
+
+__all__ = [
+    "Block", "BlockMetadata", "DataIterator", "Dataset", "from_items",
+    "from_numpy", "range", "read_csv", "read_json", "read_parquet",
+    "iter_jax_batches",
+]

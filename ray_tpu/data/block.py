@@ -1,0 +1,112 @@
+"""Columnar block primitives for ray_tpu.data.
+
+A Block is a dict[str, np.ndarray] whose arrays share their first
+dimension (the row count). This is the TPU-era replacement for the
+reference's pyarrow Block (reference python/ray/data/block.py): token
+pipelines want contiguous numpy that `jax.device_put` can ship without
+a format hop, and pyarrow remains available at the datasource edge for
+parquet IO.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+Block = Dict[str, np.ndarray]
+
+
+def block_num_rows(block: Block) -> int:
+    if not block:
+        return 0
+    return len(next(iter(block.values())))
+
+
+def block_from_rows(rows: List[Dict[str, Any]]) -> Block:
+    """Rows (list of dicts) -> columnar block."""
+    if not rows:
+        return {}
+    cols: Dict[str, list] = {k: [] for k in rows[0]}
+    for r in rows:
+        for k in cols:
+            cols[k].append(r[k])
+    return {k: _to_array(v) for k, v in cols.items()}
+
+
+def _to_array(values: list) -> np.ndarray:
+    first = values[0]
+    if isinstance(first, np.ndarray):
+        try:
+            return np.stack(values)
+        except ValueError:          # ragged: keep as object array
+            out = np.empty(len(values), dtype=object)
+            for i, v in enumerate(values):
+                out[i] = v
+            return out
+    arr = np.asarray(values)
+    if arr.dtype.kind in ("U", "S"):
+        arr = arr.astype(object)
+    return arr
+
+
+def block_to_rows(block: Block) -> Iterable[Dict[str, Any]]:
+    n = block_num_rows(block)
+    keys = list(block)
+    for i in range(n):
+        yield {k: block[k][i] for k in keys}
+
+
+def block_slice(block: Block, start: int, stop: int) -> Block:
+    return {k: v[start:stop] for k, v in block.items()}
+
+
+def block_take(block: Block, indices: np.ndarray) -> Block:
+    return {k: v[indices] for k, v in block.items()}
+
+
+def block_concat(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if block_num_rows(b)]
+    if not blocks:
+        return {}
+    if len(blocks) == 1:
+        return blocks[0]
+    keys = list(blocks[0])
+    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+def validate_block(block: Block) -> None:
+    lengths = {k: len(v) for k, v in block.items()}
+    if len(set(lengths.values())) > 1:
+        raise ValueError(f"ragged block: column lengths {lengths}")
+
+
+def normalize_batch_output(out: Any) -> Block:
+    """map_batches user fns may return dict of arrays/lists."""
+    if not isinstance(out, dict):
+        raise TypeError(
+            f"map_batches fn must return a dict of columns, got "
+            f"{type(out).__name__}")
+    block = {k: (v if isinstance(v, np.ndarray) else _to_array(list(v)))
+             for k, v in out.items()}
+    validate_block(block)
+    return block
+
+
+class BlockMetadata:
+    """Size/row accounting carried with each block (reference
+    data/block.py BlockMetadata, trimmed to what the executor uses)."""
+
+    __slots__ = ("num_rows", "size_bytes", "input_files")
+
+    def __init__(self, num_rows: int, size_bytes: int,
+                 input_files: Optional[List[str]] = None):
+        self.num_rows = num_rows
+        self.size_bytes = size_bytes
+        self.input_files = input_files or []
+
+    @staticmethod
+    def of(block: Block,
+           input_files: Optional[List[str]] = None) -> "BlockMetadata":
+        size = sum(v.nbytes if isinstance(v, np.ndarray) else 0
+                   for v in block.values())
+        return BlockMetadata(block_num_rows(block), size, input_files)

@@ -1,0 +1,193 @@
+"""Datasources: file/range/items readers producing ReadTasks.
+
+Parity: reference python/ray/data/_internal/datasource/ (parquet, json,
+csv readers) + read_api.py — re-shaped for the columnar numpy Block.
+Each ReadTask is a picklable zero-arg callable returning an iterator of
+Blocks, so the streaming executor can run it inside a ray_tpu task on
+any worker.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, block_from_rows, block_slice
+
+ReadFn = Callable[[], Iterator[Block]]
+
+
+class ReadTask:
+    """One unit of parallel read work."""
+
+    def __init__(self, fn: ReadFn, name: str,
+                 input_files: Optional[List[str]] = None):
+        self._fn = fn
+        self.name = name
+        self.input_files = input_files or []
+
+    def __call__(self) -> Iterator[Block]:
+        return self._fn()
+
+    def __repr__(self) -> str:
+        return f"ReadTask({self.name})"
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+# --------------------------------------------------------------- range
+def range_tasks(n: int, num_blocks: int) -> List[ReadTask]:
+    num_blocks = max(1, min(num_blocks, n) if n else 1)
+    sizes = [n // num_blocks + (1 if i < n % num_blocks else 0)
+             for i in range(num_blocks)]
+    tasks, start = [], 0
+    for i, sz in enumerate(sizes):
+        lo, hi = start, start + sz
+        start = hi
+
+        def fn(lo=lo, hi=hi) -> Iterator[Block]:
+            yield {"id": np.arange(lo, hi, dtype=np.int64)}
+
+        tasks.append(ReadTask(fn, f"range[{lo}:{hi}]"))
+    return tasks
+
+
+# --------------------------------------------------------------- items
+def items_tasks(items: List[Any], num_blocks: int) -> List[ReadTask]:
+    n = len(items)
+    num_blocks = max(1, min(num_blocks, n) if n else 1)
+    sizes = [n // num_blocks + (1 if i < n % num_blocks else 0)
+             for i in range(num_blocks)]
+    tasks, start = [], 0
+    for sz in sizes:
+        chunk = items[start:start + sz]
+        start += sz
+
+        def fn(chunk=chunk) -> Iterator[Block]:
+            rows = [r if isinstance(r, dict) else {"item": r}
+                    for r in chunk]
+            yield block_from_rows(rows)
+
+        tasks.append(ReadTask(fn, f"items[{sz}]"))
+    return tasks
+
+
+# --------------------------------------------------------------- jsonl
+def jsonl_tasks(paths, rows_per_block: int = 4096) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def read_one(path: str) -> Iterator[Block]:
+        rows: List[Dict[str, Any]] = []
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rows.append(json.loads(line))
+                if len(rows) >= rows_per_block:
+                    yield block_from_rows(rows)
+                    rows = []
+        if rows:
+            yield block_from_rows(rows)
+
+    return [ReadTask(lambda p=p: read_one(p), f"jsonl[{os.path.basename(p)}]",
+                     [p]) for p in files]
+
+
+# ------------------------------------------------------------- parquet
+def parquet_tasks(paths, columns: Optional[List[str]] = None,
+                  rows_per_block: int = 65536) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def read_one(path: str) -> Iterator[Block]:
+        import pyarrow.parquet as pq
+        pf = pq.ParquetFile(path)
+        for batch in pf.iter_batches(batch_size=rows_per_block,
+                                     columns=columns):
+            block: Block = {}
+            for name, col in zip(batch.schema.names, batch.columns):
+                arr = col.to_numpy(zero_copy_only=False)
+                if arr.dtype.kind in ("U", "S"):
+                    arr = arr.astype(object)
+                block[name] = arr
+            yield block
+
+    return [ReadTask(lambda p=p: read_one(p),
+                     f"parquet[{os.path.basename(p)}]", [p])
+            for p in files]
+
+
+# ----------------------------------------------------------------- csv
+def csv_tasks(paths, rows_per_block: int = 65536) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def read_one(path: str) -> Iterator[Block]:
+        import pyarrow.csv as pacsv
+        table = pacsv.read_csv(path)
+        n = table.num_rows
+        cols = {name: table.column(name).to_numpy(zero_copy_only=False)
+                for name in table.schema.names}
+        block = {k: (v.astype(object) if v.dtype.kind in ("U", "S") else v)
+                 for k, v in cols.items()}
+        for lo in range(0, n, rows_per_block):
+            yield block_slice(block, lo, min(lo + rows_per_block, n))
+
+    return [ReadTask(lambda p=p: read_one(p),
+                     f"csv[{os.path.basename(p)}]", [p]) for p in files]
+
+
+# ----------------------------------------------------------- write side
+def write_jsonl(blocks: Iterator[Block], path: str) -> List[str]:
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, "part-00000.jsonl")
+    from ray_tpu.data.block import block_to_rows
+    with open(out, "w", encoding="utf-8") as f:
+        for block in blocks:
+            for row in block_to_rows(block):
+                f.write(json.dumps({k: _json_safe(v)
+                                    for k, v in row.items()}) + "\n")
+    return [out]
+
+
+def write_parquet(blocks: Iterator[Block], path: str) -> List[str]:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, "part-00000.parquet")
+    tables = []
+    for block in blocks:
+        tables.append(pa.table(
+            {k: pa.array(list(v)) for k, v in block.items()}))
+    if tables:
+        pq.write_table(pa.concat_tables(tables), out)
+    return [out]
+
+
+def _json_safe(v):
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v

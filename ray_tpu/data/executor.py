@@ -1,0 +1,156 @@
+"""Streaming executor: pull blocks through the op chain with bounded
+in-flight work.
+
+Parity: reference data/_internal/execution/streaming_executor.py:48 —
+re-shaped for ray_tpu: instead of an operator-graph thread juggling
+actor pools, each ReadTask (+ its whole op chain) becomes ONE remote
+task; the driver keeps a bounded window of them in flight and yields
+blocks in task order. Backpressure falls out of the window bound: no
+more than `max_in_flight` read partitions are ever materialized beyond
+what the consumer has taken. Falls back to a local thread when the
+runtime is not initialized (pure-local datasets in tests/tools).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, List, Optional, Tuple
+
+from ray_tpu.data.block import (Block, block_concat, block_num_rows,
+                                block_slice, normalize_batch_output)
+from ray_tpu.data.datasource import ReadTask
+
+# op tuples: ("map_batches", fn, batch_size) | ("map", fn) |
+#            ("filter", fn) | ("flat_map", fn)
+Op = Tuple[Any, ...]
+
+
+def apply_ops(blocks: Iterator[Block], ops: List[Op]) -> Iterator[Block]:
+    for op in ops:
+        kind = op[0]
+        if kind == "map_batches":
+            blocks = _apply_map_batches(blocks, op[1], op[2])
+        elif kind == "map":
+            blocks = _apply_map(blocks, op[1])
+        elif kind == "filter":
+            blocks = _apply_filter(blocks, op[1])
+        elif kind == "flat_map":
+            blocks = _apply_flat_map(blocks, op[1])
+        else:  # pragma: no cover - guarded at Dataset level
+            raise ValueError(f"unknown op {kind}")
+    return blocks
+
+
+def _apply_map_batches(blocks, fn, batch_size) -> Iterator[Block]:
+    if batch_size is None:
+        for b in blocks:
+            if block_num_rows(b):
+                yield normalize_batch_output(fn(b))
+        return
+    buf: List[Block] = []
+    have = 0
+    for b in blocks:
+        n = block_num_rows(b)
+        if not n:
+            continue
+        buf.append(b)
+        have += n
+        while have >= batch_size:
+            merged = block_concat(buf)
+            batch = block_slice(merged, 0, batch_size)
+            rest = block_slice(merged, batch_size, have)
+            yield normalize_batch_output(fn(batch))
+            buf = [rest] if block_num_rows(rest) else []
+            have = block_num_rows(rest)
+    if have:
+        yield normalize_batch_output(fn(block_concat(buf)))
+
+
+def _apply_map(blocks, fn) -> Iterator[Block]:
+    from ray_tpu.data.block import block_from_rows, block_to_rows
+    for b in blocks:
+        rows = [fn(r) for r in block_to_rows(b)]
+        if rows:
+            yield block_from_rows(rows)
+
+
+def _apply_filter(blocks, fn) -> Iterator[Block]:
+    import numpy as np
+
+    from ray_tpu.data.block import block_take, block_to_rows
+    for b in blocks:
+        keep = np.asarray([bool(fn(r)) for r in block_to_rows(b)])
+        if keep.any():
+            yield block_take(b, np.nonzero(keep)[0])
+
+
+def _apply_flat_map(blocks, fn) -> Iterator[Block]:
+    from ray_tpu.data.block import block_from_rows, block_to_rows
+    for b in blocks:
+        rows = []
+        for r in block_to_rows(b):
+            rows.extend(fn(r))
+        if rows:
+            yield block_from_rows(rows)
+
+
+def _run_partition(task: ReadTask, ops: List[Op]) -> List[Block]:
+    """Executed inside a ray_tpu worker: read + transform one partition."""
+    return [b for b in apply_ops(task(), ops) if block_num_rows(b)]
+
+
+def stream_blocks(tasks: List[ReadTask], ops: List[Op],
+                  max_in_flight: int = 4,
+                  locality: Optional[str] = None) -> Iterator[Block]:
+    """Yield blocks across all partitions, in partition order."""
+    if not tasks:
+        return
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        yield from _stream_local(tasks, ops)
+        return
+
+    remote_fn = ray_tpu.remote(num_cpus=1)(_run_partition)
+    opts = {}
+    if locality:
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        opts["scheduling_strategy"] = NodeAffinitySchedulingStrategy(
+            node_id=locality, soft=True)
+        remote_fn = remote_fn.options(**opts)
+
+    window: List[Any] = []
+    next_submit = 0
+    while next_submit < len(tasks) or window:
+        while next_submit < len(tasks) and len(window) < max_in_flight:
+            window.append(remote_fn.remote(tasks[next_submit], ops))
+            next_submit += 1
+        blocks = ray_tpu.get(window.pop(0))
+        for b in blocks:
+            yield b
+
+
+def _stream_local(tasks: List[ReadTask], ops: List[Op]) -> Iterator[Block]:
+    """Single background thread reads ahead one partition."""
+    q: "queue.Queue" = queue.Queue(maxsize=2)
+    SENTINEL = object()
+
+    def producer():
+        try:
+            for t in tasks:
+                for b in apply_ops(t(), ops):
+                    if block_num_rows(b):
+                        q.put(b)
+            q.put(SENTINEL)
+        except BaseException as e:  # surface in consumer
+            q.put(e)
+
+    th = threading.Thread(target=producer, daemon=True)
+    th.start()
+    while True:
+        item = q.get()
+        if item is SENTINEL:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item

@@ -1,0 +1,93 @@
+"""Host→device batch feeding: sharded jax.Arrays with prefetch.
+
+The Train ingestion edge (reference data/iterator.py iter_torch_batches
+analogue, TPU-shaped): numpy batches stream off the Dataset while the
+PREVIOUS batch's `jax.device_put` transfer overlaps the current step —
+a two-deep pipeline so input never serializes with compute unless the
+pipeline genuinely underruns (tracked in `stats()`).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+class _Prefetcher:
+    """Bounded background producer of host batches."""
+
+    def __init__(self, it: Iterator[Dict[str, np.ndarray]], depth: int):
+        import queue
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._sentinel = object()
+        self.wait_s = 0.0
+
+        def run():
+            try:
+                for item in it:
+                    self._q.put(item)
+                self._q.put(self._sentinel)
+            except BaseException as e:
+                self._q.put(e)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        while True:
+            t0 = time.perf_counter()
+            item = self._q.get()
+            self.wait_s += time.perf_counter() - t0
+            if item is self._sentinel:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+
+def iter_jax_batches(dataset, *, batch_size: int,
+                     sharding=None,
+                     dtypes: Optional[Dict[str, str]] = None,
+                     drop_last: bool = True,
+                     local_shuffle_buffer_size: int = 0,
+                     seed: Optional[int] = None,
+                     prefetch_depth: int = 2,
+                     stats: Optional[dict] = None):
+    """Yield dict[str, jax.Array] batches.
+
+    `sharding`: a jax.sharding.Sharding (e.g. NamedSharding(mesh,
+    P("dp"))) applied on device_put — the per-host batch lands already
+    laid out for the train step, no resharding inside jit.
+    """
+    import jax
+
+    host_iter = dataset.iter_batches(
+        batch_size=batch_size, drop_last=drop_last,
+        local_shuffle_buffer_size=local_shuffle_buffer_size, seed=seed)
+    pf = _Prefetcher(host_iter, prefetch_depth)
+
+    def put(batch: Dict[str, np.ndarray]):
+        out = {}
+        for k, v in batch.items():
+            if dtypes and k in dtypes:
+                v = v.astype(dtypes[k])
+            out[k] = (jax.device_put(v, sharding) if sharding is not None
+                      else jax.device_put(v))
+        return out
+
+    pending = None
+    n = 0
+    for batch in pf:
+        nxt = put(batch)            # start async transfer
+        if pending is not None:
+            yield pending
+            n += 1
+        pending = nxt
+    if pending is not None:
+        yield pending
+        n += 1
+    if stats is not None:
+        stats["num_batches"] = n
+        stats["input_wait_s"] = pf.wait_s

@@ -1,11 +1,16 @@
-"""Flash attention: Pallas TPU kernel (forward) + blockwise JAX backward.
+"""Flash attention: Pallas TPU kernels, forward AND backward.
 
 The hot op of the model zoo. Forward is an online-softmax kernel that
 streams K/V blocks through VMEM on a (batch, head, q-block, k-block)
 grid — O(seq) memory, MXU-shaped matmuls, causal blocks above the
-diagonal skipped. Backward is the standard flash recomputation written
-as a `lax.scan` over K blocks in plain JAX (XLA pipelines it well); a
-Pallas backward kernel is a later optimisation.
+diagonal skipped. Backward is two Pallas kernels sharing the flash
+recomputation: a dK/dV kernel on a (b, h, k-block, q-block) grid and a
+dQ kernel on (b, h, q-block, k-block), both computing scores in the
+TRANSPOSED (block_k, block_q) orientation so the per-row stats (lse,
+delta) broadcast along sublanes — the cheap direction — instead of
+needing lane-expanded copies; dQ is produced as (b, h, d, s) and
+transposed once by XLA. A blockwise lax.scan backward is kept as the
+cross-check/fallback path (`_flash_bwd_xla`).
 
 Layout: (batch, num_heads, seq, head_dim). GQA supported: K/V may have
 fewer heads (num_kv_heads must divide num_heads) — the kernel maps query
@@ -168,8 +173,229 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     return out, lse[:, :, 0, :]
 
 
-# ------------------------------------------------------------- backward
-def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_k):
+# ---------------------------------------------------- backward (pallas)
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, *,
+                           sm_scale: float, causal: bool,
+                           block_q: int, block_k: int, seq_q: int):
+    j = pl.program_id(2)           # k block (parallel)
+    i = pl.program_id(3)           # q block (inner scan)
+    nq = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # Causal: k block j only sees q blocks whose max q index reaches it.
+    run = (not causal) or (i * block_q + block_q - 1 >= j * block_k)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        if seq_q % block_q:
+            # q/do padding rows hold garbage and are CONTRACTED into
+            # dk/dv below — zero them (p=0 does not neutralise NaN).
+            qrows = i * block_q + lax.broadcasted_iota(
+                jnp.int32, q.shape, 0)
+            q = jnp.where(qrows < seq_q, q, 0)
+            do = jnp.where(qrows < seq_q, do, 0)
+        lse = lse_ref[0, 0, 0:1, :]            # (1, block_q) f32
+        dlt = dlt_ref[0, 0, 0:1, :]            # (1, block_q) f32
+        # Transposed scores: rows = k positions, cols = q positions.
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # (bk, bq)
+        rows = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 0)
+        cols = i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1)
+        valid = None
+        if causal:
+            valid = rows <= cols
+        if seq_q % block_q:
+            vq = cols < seq_q                  # q-tail: garbage columns
+            valid = vq if valid is None else (valid & vq)
+        pt = jnp.exp(st - lse)                 # (bk, bq)
+        if valid is not None:
+            pt = jnp.where(valid, pt, 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+        dpt = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, bq)
+        dst = pt * (dpt - dlt) * sm_scale
+        if valid is not None:                  # kill 0*inf NaNs from tails
+            dst = jnp.where(valid, dst, 0.0)
+        dk_acc[:] += jax.lax.dot_general(
+            dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+
+    @pl.when(i == nq - 1)
+    def _final():
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                         dqt_ref, dqt_acc, *,
+                         sm_scale: float, causal: bool,
+                         block_q: int, block_k: int, seq_k: int):
+    i = pl.program_id(2)           # q block (parallel)
+    j = pl.program_id(3)           # k block (inner scan)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dqt_acc[:] = jnp.zeros_like(dqt_acc)
+
+    run = (not causal) or (j * block_k <= i * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        if seq_k % block_k:
+            # k padding rows are contracted into dq — zero the garbage.
+            krows = j * block_k + lax.broadcasted_iota(
+                jnp.int32, k.shape, 0)
+            k = jnp.where(krows < seq_k, k, 0)
+        lse = lse_ref[0, 0, 0:1, :]
+        dlt = dlt_ref[0, 0, 0:1, :]
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # (bk, bq)
+        rows = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 0)
+        cols = i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1)
+        valid = None
+        if causal:
+            valid = rows <= cols
+        if seq_k % block_k:
+            vk = rows < seq_k                  # k-tail: garbage rows feed
+            valid = vk if valid is None else (valid & vk)  # the contraction
+        pt = jnp.exp(st - lse)
+        if valid is not None:
+            pt = jnp.where(valid, pt, 0.0)
+        dpt = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, bq)
+        dst = pt * (dpt - dlt) * sm_scale
+        if valid is not None:
+            dst = jnp.where(valid, dst, 0.0)
+        # dq^T accumulation: (d, bq) = k^T (d, bk) @ ds^T (bk, bq).
+        dqt_acc[:] += jax.lax.dot_general(
+            k, dst.astype(k.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _final():
+        dqt_ref[0, 0, :, :] = dqt_acc[:].astype(dqt_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale,
+                      block_q, block_k, interpret):
+    """Full Pallas backward: returns (dq, dk, dv)."""
+    b, h, sq, d = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    group = h // kvh
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                  # (b, h, sq)
+    # Sublane-broadcast stats layout (b, h, 8, sq): tiles (8, block_q)
+    # satisfy Mosaic's (8, 128) rule; kernels read row 0 as (1, block_q).
+    lse8 = jnp.broadcast_to(lse[:, :, None, :], (b, h, 8, sq))
+    dlt8 = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, sq))
+
+    # -------- dk/dv: grid (b, h, k-block, q-block), q innermost --------
+    dkdv_out_dtype = jnp.float32 if group > 1 else k.dtype
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_q=sq),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, j, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, j, i: (b_, h_ // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, j, i: (b_, h_ // group, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, j, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b_, h_, j, i: (b_, h_, 0, i)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b_, h_, j, i: (b_, h_, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, j, i: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, j, i: (b_, h_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), dkdv_out_dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), dkdv_out_dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse8, dlt8)
+    if group > 1:
+        dk = dk.reshape(b, kvh, group, sk, d).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(b, kvh, group, sk, d).sum(axis=2).astype(v.dtype)
+
+    # -------- dq: grid (b, h, q-block, k-block), k innermost -----------
+    dqt = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_k=sk),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b_, h_, i, j: (b_, h_, 0, i)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b_, h_, i, j: (b_, h_, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d, block_q),
+                               lambda b_, h_, i, j: (b_, h_, 0, i)),
+        out_shape=jax.ShapeDtypeStruct((b, h, d, sq), q.dtype),
+        scratch_shapes=[pltpu.VMEM((d, block_q), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse8, dlt8)
+    dq = dqt.swapaxes(2, 3)                    # one XLA transpose
+    return dq, dk, dv
+
+
+# ------------------------------------------------ backward (xla check)
+def _flash_bwd_xla(q, k, v, o, lse, do, causal, sm_scale, block_k):
     """Blockwise flash backward: scan over K blocks; O(seq·block) memory."""
     b, h, sq, d = q.shape
     kvh, sk = k.shape[1], k.shape[2]
@@ -248,7 +474,8 @@ def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
     do, _g_lse = g  # lse cotangent dropped by design (see _flash docstring)
     q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, do, causal, sm_scale, block_k)
+    return _flash_bwd_pallas(q, k, v, out, lse, do, causal, sm_scale,
+                             block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)

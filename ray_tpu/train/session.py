@@ -55,9 +55,11 @@ class TrainContext:
 class _TrainSession:
     def __init__(self, fn: Callable, config: Dict[str, Any],
                  context: TrainContext,
-                 restore_checkpoint: Optional[Checkpoint]):
+                 restore_checkpoint: Optional[Checkpoint],
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.context = context
         self.restore_checkpoint = restore_checkpoint
+        self.dataset_shards = dataset_shards or {}
         self._fn = fn
         self._config = config
         self._results: "queue.Queue" = queue.Queue(maxsize=1)
@@ -126,6 +128,20 @@ def get_checkpoint() -> Optional[Checkpoint]:
     if _session is None:
         return None
     return _session.restore_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a dataset passed to JaxTrainer(datasets=)
+    as a DataIterator (reference train.get_dataset_shard)."""
+    if _session is None or name not in _session.dataset_shards:
+        raise KeyError(
+            f"no dataset shard {name!r}: pass datasets={{{name!r}: ds}} "
+            f"to JaxTrainer")
+    shard = _session.dataset_shards[name]
+    from ray_tpu.data.dataset import DataIterator, Dataset
+    if isinstance(shard, Dataset):
+        return DataIterator(shard)
+    return shard
 
 
 def make_temp_checkpoint_dir() -> str:

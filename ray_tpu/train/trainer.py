@@ -43,9 +43,11 @@ class JaxTrainer:
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  backend_config: Optional[BackendConfig] = None,
-                 resume_from_checkpoint: Optional[Checkpoint] = None):
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
         self._fn = train_loop_per_worker
         self._config = dict(train_loop_config or {})
+        self._datasets = dict(datasets or {})
         self._scaling = scaling_config or ScalingConfig()
         self._run_config = run_config or RunConfig()
         self._backend_config = backend_config or JaxConfig()
@@ -82,10 +84,11 @@ class JaxTrainer:
                 backend.on_start(group, self._backend_config)
                 fn_bytes = cloudpickle.dumps(self._fn)
                 restore_path = restore.path if restore else None
+                shard_bytes = self._dataset_shards(group.num_workers)
                 ray_tpu.get([
                     w.init_session.remote(fn_bytes, self._config,
-                                          restore_path)
-                    for w in group.workers])
+                                          restore_path, shard_bytes[i])
+                    for i, w in enumerate(group.workers)])
                 backend.on_training_start(group, self._backend_config)
                 last_metrics = self._training_loop(
                     group, manager, metrics_history)
@@ -114,6 +117,21 @@ class JaxTrainer:
                       path=exp_dir,
                       metrics_history=metrics_history,
                       error=error)
+
+    # ------------------------------------------------- dataset sharding
+    def _dataset_shards(self, n: int) -> list:
+        """Split every dataset into one shard per worker (reference
+        data_parallel_trainer streaming_split). Datasets with fewer
+        partitions than workers are repartitioned first."""
+        if not self._datasets:
+            return [None] * n
+        per_worker: list = [dict() for _ in range(n)]
+        for name, dset in self._datasets.items():
+            if dset.num_partitions() < n:
+                dset = dset.repartition(n)
+            for rank, shard in enumerate(dset.split(n)):
+                per_worker[rank][name] = shard
+        return [cloudpickle.dumps(s) for s in per_worker]
 
     # ---------------------------------------------------- driver loop
     def _training_loop(self, group: WorkerGroup,

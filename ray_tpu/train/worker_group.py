@@ -46,13 +46,17 @@ class RayTrainWorker:
 
     # --------------------------------------------------------- training
     def init_session(self, fn_bytes: bytes, config: Dict[str, Any],
-                     restore_path: Optional[str]) -> None:
+                     restore_path: Optional[str],
+                     datasets_bytes: Optional[bytes] = None) -> None:
         fn = cloudpickle.loads(fn_bytes)
         ctx = TrainContext(
             world_rank=self._rank, world_size=self._world_size,
             local_rank=0, local_world_size=1, node_rank=self._rank)
         restore = Checkpoint(restore_path) if restore_path else None
-        self._session = _TrainSession(fn, config, ctx, restore)
+        shards = (cloudpickle.loads(datasets_bytes)
+                  if datasets_bytes else None)
+        self._session = _TrainSession(fn, config, ctx, restore,
+                                      dataset_shards=shards)
         self._session.start()
 
     def next_result(self):

@@ -46,11 +46,14 @@ def main():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
+        # Tuned on-chip (tools/bench_sweep.py): 1024-block flash kernels,
+        # no remat (activations fit HBM at this batch), unchunked loss.
         cfg = TransformerConfig(
             vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
-            n_kv_heads=16, d_ff=5632, max_seq_len=2048, remat=True,
-            dtype="bfloat16", param_dtype="bfloat16", loss_chunk=512)
-        batch, seq, steps = 8, 2048, 10
+            n_kv_heads=16, d_ff=5632, max_seq_len=2048, remat=False,
+            dtype="bfloat16", param_dtype="bfloat16", loss_chunk=0,
+            attn_block_q=1024, attn_block_k=1024)
+        batch, seq, steps = 2, 2048, 20
     else:  # smoke mode off-TPU
         from ray_tpu.models.config import tiny
         cfg = tiny()

@@ -432,12 +432,21 @@ class Scheduler:
             worker = self._pick_worker()
             if worker is None:
                 blocked = sum(1 for r in self._workers.values()
-                              if r.blocked_depth > 0 and r.state != DEAD)
+                              if r.blocked_depth > 0
+                              and r.state not in (DEAD, ACTOR))
+                # The max_workers soft cap governs the REUSABLE task-worker
+                # pool only. Workers pinned by live actors are dedicated
+                # processes outside the cap (reference worker_pool.cc keeps
+                # its soft limit for returnable workers; actor workers are
+                # started on demand) — otherwise long-lived actors starve
+                # task/actor dispatch permanently.
+                pool_count = sum(1 for r in self._workers.values()
+                                 if r.state not in (DEAD, ACTOR))
                 # Spawn only for unmet demand: never more in-flight spawns
                 # than pending work items (raylet WorkerPool prestart logic,
                 # worker_pool.cc PrestartWorkers, is demand-capped the same
                 # way).
-                if (self._alive_count() - blocked < self._max_workers
+                if (pool_count - blocked < self._max_workers
                         and self._spawning < min(len(self._pending), 4)):
                     self._cv.release()
                     try:

@@ -162,10 +162,6 @@ class Dataset:
     def materialize(self) -> "Dataset":
         """Execute now; the result is a Dataset over in-memory blocks."""
         blocks = list(self.iter_blocks())
-
-        def fn(blocks=blocks) -> Iterator[Block]:
-            yield from blocks
-
         # one task per materialized block keeps split() usable
         tasks = []
         for i, blk in enumerate(blocks):

@@ -27,6 +27,10 @@ class TransformerConfig:
     dtype: str = "bfloat16"               # activation/compute dtype
     param_dtype: str = "float32"
     remat: bool = True                    # checkpoint each layer in scan
+    # "full": recompute everything in bwd (min HBM). "save_attn": save
+    # flash-attention out+lse across the checkpoint so the fwd kernel is
+    # not re-run in bwd (~(b,s,d_model) bf16 + (b,h,s) f32 per layer).
+    remat_policy: str = "full"
     use_ring_attention: bool = False      # seq-parallel attention (sp axis)
     attn_block_q: int = 128
     attn_block_k: int = 128

@@ -116,6 +116,15 @@ class Transformer:
         if (c.use_ring_attention and self.mesh is not None
                 and self.mesh.shape.get("sp", 1) > 1):
             return ring_attention_sharded(q, k, v, self.mesh, causal=True)
+        if c.remat and c.remat_policy == "save_attn":
+            from ray_tpu.ops.attention import flash_attention_saveable
+            from ray_tpu.ops.dispatch import on_tpu
+            if on_tpu():
+                return flash_attention_saveable(
+                    q, k, v, causal=True, block_q=c.attn_block_q,
+                    block_k=c.attn_block_k)
+            # off-TPU the einsum fallback has no kernel to spare; plain
+            # path keeps CPU tests exercising the same math.
         return flash_attention(q, k, v, causal=True,
                                block_q=c.attn_block_q,
                                block_k=c.attn_block_k)
@@ -184,7 +193,11 @@ class Transformer:
         if c.remat:
             # prevent_cse=False: scan's loop structure already blocks the
             # CSE hazard; keeping it True inserts unfusable barriers.
-            body = jax.checkpoint(body, prevent_cse=False)
+            policy = None
+            if c.remat_policy == "save_attn":
+                from ray_tpu.ops.attention import attn_remat_policy
+                policy = attn_remat_policy()
+            body = jax.checkpoint(body, prevent_cse=False, policy=policy)
         x, _ = lax.scan(body, x, params["layers"])
         return rms_norm(x, params["final_norm"], c.norm_eps)
 

@@ -511,3 +511,80 @@ def flash_attention_kernel(q, k, v, causal=True, sm_scale=None,
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     return _flash(q, k, v, causal, sm_scale, block_q, block_k,
                   not _on_tpu())[0]
+
+
+# --------------------------------------- remat-saveable attention path
+#
+# Under per-layer `jax.checkpoint`, a custom_vjp flash kernel reruns its
+# forward during the backward pass to rebuild residuals — the kernel
+# executes twice per step. This path splits the op so the residuals
+# (out, lse) are *named public values* a checkpoint policy can save:
+#
+#   out, lse = fwd kernel        (no AD; pruned from recompute when saved)
+#   out, lse = checkpoint_name(...)
+#   return _attn_from_saved(q, k, v, stop_grad(out), stop_grad(lse))
+#
+# `_attn_from_saved` is the only differentiable op: its VJP runs the
+# Pallas backward straight from the saved residuals. Cotangents for
+# out/lse die at stop_gradient, so the forward kernel is never
+# differentiated or (with `save_only_these_names("attn_out","attn_lse")`)
+# re-executed. q/k/v are still rematerialised by the layer recompute —
+# that is three cheap matmuls + rope, not the attention kernel.
+
+ATTN_RESIDUAL_NAMES = ("attn_out", "attn_lse")
+
+
+def attn_remat_policy():
+    """Checkpoint policy saving exactly the flash-attention residuals."""
+    return jax.checkpoint_policies.save_only_these_names(
+        *ATTN_RESIDUAL_NAMES)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _attn_from_saved(q, k, v, out, lse, causal, sm_scale, block_q,
+                     block_k, interpret):
+    return out
+
+
+def _afs_fwd(q, k, v, out, lse, causal, sm_scale, block_q, block_k,
+             interpret):
+    return out, (q, k, v, out, lse)
+
+
+def _afs_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, do, causal,
+                                   sm_scale, block_q, block_k, interpret)
+    # out/lse arrive through stop_gradient: their cotangents are dropped
+    # symbolically, these zeros never materialise.
+    return dq, dk, dv, jnp.zeros_like(out), jnp.zeros_like(lse)
+
+
+_attn_from_saved.defvjp(_afs_fwd, _afs_bwd)
+
+
+def flash_attention_saveable(q: jax.Array, k: jax.Array, v: jax.Array,
+                             causal: bool = True,
+                             sm_scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None) -> jax.Array:
+    """Flash attention whose residuals survive `jax.checkpoint` when the
+    wrapping policy is `attn_remat_policy()` (see block comment above).
+    Semantically identical to `flash_attention`; use inside rematted
+    layer bodies."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        from ray_tpu.ops.dispatch import on_tpu as _on_tpu
+        interpret = not _on_tpu()
+    from jax.ad_checkpoint import checkpoint_name
+    # Run the forward kernel on gradient-stopped inputs: pallas_call has
+    # no JVP rule, and the only differentiable route is _attn_from_saved.
+    out, lse = _flash_fwd(lax.stop_gradient(q), lax.stop_gradient(k),
+                          lax.stop_gradient(v), causal, sm_scale,
+                          block_q, block_k, interpret)
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
+    return _attn_from_saved(q, k, v, lax.stop_gradient(out),
+                            lax.stop_gradient(lse), causal, sm_scale,
+                            block_q, block_k, interpret)

@@ -239,6 +239,17 @@ class FaultTolerantActorManager:
                                        aid, e)
         return restored
 
+    def clear(self) -> None:
+        """Kill every managed actor and forget the fleet (reference
+        manager's clear()). Groups call this from their stop()."""
+        for st in self._states.values():
+            try:
+                ray_tpu.kill(st.actor)
+            except BaseException:
+                pass
+        self._states.clear()
+        self._in_flight.clear()
+
     def set_actor_state(self, actor_id: int, healthy: bool) -> None:
         self._states[actor_id].healthy = healthy
 

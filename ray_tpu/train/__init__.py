@@ -19,6 +19,7 @@ from ray_tpu.train.checkpoint import (Checkpoint, CheckpointManager,  # noqa: F4
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig,  # noqa: F401
                                   Result, RunConfig, ScalingConfig)
 from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
+                                   get_dataset_shard,
                                    make_temp_checkpoint_dir, report)
 from ray_tpu.train.trainer import JaxTrainer  # noqa: F401
 from ray_tpu.train.worker_group import RayTrainWorker, WorkerGroup  # noqa: F401

@@ -123,6 +123,36 @@ def test_flash_gqa_backward():
                                    atol=1e-3, rtol=1e-3)
 
 
+def test_flash_saveable_grads_and_remat_policy():
+    """The remat-saveable path (named out/lse residuals) must produce the
+    same gradients as the reference, standalone and under jax.checkpoint
+    with attn_remat_policy (the bench's save_attn configuration)."""
+    from ray_tpu.ops.attention import (attn_remat_policy,
+                                       flash_attention_saveable)
+    b, h, s, d = 1, 2, 128, 32
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.float32)
+
+    g_ref = jax.grad(lambda *a: jnp.sum(mha_reference(*a, causal=True) ** 2),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_sv = jax.grad(lambda *a: jnp.sum(flash_attention_saveable(
+        *a, causal=True, block_q=64, block_k=64, interpret=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    rematted = jax.checkpoint(
+        lambda *a: flash_attention_saveable(
+            *a, causal=True, block_q=64, block_k=64, interpret=True),
+        policy=attn_remat_policy())
+    g_rm = jax.grad(lambda *a: jnp.sum(rematted(*a) ** 2),
+                    argnums=(0, 1, 2))(q, k, v)
+    for a, b_, c in zip(g_ref, g_sv, g_rm):
+        np.testing.assert_allclose(np.asarray(b_), np.asarray(a),
+                                   atol=1e-3, rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                                   atol=1e-3, rtol=1e-3)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_reference(causal):
     b, h, s, d = 1, 2, 64, 16

@@ -119,6 +119,7 @@ def test_actor_manager_sync_and_user_errors(ray_cluster):
     assert sorted(res.values()) == [0, 20]
     # User error does NOT mark the actor unhealthy.
     assert mgr.num_healthy_actors == 3
+    mgr.clear()
 
 
 def test_actor_manager_async_fetch(ray_cluster):
@@ -140,6 +141,7 @@ def test_actor_manager_async_fetch(ray_cluster):
         got += mgr.fetch_ready_async_reqs(timeout_seconds=1.0,
                                           tags=["t"]).values()
     assert sorted(got) == [42, 42]
+    mgr.clear()
 
 
 def test_actor_manager_detects_death_and_factory_restores(ray_cluster):
@@ -169,6 +171,7 @@ def test_actor_manager_detects_death_and_factory_restores(ray_cluster):
     assert mgr.num_healthy_actors == 2
     res = mgr.foreach_actor("val")
     assert sorted(res.values()) == [7, 7]
+    mgr.clear()
 
 
 def test_actor_manager_async_death_detection(ray_cluster):
@@ -202,6 +205,7 @@ def test_actor_manager_async_death_detection(ray_cluster):
     restored = mgr.probe_unhealthy_actors()
     assert restored == [0]
     assert mgr.num_healthy_actors == 2
+    mgr.clear()
 
 
 def test_actor_manager_timeout_not_fatal(ray_cluster):
@@ -221,6 +225,7 @@ def test_actor_manager_timeout_not_fatal(ray_cluster):
     res = mgr.foreach_actor("napcall", timeout_seconds=0.2)
     assert res.num_errors == 1
     assert mgr.num_healthy_actors == 1
+    mgr.clear()
 
 
 # ----------------------------------------------------- env runner group

@@ -23,26 +23,41 @@ def block_num_rows(block: Block) -> int:
 
 
 def block_from_rows(rows: List[Dict[str, Any]]) -> Block:
-    """Rows (list of dicts) -> columnar block."""
+    """Rows (list of dicts) -> columnar block.
+
+    Rows may have heterogeneous key sets (optional JSONL fields are the
+    norm): columns are the UNION of keys, absent values become None (the
+    column is then object-dtyped), mirroring the reference's null-filling
+    pyarrow conversion."""
     if not rows:
         return {}
-    cols: Dict[str, list] = {k: [] for k in rows[0]}
+    keys: List[str] = []
+    seen = set()
     for r in rows:
-        for k in cols:
-            cols[k].append(r[k])
+        for k in r:
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
+    cols: Dict[str, list] = {
+        k: [r.get(k) for r in rows] for k in keys}
     return {k: _to_array(v) for k, v in cols.items()}
 
 
 def _to_array(values: list) -> np.ndarray:
+    def _object_array():
+        out = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            out[i] = v
+        return out
+
+    if any(v is None for v in values):   # nullable column
+        return _object_array()
     first = values[0]
     if isinstance(first, np.ndarray):
         try:
             return np.stack(values)
         except ValueError:          # ragged: keep as object array
-            out = np.empty(len(values), dtype=object)
-            for i, v in enumerate(values):
-                out[i] = v
-            return out
+            return _object_array()
     arr = np.asarray(values)
     if arr.dtype.kind in ("U", "S"):
         arr = arr.astype(object)
@@ -65,13 +80,59 @@ def block_take(block: Block, indices: np.ndarray) -> Block:
 
 
 def block_concat(blocks: List[Block]) -> Block:
+    """Concatenate blocks row-wise. Key sets may differ between blocks
+    (a nullable column can be absent from a whole chunk): columns are
+    the union, absent stretches are None-filled object columns —
+    consistent with block_from_rows' row-level semantics."""
     blocks = [b for b in blocks if block_num_rows(b)]
     if not blocks:
         return {}
     if len(blocks) == 1:
         return blocks[0]
-    keys = list(blocks[0])
-    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    keys: List[str] = []
+    seen = set()
+    for b in blocks:
+        for k in b:
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
+
+    def col(b: Block, k: str) -> np.ndarray:
+        if k in b:
+            return b[k]
+        filler = np.empty(block_num_rows(b), dtype=object)
+        filler[:] = None
+        return filler
+
+    out: Block = {}
+    for k in keys:
+        cols = [col(b, k) for b in blocks]
+        if any(c.dtype == object for c in cols):
+            cols = [c.astype(object) for c in cols]
+        out[k] = np.concatenate(cols)
+    return out
+
+
+def rebatch_blocks(blocks: Iterable[Block], batch_size: int,
+                   drop_last: bool = False) -> Iterable[Block]:
+    """Re-chunk a block stream into fixed-size row batches (the shared
+    engine behind Dataset.iter_batches and map_batches(batch_size=...))."""
+    buf: List[Block] = []
+    have = 0
+    for b in blocks:
+        n = block_num_rows(b)
+        if not n:
+            continue
+        buf.append(b)
+        have += n
+        while have >= batch_size:
+            merged = block_concat(buf)
+            yield block_slice(merged, 0, batch_size)
+            rest = block_slice(merged, batch_size, have)
+            have = block_num_rows(rest)
+            buf = [rest] if have else []
+    if have and not drop_last:
+        yield block_concat(buf)
 
 
 def validate_block(block: Block) -> None:

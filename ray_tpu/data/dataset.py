@@ -88,7 +88,7 @@ class Dataset:
                 f"cannot split {len(self._tasks)} partitions into {n} "
                 f"shards; re-read with override_num_blocks>={n}")
         return [Dataset(self._tasks[i::n], list(self._ops),
-                        self._max_in_flight) for i in range(n)]
+                        self._max_in_flight) for i in _irange(n)]
 
     def repartition(self, n: int) -> "Dataset":
         """Materialize and re-block into exactly n row-range partitions
@@ -127,23 +127,12 @@ class Dataset:
         """Stream fixed-size row batches; optional streaming shuffle via
         a reservoir buffer (reference iter_batches
         local_shuffle_buffer_size semantics)."""
+        from ray_tpu.data.block import rebatch_blocks
         blocks = self.iter_blocks()
         if local_shuffle_buffer_size:
             blocks = _shuffle_blocks(blocks, local_shuffle_buffer_size,
                                      seed)
-        buf: List[Block] = []
-        have = 0
-        for b in blocks:
-            buf.append(b)
-            have += block_num_rows(b)
-            while have >= batch_size:
-                merged = block_concat(buf)
-                yield block_slice(merged, 0, batch_size)
-                rest = block_slice(merged, batch_size, have)
-                have = block_num_rows(rest)
-                buf = [rest] if have else []
-        if have and not drop_last:
-            yield block_concat(buf)
+        yield from rebatch_blocks(blocks, batch_size, drop_last=drop_last)
 
     def take(self, n: int = 20) -> List[Dict[str, Any]]:
         return list(itertools.islice(self.iter_rows(), n))

@@ -47,23 +47,9 @@ def _apply_map_batches(blocks, fn, batch_size) -> Iterator[Block]:
             if block_num_rows(b):
                 yield normalize_batch_output(fn(b))
         return
-    buf: List[Block] = []
-    have = 0
-    for b in blocks:
-        n = block_num_rows(b)
-        if not n:
-            continue
-        buf.append(b)
-        have += n
-        while have >= batch_size:
-            merged = block_concat(buf)
-            batch = block_slice(merged, 0, batch_size)
-            rest = block_slice(merged, batch_size, have)
-            yield normalize_batch_output(fn(batch))
-            buf = [rest] if block_num_rows(rest) else []
-            have = block_num_rows(rest)
-    if have:
-        yield normalize_batch_output(fn(block_concat(buf)))
+    from ray_tpu.data.block import rebatch_blocks
+    for batch in rebatch_blocks(blocks, batch_size):
+        yield normalize_batch_output(fn(batch))
 
 
 def _apply_map(blocks, fn) -> Iterator[Block]:
@@ -131,26 +117,42 @@ def stream_blocks(tasks: List[ReadTask], ops: List[Op],
 
 
 def _stream_local(tasks: List[ReadTask], ops: List[Op]) -> Iterator[Block]:
-    """Single background thread reads ahead one partition."""
+    """Single background thread reads ahead one partition. The producer
+    polls a closed flag on every put so an abandoned consumer (generator
+    GC'd mid-stream) retires the thread instead of stranding it."""
     q: "queue.Queue" = queue.Queue(maxsize=2)
     SENTINEL = object()
+    closed = threading.Event()
+
+    def _put(item) -> bool:
+        while not closed.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer():
         try:
             for t in tasks:
                 for b in apply_ops(t(), ops):
                     if block_num_rows(b):
-                        q.put(b)
-            q.put(SENTINEL)
+                        if not _put(b):
+                            return
+            _put(SENTINEL)
         except BaseException as e:  # surface in consumer
-            q.put(e)
+            _put(e)
 
     th = threading.Thread(target=producer, daemon=True)
     th.start()
-    while True:
-        item = q.get()
-        if item is SENTINEL:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is SENTINEL:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        closed.set()

@@ -16,24 +16,43 @@ import numpy as np
 
 
 class _Prefetcher:
-    """Bounded background producer of host batches."""
+    """Bounded background producer of host batches.
+
+    `close()` unblocks and retires the producer thread when the consumer
+    abandons the iterator early (the common `zip(range(steps), it)` loop)
+    — without it the thread would sit in q.put forever, pinning batches."""
 
     def __init__(self, it: Iterator[Dict[str, np.ndarray]], depth: int):
         import queue
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._sentinel = object()
+        self._closed = threading.Event()
         self.wait_s = 0.0
 
         def run():
             try:
                 for item in it:
-                    self._q.put(item)
-                self._q.put(self._sentinel)
+                    if not self._put(item):
+                        return
+                self._put(self._sentinel)
             except BaseException as e:
-                self._q.put(e)
+                self._put(e)
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
+
+    def _put(self, item) -> bool:
+        import queue
+        while not self._closed.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def close(self) -> None:
+        self._closed.set()
 
     def __iter__(self):
         while True:
@@ -79,15 +98,20 @@ def iter_jax_batches(dataset, *, batch_size: int,
 
     pending = None
     n = 0
-    for batch in pf:
-        nxt = put(batch)            # start async transfer
+    try:
+        for batch in pf:
+            nxt = put(batch)        # start async transfer
+            if pending is not None:
+                yield pending
+                n += 1
+            pending = nxt
         if pending is not None:
             yield pending
             n += 1
-        pending = nxt
-    if pending is not None:
-        yield pending
-        n += 1
-    if stats is not None:
-        stats["num_batches"] = n
-        stats["input_wait_s"] = pf.wait_s
+    finally:
+        # runs on normal exhaustion AND GeneratorExit when the consumer
+        # abandons the loop early — either way the producer must die.
+        pf.close()
+        if stats is not None:
+            stats["num_batches"] = n
+            stats["input_wait_s"] = pf.wait_s

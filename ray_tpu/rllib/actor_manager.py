@@ -267,6 +267,15 @@ class FaultTolerantActorManager:
     def _in_flight_count(self, actor_id: int) -> int:
         return sum(1 for r in self._in_flight if r.actor_id == actor_id)
 
+    def num_in_flight(self, actor_id: Optional[int] = None,
+                      tag: Optional[str] = None) -> int:
+        """Outstanding async requests, filterable by actor and tag
+        (drivers of perpetual-sampling loops use this to keep every
+        actor saturated, e.g. IMPALA's pump)."""
+        return sum(1 for r in self._in_flight
+                   if (actor_id is None or r.actor_id == actor_id)
+                   and (tag is None or r.tag == tag))
+
     def _submit(self, aid: int, fn_or_name, args, kwargs):
         actor = self._states[aid].actor
         try:

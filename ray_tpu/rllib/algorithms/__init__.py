@@ -1,3 +1,8 @@
+from ray_tpu.rllib.algorithms.impala import (IMPALA, IMPALAConfig,
+                                             IMPALALearner,
+                                             IMPALALearnerConfig,
+                                             vtrace_returns)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 
-__all__ = ["PPO", "PPOConfig"]
+__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "IMPALALearner",
+           "IMPALALearnerConfig", "vtrace_returns"]

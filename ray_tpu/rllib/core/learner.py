@@ -44,6 +44,11 @@ class PPOLearnerConfig:
     num_minibatches: int = 4
     target_kl: float = 0.03   # stop epoch/minibatch SGD when exceeded
     seed: int = 0
+    # Data-parallel width INSIDE the learner: the batch's env axis is
+    # sharded over a `dp` mesh of this many local devices and XLA
+    # inserts the gradient psum — the TPU-native form of the reference's
+    # k-GPU DDP learners (torch_learner.py:566). 1 = single device.
+    num_devices: int = 1
 
 
 class PPOLearner:
@@ -64,6 +69,8 @@ class PPOLearner:
     def __init__(self, config: PPOLearnerConfig,
                  module: Optional[ActorCriticModule] = None,
                  mesh=None):
+        from ray_tpu._private.jaxenv import pin_platform_from_env
+        pin_platform_from_env()
         self.config = config
         self.module = module or ActorCriticModule(
             config.obs_dim, config.num_actions, tuple(config.hidden))
@@ -75,7 +82,35 @@ class PPOLearner:
         self._perm_key, init_key = jax.random.split(key)
         self.params = self.module.init(init_key)
         self.opt_state = self._tx.init(self.params)
-        self._update_fn = jax.jit(self._build_update())
+        if config.num_devices > 1 and mesh is None:
+            from jax.sharding import Mesh
+            devs = jax.devices()
+            if len(devs) < config.num_devices:
+                raise ValueError(
+                    f"num_devices={config.num_devices} but only "
+                    f"{len(devs)} local devices visible")
+            self.mesh = Mesh(
+                np.array(devs[:config.num_devices]), ("dp",))
+        if self.mesh is not None and "dp" in self.mesh.shape:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            mesh = self.mesh
+
+            def shard_for(name):
+                # time-major (T, N, ...) leaves shard the env axis
+                return NamedSharding(
+                    mesh, P(*((None, "dp") if name != "obs"
+                              else (None, "dp", None))))
+            repl = NamedSharding(mesh, P())
+            self._update_fn = jax.jit(
+                self._build_update(),
+                in_shardings=(repl, repl,
+                              {k: shard_for(k) for k in
+                               ("obs", "actions", "logp", "rewards",
+                                "terminateds", "dones", "mask")},
+                              repl),
+                out_shardings=(repl, repl, repl))
+        else:
+            self._update_fn = jax.jit(self._build_update())
         self._timer = {"updates": 0, "update_time": 0.0,
                        "minibatches": 0, "transitions": 0}
 
@@ -238,19 +273,32 @@ class PPOLearner:
 
 
 class LearnerGroup:
-    """One or more PPOLearner actors behind FaultTolerantActorManager.
+    """The learner scaling unit.
 
-    num_learners=0 runs the learner in-process (the reference's local
-    mode, learner_group.py:152 — right default for a single host where
-    the learner already owns every local TPU chip via pjit; remote
-    learners exist for scale-out across hosts)."""
+    The reference scales learners by adding DDP-wrapped GPU processes
+    (learner_group.py:152-167, torch_learner.py:566). On TPU the same
+    scaling is a WIDER MESH, not more processes: `num_learners=k` runs
+    ONE learner whose update shards the batch's env axis over a k-device
+    `dp` mesh — XLA inserts the gradient psum exactly where DDP would
+    allreduce, with bitwise-stable single-program semantics instead of
+    k redundant replicas. `remote=True` hosts that learner in an actor
+    (off the driver); cross-host learner scale-out rides
+    jax.distributed (ray_tpu.train.JaxBackend), where the same dp mesh
+    simply spans hosts.
+
+    num_learners=0 -> local single-device learner (reference local mode).
+    """
 
     def __init__(self, config: PPOLearnerConfig, num_learners: int = 0,
-                 num_cpus_per_learner: float = 1.0):
+                 num_cpus_per_learner: float = 1.0,
+                 remote: Optional[bool] = None):
+        if num_learners > 0:
+            config = dataclasses.replace(config, num_devices=num_learners)
         self.config = config
+        self._remote = (remote if remote is not None else num_learners > 0)
         self._local: Optional[PPOLearner] = None
         self._manager = None
-        if num_learners == 0:
+        if not self._remote:
             self._local = PPOLearner(config)
         else:
             import ray_tpu
@@ -258,55 +306,53 @@ class LearnerGroup:
 
             remote_cls = ray_tpu.remote(
                 num_cpus=num_cpus_per_learner)(PPOLearner)
-            actors = [remote_cls.remote(config)
-                      for _ in range(num_learners)]
-            self._manager = FaultTolerantActorManager(actors)
+            self._manager = FaultTolerantActorManager(
+                [remote_cls.remote(config)])
 
     @property
     def is_local(self) -> bool:
         return self._local is not None
 
+    def _call(self, name, *args):
+        results = self._manager.foreach_actor(name, args=args)
+        ok = results.values()
+        if not ok:
+            raise RuntimeError(f"learner call {name} failed: "
+                               f"{[r.error for r in results]}")
+        return ok[0]
+
     def update(self, batch) -> Dict[str, float]:
         if self._local is not None:
             return self._local.update(batch)
-        results = self._manager.foreach_actor("update", args=(batch,))
-        ok = results.values()
-        if not ok:
-            raise RuntimeError("all learners failed the update")
-        return ok[0]
+        return self._call("update", batch)
 
     def get_weights(self) -> Params:
         if self._local is not None:
             return self._local.get_weights()
-        return self._manager.foreach_actor("get_weights").values()[0]
+        return self._call("get_weights")
 
     def set_weights(self, weights: Params) -> None:
         if self._local is not None:
             self._local.set_weights(weights)
         else:
-            self._manager.foreach_actor("set_weights", args=(weights,))
+            self._call("set_weights", weights)
 
     def get_state(self):
         if self._local is not None:
             return self._local.get_state()
-        return self._manager.foreach_actor("get_state").values()[0]
+        return self._call("get_state")
 
     def set_state(self, state) -> None:
         if self._local is not None:
             self._local.set_state(state)
         else:
-            self._manager.foreach_actor("set_state", args=(state,))
+            self._call("set_state", state)
 
     def sgd_throughput(self) -> Dict[str, float]:
         if self._local is not None:
             return self._local.sgd_throughput()
-        return self._manager.foreach_actor("sgd_throughput").values()[0]
+        return self._call("sgd_throughput")
 
     def shutdown(self) -> None:
         if self._manager is not None:
-            import ray_tpu
-            for actor in self._manager.actors().values():
-                try:
-                    ray_tpu.kill(actor)
-                except BaseException:
-                    pass
+            self._manager.clear()

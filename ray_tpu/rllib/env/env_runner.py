@@ -35,6 +35,8 @@ class SingleAgentEnvRunner:
     """Owns a gym.vector env + policy params; `sample()` one rollout."""
 
     def __init__(self, config: EnvRunnerConfig, worker_index: int = 0):
+        from ray_tpu._private.jaxenv import pin_platform_from_env
+        pin_platform_from_env()
         import gymnasium as gym
 
         self.config = config

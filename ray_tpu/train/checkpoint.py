@@ -2,16 +2,29 @@
 
 Parity: reference train/_checkpoint.py (directory-handle Checkpoint),
 train/_internal/checkpoint_manager.py:80-108 (num_to_keep retention).
-Model/optimizer pytrees are stored via orbax when available, else a
-numpy+pickle fallback with identical layout, so checkpoints work in
-minimal environments and tests.
+
+Two storage engines:
+- "npz" (default): pickled treedef + flat npz of leaves. Round-trips
+  ARBITRARY pytrees (optax NamedTuple states included) with no restore
+  target needed.
+- "orbax": orbax.checkpoint PyTreeCheckpointer (async save available).
+  Orbax cannot rebuild custom treedefs without a `target`, so pass one
+  to `load_pytree` when restoring non-dict trees saved this way.
+Select via `engine=` or the RAY_TPU_CKPT_ENGINE env var.
+
+Checkpoint DIRECTORIES move between hosts as tar bytes (`pack_dir` /
+`unpack_dir`) through the object store — the trainer never assumes a
+shared filesystem (reference ships files via storage_path upload,
+train/_internal/storage.py:104; our transport is the object plane).
 """
 from __future__ import annotations
 
+import io
 import json
 import os
 import pickle
 import shutil
+import tarfile
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -59,26 +72,78 @@ class Checkpoint:
 
 def _encode_leaf(leaf) -> Tuple[np.ndarray, Optional[str]]:
     """npz only round-trips builtin numpy dtypes; ml_dtypes leaves
-    (bfloat16, fp8, ...) are stored as raw bytes + a dtype tag."""
+    (bfloat16, fp8, ...) are stored as raw bytes + a dtype tag. 0-d
+    arrays can't be viewed as uint8 directly — they ride as (1,) with a
+    `!0d` tag suffix."""
     a = np.asarray(leaf)
-    if a.dtype.isbuiltin:
+    if a.dtype.isbuiltin == 1:   # ml_dtypes register as 2 ("user w/ slots")
         return a, None
-    return a.view(np.uint8).reshape(a.shape + (a.dtype.itemsize,)), \
-        str(a.dtype)
+    tag = str(a.dtype)
+    if a.ndim == 0:
+        a = a.reshape(1)
+        tag += "!0d"
+    return a.view(np.uint8).reshape(a.shape + (a.dtype.itemsize,)), tag
 
 
 def _decode_leaf(a: np.ndarray, dtype_tag: Optional[str]) -> np.ndarray:
     if dtype_tag is None:
         return a
     import ml_dtypes  # ships with jax
+    scalar = dtype_tag.endswith("!0d")
+    if scalar:
+        dtype_tag = dtype_tag[:-3]
     dt = np.dtype(getattr(ml_dtypes, dtype_tag))
-    return a.reshape(a.shape[:-1] + (-1,)).view(dt).reshape(a.shape[:-1])
+    out = a.reshape(a.shape[:-1] + (-1,)).view(dt).reshape(a.shape[:-1])
+    return out.reshape(()) if scalar else out
 
 
-def save_pytree(tree: Any, path: str) -> None:
-    """Structure via pickle of treedef + flat npz of leaves."""
-    import jax
+def _engine(engine: Optional[str]) -> str:
+    return engine or os.environ.get("RAY_TPU_CKPT_ENGINE", "npz")
+
+
+# path -> in-flight orbax AsyncCheckpointer (see save_pytree)
+_ASYNC_CKPTRS: Dict[str, Any] = {}
+
+
+def save_pytree(tree: Any, path: str, engine: Optional[str] = None,
+                async_save: bool = False):
+    """Persist a pytree under `path` with the chosen engine.
+
+    engine="npz" (default): treedef pickle + npz leaves, any treedef.
+    engine="orbax": orbax PyTreeCheckpointer; with async_save=True
+    returns an orbax future-like handle (call .wait() or let the next
+    save barrier), else None.
+    """
+    eng = _engine(engine)
     os.makedirs(path, exist_ok=True)
+    if eng == "orbax":
+        import orbax.checkpoint as ocp
+        target = os.path.join(path, "orbax")
+        # One AsyncCheckpointer per path, reused: re-saving a path first
+        # barriers on the in-flight save, so rmtree can never tear a
+        # write that is still running.
+        prev = _ASYNC_CKPTRS.pop(path, None)
+        if prev is not None:
+            prev.wait_until_finished()
+        if os.path.exists(target):
+            shutil.rmtree(target)
+        marker = os.path.join(path, "engine")
+        if async_save:
+            ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+            ckptr.save(target, args=ocp.args.PyTreeSave(tree))
+            _ASYNC_CKPTRS[path] = ckptr
+            with open(marker, "w") as f:
+                f.write(eng)
+            return ckptr           # .wait_until_finished() before reading
+        ocp.PyTreeCheckpointer().save(target, tree)
+        with open(marker, "w") as f:
+            f.write(eng)
+        return None
+    with open(os.path.join(path, "engine"), "w") as f:
+        f.write(eng)
+    if eng != "npz":
+        raise ValueError(f"unknown checkpoint engine {eng!r}")
+    import jax
     leaves, treedef = jax.tree.flatten(
         jax.tree.map(lambda x: np.asarray(x), tree))
     encoded, tags = [], []
@@ -90,10 +155,26 @@ def save_pytree(tree: Any, path: str) -> None:
              **{f"leaf_{i}": leaf for i, leaf in enumerate(encoded)})
     with open(os.path.join(path, "treedef.pkl"), "wb") as f:
         pickle.dump((treedef, tags), f)
+    return None
 
 
-def load_pytree(path: str) -> Any:
+def load_pytree(path: str, target: Any = None) -> Any:
+    """Load a pytree saved by `save_pytree`. `target` (an example tree)
+    is only needed to rebuild custom treedefs from orbax-engine saves."""
     import jax
+    marker = os.path.join(path, "engine")
+    eng = "npz"
+    if os.path.exists(marker):
+        with open(marker) as f:
+            eng = f.read().strip()
+    if eng == "orbax":
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(os.path.join(path, "orbax"))
+        if target is None:
+            return restored
+        return jax.tree.unflatten(
+            jax.tree.structure(target), jax.tree.leaves(restored))
     with open(os.path.join(path, "treedef.pkl"), "rb") as f:
         meta = pickle.load(f)
     treedef, tags = meta if isinstance(meta, tuple) else (meta, None)
@@ -102,6 +183,23 @@ def load_pytree(path: str) -> Any:
     if tags is not None:
         leaves = [_decode_leaf(a, t) for a, t in zip(leaves, tags)]
     return jax.tree.unflatten(treedef, leaves)
+
+
+# -------------------------------------------------- dir <-> bytes
+def pack_dir(path: str) -> bytes:
+    """Tar a checkpoint directory into bytes (the cross-host transport:
+    worker -> object store -> driver storage; no shared fs assumed)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        tar.add(path, arcname=".")
+    return buf.getvalue()
+
+
+def unpack_dir(data: bytes, dest: str) -> str:
+    os.makedirs(dest, exist_ok=True)
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r") as tar:
+        tar.extractall(dest, filter="data")
+    return dest
 
 
 class CheckpointManager:
@@ -120,7 +218,9 @@ class CheckpointManager:
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Optional[Dict] = None) -> Checkpoint:
-        """Move the checkpoint under management and apply retention."""
+        """Move the checkpoint under management and apply retention.
+        Only valid when `checkpoint.path` is on THIS host's filesystem;
+        remote workers ship bytes via `register_bytes`."""
         metrics = metrics or {}
         self._counter += 1
         dest = os.path.join(self.root, f"checkpoint_{self._counter:06d}")
@@ -128,11 +228,25 @@ class CheckpointManager:
             if os.path.exists(dest):
                 shutil.rmtree(dest)
             shutil.move(checkpoint.path, dest)
-        managed = Checkpoint(dest)
+        return self._register_dest(dest, metrics)
+
+    def register_bytes(self, data: bytes,
+                       metrics: Optional[Dict] = None) -> Checkpoint:
+        """Unpack a worker-shipped checkpoint tarball under management
+        (the no-shared-filesystem path)."""
+        metrics = metrics or {}
+        self._counter += 1
+        dest = os.path.join(self.root, f"checkpoint_{self._counter:06d}")
+        if os.path.exists(dest):
+            shutil.rmtree(dest)
+        unpack_dir(data, dest)
+        return self._register_dest(dest, metrics)
+
+    def _register_dest(self, dest: str, metrics: Dict) -> Checkpoint:
         score = self._score(metrics)
         self._registered.append((score, self._counter, dest, metrics))
         self._apply_retention()
-        return managed
+        return Checkpoint(dest)
 
     def _score(self, metrics: Dict) -> float:
         if self.score_attribute and self.score_attribute in metrics:

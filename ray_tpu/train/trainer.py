@@ -83,11 +83,16 @@ class JaxTrainer:
                 group.start()
                 backend.on_start(group, self._backend_config)
                 fn_bytes = cloudpickle.dumps(self._fn)
-                restore_path = restore.path if restore else None
+                # restore ships as tar bytes (workers may not share the
+                # driver's filesystem)
+                restore_bytes = None
+                if restore is not None:
+                    from ray_tpu.train.checkpoint import pack_dir
+                    restore_bytes = pack_dir(restore.path)
                 shard_bytes = self._dataset_shards(group.num_workers)
                 ray_tpu.get([
                     w.init_session.remote(fn_bytes, self._config,
-                                          restore_path, shard_bytes[i])
+                                          restore_bytes, shard_bytes[i])
                     for i, w in enumerate(group.workers)])
                 backend.on_training_start(group, self._backend_config)
                 last_metrics = self._training_loop(
@@ -150,7 +155,7 @@ class JaxTrainer:
                 refs, timeout=self._run_config.worker_poll_timeout)
             idx = 0
             round_metrics: Optional[Dict[str, Any]] = None
-            round_ckpt: Optional[str] = None
+            round_ckpt: Optional[bytes] = None
             for i in range(group.num_workers):
                 if done[i]:
                     continue
@@ -159,18 +164,15 @@ class JaxTrainer:
                 if item is None:
                     done[i] = True
                     continue
-                metrics, ckpt_path = item
+                metrics, ckpt_bytes = item
                 if i == 0:
                     round_metrics = metrics
-                    round_ckpt = ckpt_path
-                elif ckpt_path:
-                    # only rank 0's checkpoint is registered; clean up
-                    # other ranks' temp dirs so /tmp doesn't grow
-                    import shutil
-                    shutil.rmtree(ckpt_path, ignore_errors=True)
+                    round_ckpt = ckpt_bytes
+                # rank>0 checkpoints: workers already reclaimed their own
+                # temp dirs host-side; nothing to do driver-side.
             if round_metrics is not None:
                 metrics_history.append(round_metrics)
                 last = round_metrics
                 if round_ckpt is not None:
-                    manager.register(Checkpoint(round_ckpt), round_metrics)
+                    manager.register_bytes(round_ckpt, round_metrics)
         return last

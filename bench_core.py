@@ -1,0 +1,115 @@
+"""Core-runtime microbenchmarks: named timed scenarios.
+
+Parity: reference python/ray/_private/ray_perf.py:120-274 (tasks/s,
+actor calls/s, put/get ops/s, put GB/s, wait on many refs) — the
+scalability-envelope numbers SURVEY.md §4.5(e) requires in-repo.
+Run: `python bench_core.py [--json]`; results land in ENVELOPE.md via
+tools/update_envelope.py or the --json line.
+
+Numbers are for THIS host (the CI box is 1 CPU core; worker spawns are
+~2s each) — they are envelope shapes, not cluster limits.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def timed(fn, n: int, *, unit: str = "ops") -> dict:
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    return {"n": n, "seconds": round(dt, 4),
+            "per_second": round(n / dt, 1), "unit": unit}
+
+
+def main(as_json: bool = False) -> dict:
+    import ray_tpu
+    ray_tpu.init(num_cpus=4)
+    results: dict = {}
+
+    # -------------------------------------------------- tasks / second
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(10)])        # warm pool
+    N = 200
+    results["tasks_sync_per_s"] = timed(
+        lambda: [ray_tpu.get(nop.remote()) for _ in range(N)], N)
+    results["tasks_batch_per_s"] = timed(
+        lambda: ray_tpu.get([nop.remote() for _ in range(N)]), N)
+
+    # -------------------------------------------- actor calls / second
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+    results["actor_calls_sync_per_s"] = timed(
+        lambda: [ray_tpu.get(a.ping.remote()) for _ in range(N)], N)
+    results["actor_calls_async_per_s"] = timed(
+        lambda: ray_tpu.get([a.ping.remote() for _ in range(N)]), N)
+
+    # --------------------------------------------------- object plane
+    small = np.arange(16)
+    results["put_small_per_s"] = timed(
+        lambda: [ray_tpu.put(small) for _ in range(N)], N)
+    big = np.zeros(8 * 1024 * 1024 // 8)                  # 8 MB
+    M = 40
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(big) for _ in range(M)]
+    dt = time.perf_counter() - t0
+    results["put_gbps"] = {"n": M, "seconds": round(dt, 4),
+                           "per_second": round(M * 8 / 1024 / dt, 3),
+                           "unit": "GB"}
+    t0 = time.perf_counter()
+    for r in refs:
+        ray_tpu.get(r)
+    dt = time.perf_counter() - t0
+    results["get_gbps"] = {"n": M, "seconds": round(dt, 4),
+                           "per_second": round(M * 8 / 1024 / dt, 3),
+                           "unit": "GB"}
+
+    # -------------------------------------------------- wait semantics
+    K = 1000
+    refs = [nop.remote() for _ in range(K)]
+    t0 = time.perf_counter()
+    remaining = refs
+    while remaining:
+        done, remaining = ray_tpu.wait(
+            remaining, num_returns=min(100, len(remaining)), timeout=30)
+    dt = time.perf_counter() - t0
+    results["wait_1k_refs"] = {"n": K, "seconds": round(dt, 4),
+                               "per_second": round(K / dt, 1),
+                               "unit": "refs"}
+
+    # ------------------------------------------- many queued tasks
+    K = 5000
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(K)]
+    dt_submit = time.perf_counter() - t0
+    ray_tpu.get(refs, timeout=300)
+    dt_total = time.perf_counter() - t0
+    results["queue_5k_tasks"] = {
+        "n": K, "seconds": round(dt_total, 4),
+        "submit_per_second": round(K / dt_submit, 1),
+        "per_second": round(K / dt_total, 1), "unit": "tasks"}
+
+    ray_tpu.shutdown()
+    if as_json:
+        print(json.dumps(results))
+    else:
+        for name, r in results.items():
+            print(f"{name:28s} {r['per_second']:>12} {r['unit']}/s "
+                  f"(n={r['n']}, {r['seconds']}s)")
+    return results
+
+
+if __name__ == "__main__":
+    main(as_json="--json" in sys.argv)

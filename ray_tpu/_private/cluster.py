@@ -36,7 +36,7 @@ PG_CREATED = "CREATED"
 PG_REMOVED = "REMOVED"
 PG_RESCHEDULING = "RESCHEDULING"
 
-HEARTBEAT_TIMEOUT_S = 3.0
+from ray_tpu._private.config import CONFIG as _CFG
 _HYBRID_THRESHOLD = 0.5
 
 
@@ -118,6 +118,12 @@ class ClusterTaskManager:
     def alive_nodes(self) -> List[NodeRecord]:
         with self._lock:
             return [n for n in self._nodes.values() if n.alive]
+
+    def alive_node_count(self) -> int:
+        """LOCK-FREE alive-node count (single atomic dict scan): safe to
+        call while holding a node lock, where taking the cluster lock
+        would ABBA-deadlock against cluster->node lock paths."""
+        return sum(1 for n in list(self._nodes.values()) if n.alive)
 
     def get_node(self, node_id: str) -> Optional[NodeRecord]:
         with self._lock:
@@ -476,7 +482,7 @@ class ClusterTaskManager:
             with self._lock:
                 for n in self._nodes.values():
                     if (n.alive and
-                            now - n.last_heartbeat > HEARTBEAT_TIMEOUT_S):
+                            now - n.last_heartbeat > _CFG.heartbeat_timeout_s):
                         dead.append(n.node_id)
             for nid in dead:
                 self._on_node_death(nid, cause="heartbeat timeout")

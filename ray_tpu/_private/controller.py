@@ -56,7 +56,10 @@ class NodeTableRecord:
 
 
 class Controller:
-    def __init__(self, task_event_capacity: int = 10000):
+    def __init__(self, task_event_capacity: Optional[int] = None):
+        if task_event_capacity is None:
+            from ray_tpu._private.config import CONFIG as _CFG
+            task_event_capacity = _CFG.task_event_history
         self._lock = threading.RLock()
         self._kv: dict[tuple[str, str], Any] = {}
         self._actors: dict[str, ActorRecord] = {}
@@ -132,6 +135,12 @@ class Controller:
     def refcount(self, object_id: str) -> int:
         with self._lock:
             return self._refcounts.get(object_id, 0)
+
+    def pinned_ids(self) -> list[str]:
+        """Objects pinned by in-flight work — the store's spill policy
+        must not touch these (they may be mid-transfer as task args)."""
+        with self._lock:
+            return [oid for oid, n in self._pins.items() if n > 0]
 
     def unreferenced(self, object_id: str) -> bool:
         with self._lock:

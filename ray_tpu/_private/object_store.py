@@ -29,6 +29,7 @@ import mmap
 import os
 import pickle
 import threading
+import time
 import uuid
 from dataclasses import dataclass, field
 from multiprocessing import resource_tracker, shared_memory
@@ -41,7 +42,7 @@ import cloudpickle
 # ones are carved into shm segments. Mirrors the reference's inline-small
 # -return threshold semantics (task returns under ~100KiB go to the owner's
 # memory store; reference core_worker.h AllocateReturnObject).
-INLINE_THRESHOLD = 100 * 1024
+from ray_tpu._private.config import CONFIG as _CFG
 
 
 def new_object_id() -> str:
@@ -109,7 +110,7 @@ def serialize(value: Any, object_id: Optional[str] = None,
     order: list[str] = []
     for i, pb in enumerate(raw_buffers):
         mv = pb.raw()
-        if len(mv) < INLINE_THRESHOLD or not create_shm:
+        if len(mv) < _CFG.inline_threshold_bytes or not create_shm:
             inline.append(mv.tobytes())
             order.append("i")
         else:
@@ -137,43 +138,247 @@ def deserialize(obj: StoredObject) -> Any:
     return pickle.loads(obj.payload, buffers=buffers)
 
 
-class LocalStore:
-    """Driver-resident object store with refcount-driven eviction."""
+@dataclass
+class _SpilledObject:
+    object_id: str
+    path: str
+    nbytes: int
 
-    def __init__(self):
-        self._objects: dict[str, StoredObject] = {}
+
+class LocalStore:
+    """Driver-resident object store: refcount-driven deletion, plus a
+    capacity cap with LRU spill-to-disk of unpinned objects.
+
+    Parity: reference plasma eviction
+    (object_manager/plasma/eviction_policy.cc LRU) + raylet spilling
+    (raylet/local_object_manager.cc). A `put` that pushes residency past
+    `capacity_bytes` spills least-recently-used unpinned objects to
+    `spill_dir` (shm segments are materialised into the spill file and
+    unlinked); a later `get` restores transparently.
+    """
+
+    def __init__(self, capacity_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 pinned_fn=None):
+        import collections
+        import tempfile
+        if capacity_bytes is None:
+            capacity_bytes = _CFG.object_store_memory or None
+        self.capacity_bytes = capacity_bytes
+        self._spill_dir = spill_dir or os.path.join(
+            tempfile.gettempdir(), f"rtpu_spill_{os.getpid()}")
+        self._pinned_fn = pinned_fn or (lambda: ())
+        self._objects: "collections.OrderedDict[str, StoredObject]" = (
+            collections.OrderedDict())
+        self._spilled: dict[str, _SpilledObject] = {}
+        # last hand-out time per object: the spill policy avoids objects
+        # a reader may be about to map (get_stored returns shm names the
+        # caller maps OUTSIDE the lock; see _pick_victims_locked)
+        self._touched_at: dict[str, float] = {}
+        self._spilling: set[str] = set()        # popped, disk write in flight
+        self._spill_cancelled: set[str] = set()  # deleted mid-spill
+        self._restoring: set[str] = set()        # spill-file read in flight
+        self._restore_cancelled: set[str] = set()  # deleted mid-restore
+        self._bytes = 0
+        self._spilled_bytes_total = 0
+        self._restored_bytes_total = 0
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
 
+    # ------------------------------------------------------------- put
     def put_stored(self, obj: StoredObject) -> None:
+        stale: list[str] = []
         with self._cv:
+            old = self._objects.pop(obj.object_id, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+                # re-stored id (task retry): reclaim segments the new
+                # object doesn't reuse, or they outlive the process
+                stale = [n for n in old.shm_names
+                         if n not in set(obj.shm_names)]
             self._objects[obj.object_id] = obj
+            self._bytes += obj.nbytes
+            self._touched_at[obj.object_id] = time.monotonic()
+            victims = self._pick_victims_locked()
             self._cv.notify_all()
+        for name in stale:
+            unlink_segment(name)
+        self._write_spills(victims)
 
     def put(self, value: Any, object_id: Optional[str] = None) -> str:
         obj = serialize(value, object_id)
         self.put_stored(obj)
         return obj.object_id
 
+    # ----------------------------------------------------------- spill
+    def _pick_victims_locked(self) -> list[tuple[str, StoredObject]]:
+        """Pop LRU spill victims from residency (lock held) WITHOUT
+        doing I/O — the caller writes them to disk after releasing the
+        lock (`_write_spills`), so a slow disk never stalls the whole
+        object plane. Mid-spill objects are invisible to get/wait until
+        recorded; readers simply block on the condvar until then."""
+        if self.capacity_bytes is None or self._bytes <= self.capacity_bytes:
+            return []
+        pinned = set(self._pinned_fn())
+        now = time.monotonic()
+        victims: list[tuple[str, StoredObject]] = []
+
+        def take(oid: str) -> None:
+            obj = self._objects.pop(oid)
+            self._bytes -= obj.nbytes
+            self._spilling.add(oid)
+            victims.append((oid, obj))
+
+        # LRU order = OrderedDict insertion/touch order. Objects handed
+        # out in the last few seconds are skipped: a reader may still be
+        # mapping their shm segments outside the lock (get/deserialize
+        # race) — the retry path in the runtime covers the remainder.
+        deferred: list[str] = []
+        for oid in list(self._objects):
+            if self._bytes <= self.capacity_bytes:
+                break
+            if oid in pinned:
+                continue
+            if now - self._touched_at.get(oid, 0.0) < 5.0:
+                deferred.append(oid)
+                continue
+            take(oid)
+        # still over: last resort, take recently-touched (but not
+        # pinned) victims rather than blow past the cap unboundedly
+        for oid in deferred:
+            if self._bytes <= self.capacity_bytes:
+                break
+            take(oid)
+        return victims
+
+    def _write_spills(self, victims: list[tuple[str, StoredObject]]) -> None:
+        """Disk I/O phase of spilling (NO store lock held)."""
+        if not victims:
+            return
+        os.makedirs(self._spill_dir, exist_ok=True)
+        for oid, obj in victims:
+            path = os.path.join(self._spill_dir, oid)
+            buffers = []
+            ii = si = 0
+            for kind in obj.buffer_order:
+                if kind == "i":
+                    buffers.append(obj.inline_buffers[ii]); ii += 1
+                else:
+                    mv = _map_segment(obj.shm_names[si], obj.shm_sizes[si])
+                    buffers.append(mv.tobytes())
+                    del mv
+                    si += 1
+            with open(path, "wb") as f:
+                pickle.dump({"payload": obj.payload, "buffers": buffers,
+                             "is_error": obj.is_error}, f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            for name in obj.shm_names:
+                unlink_segment(name)
+            with self._cv:
+                self._spilling.discard(oid)
+                if oid in self._spill_cancelled:
+                    # deleted while we were writing: drop everything
+                    self._spill_cancelled.discard(oid)
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                else:
+                    self._spilled[oid] = _SpilledObject(oid, path,
+                                                        obj.nbytes)
+                    self._spilled_bytes_total += obj.nbytes
+                self._cv.notify_all()
+
+    def _restore(self, oid: str) -> Optional[StoredObject]:
+        """Two-phase restore mirroring the spill write: claim the
+        spill record under the lock, READ THE FILE OUTSIDE IT (a large
+        restore must not stall the whole object plane), re-admit under
+        the lock. Concurrent getters of the same oid wait on the
+        condvar via the _restoring marker."""
+        with self._cv:
+            rec = self._spilled.pop(oid, None)
+            if rec is None:
+                return self._objects.get(oid)   # someone else restored
+            self._restoring.add(oid)
+        try:
+            with open(rec.path, "rb") as f:
+                blob = pickle.load(f)
+            os.unlink(rec.path)
+        except BaseException:
+            with self._cv:
+                self._restoring.discard(oid)
+                self._spilled[oid] = rec        # put the claim back
+                self._cv.notify_all()
+            raise
+        # Rebuild: buffers go back inline (they re-spill if pressure
+        # persists; re-carving shm here would thrash under scans).
+        obj = StoredObject(oid, blob["payload"],
+                           inline_buffers=list(blob["buffers"]),
+                           buffer_order=["i"] * len(blob["buffers"]),
+                           is_error=blob["is_error"])
+        with self._cv:
+            self._restoring.discard(oid)
+            if oid in self._restore_cancelled:   # deleted mid-restore
+                self._restore_cancelled.discard(oid)
+                self._cv.notify_all()
+                return None
+            self._objects[oid] = obj
+            self._bytes += obj.nbytes
+            self._restored_bytes_total += obj.nbytes
+            victims = self._pick_victims_locked()
+            self._cv.notify_all()
+        self._write_spills(victims)
+        return obj
+
+    # ------------------------------------------------------------- get
     def contains(self, object_id: str) -> bool:
         with self._lock:
-            return object_id in self._objects
+            return (object_id in self._objects
+                    or object_id in self._spilled
+                    or object_id in self._spilling)
 
     def get_stored(self, object_id: str,
                    timeout: Optional[float] = None) -> Optional[StoredObject]:
         with self._cv:
-            if timeout == 0:
-                return self._objects.get(object_id)
-            ok = self._cv.wait_for(lambda: object_id in self._objects,
-                                   timeout=timeout)
-            return self._objects.get(object_id) if ok else None
+            def present():
+                return (object_id in self._objects
+                        or object_id in self._spilled)
+            if timeout != 0:
+                self._cv.wait_for(present, timeout=timeout)
+            # timeout == 0 is a NON-BLOCKING probe: a mid-flight
+            # spill/restore simply reports miss; the caller's blocking
+            # path (waiter thread) picks it up once the record lands.
+            obj = self._objects.get(object_id)
+            if obj is not None:
+                self._objects.move_to_end(object_id)   # LRU touch
+                self._touched_at[object_id] = time.monotonic()
+                return obj
+            if object_id not in self._spilled:
+                if object_id in self._restoring and timeout != 0:
+                    # another thread is reading the spill file: wait for
+                    # its re-admission instead of returning a miss
+                    self._cv.wait_for(
+                        lambda: object_id in self._objects,
+                        timeout=timeout)
+                    obj = self._objects.get(object_id)
+                    if obj is not None:
+                        self._touched_at[object_id] = time.monotonic()
+                    return obj
+                return None
+        obj = self._restore(object_id)
+        if obj is not None:
+            with self._lock:
+                self._touched_at[object_id] = time.monotonic()
+        return obj
 
     def wait_any(self, object_ids: list[str], num_returns: int,
                  timeout: Optional[float]) -> list[str]:
         """Block until >= num_returns of object_ids are local; return ready ids."""
         with self._cv:
             def ready():
-                return [o for o in object_ids if o in self._objects]
+                return [o for o in object_ids
+                        if o in self._objects or o in self._spilled
+                        or o in self._spilling or o in self._restoring]
             self._cv.wait_for(lambda: len(ready()) >= num_returns,
                               timeout=timeout)
             return ready()
@@ -181,19 +386,44 @@ class LocalStore:
     def delete(self, object_id: str) -> None:
         with self._lock:
             obj = self._objects.pop(object_id, None)
+            if obj is not None:
+                self._bytes -= obj.nbytes
+            rec = self._spilled.pop(object_id, None)
+            self._touched_at.pop(object_id, None)
+            if object_id in self._spilling:
+                # mid-flight spill: the writer drops the file + segments
+                # when it finishes (see _write_spills)
+                self._spill_cancelled.add(object_id)
+            if object_id in self._restoring:
+                self._restore_cancelled.add(object_id)
         if obj is not None:
             for name in obj.shm_names:
                 unlink_segment(name)
+        if rec is not None:
+            try:
+                os.unlink(rec.path)
+            except OSError:
+                pass
 
     def stats(self) -> dict:
         with self._lock:
             return {
-                "num_objects": len(self._objects),
-                "bytes": sum(o.nbytes for o in self._objects.values()),
+                "num_objects": len(self._objects) + len(self._spilled),
+                "bytes": self._bytes,
+                "num_spilled": len(self._spilled),
+                "spilled_bytes": sum(r.nbytes
+                                     for r in self._spilled.values()),
+                "spilled_bytes_total": self._spilled_bytes_total,
+                "restored_bytes_total": self._restored_bytes_total,
+                "capacity_bytes": self.capacity_bytes,
             }
 
     def shutdown(self) -> None:
         with self._lock:
-            ids = list(self._objects)
+            ids = list(self._objects) + list(self._spilled)
         for oid in ids:
             self.delete(oid)
+        try:
+            os.rmdir(self._spill_dir)
+        except OSError:
+            pass

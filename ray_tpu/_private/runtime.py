@@ -68,8 +68,10 @@ class Runtime(_context.BaseContext):
                  max_workers: Optional[int] = None,
                  namespace: str = "default"):
         self.namespace = namespace
-        self.store = LocalStore()
         self.controller = Controller()
+        # capacity via RAY_TPU_OBJECT_STORE_MEMORY (bytes); spill policy
+        # must never touch objects pinned by in-flight tasks.
+        self.store = LocalStore(pinned_fn=self.controller.pinned_ids)
         self._shutdown = False
         self._actor_states: dict[str, _ActorState] = {}
         self._actor_lock = threading.Lock()
@@ -81,8 +83,10 @@ class Runtime(_context.BaseContext):
         node_res = {"CPU": float(num_cpus)}
         if num_tpus:
             node_res["TPU"] = float(num_tpus)
-        node_res["memory"] = float(os.environ.get(
-            "RAY_TPU_NODE_MEMORY", 8 * 1024 ** 3))
+        from ray_tpu._private.config import CONFIG as _CFG
+        node_res["memory"] = float(
+            os.environ.get("RAY_TPU_NODE_MEMORY")    # legacy name
+            or _CFG.node_memory_bytes)
         if resources:
             node_res.update({k: float(v) for k, v in resources.items()})
 
@@ -415,7 +419,18 @@ class Runtime(_context.BaseContext):
             if stored is None:
                 raise GetTimeoutError(
                     f"get() timed out waiting for {oid}")
-            value = deserialize(stored)
+            try:
+                value = deserialize(stored)
+            except FileNotFoundError:
+                # The spill policy unlinked this object's shm between
+                # get_stored and the map (rare: touch-grace usually
+                # prevents it). The data lives in the spill file —
+                # re-fetch; the restore comes back with inline buffers.
+                stored = self.store.get_stored(oid, timeout=remaining)
+                if stored is None:
+                    raise GetTimeoutError(
+                        f"get() timed out waiting for {oid}")
+                value = deserialize(stored)
             if stored.is_error:
                 raise value
             out.append(value)

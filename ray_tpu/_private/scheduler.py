@@ -35,7 +35,7 @@ ACTOR = "actor"
 STARTING = "starting"
 DEAD = "dead"
 
-_SPAWN_TIMEOUT_S = 60.0
+from ray_tpu._private.config import CONFIG as _CFG
 
 
 @dataclass
@@ -70,7 +70,6 @@ def release(avail: dict[str, float], got: dict[str, float]) -> None:
             avail[k] = avail.get(k, 0.0) + v
 
 
-_SPILL_DELAY_S = 1.0
 
 
 class Scheduler:
@@ -89,12 +88,19 @@ class Scheduler:
         self.total = dict(node_resources)
         self.avail = dict(node_resources)
         self._addr = listen_addr
-        self._max_workers = max_workers or max(
-            int(node_resources.get("CPU", 4)) * 2, 8)
+        self._max_workers = (max_workers or _CFG.worker_pool_max
+                             or max(int(node_resources.get("CPU", 4)) * 2,
+                                    8))
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
         self._pending: deque = deque()           # TaskSpec | ActorSpec
         self._queued_at: dict[int, float] = {}   # id(spec) -> enqueue time
+        # Running sum of queued-but-undispatched demand, maintained on
+        # every queue mutation: effective_avail() and the hybrid policy
+        # read it O(1) instead of rescanning the queue (that rescan made
+        # submission O(n^2) past ~1k queued tasks).
+        self._pending_demand: dict[str, float] = {}
+        self._last_spill_scan = 0.0
         self._workers: dict[str, WorkerRec] = {}
         # (pg_id, bundle_index) -> {"total": {...}, "avail": {...}}
         self._bundles: dict[tuple, dict] = {}
@@ -152,16 +158,32 @@ class Scheduler:
         return None
 
     # ---- submission ----
+    def _demand_add(self, spec) -> None:
+        for k, v in self._effective_need(spec).items():
+            if v:
+                self._pending_demand[k] = self._pending_demand.get(k, 0.0) + v
+
+    def _demand_sub(self, spec) -> None:
+        for k, v in self._effective_need(spec).items():
+            if v:
+                left = self._pending_demand.get(k, 0.0) - v
+                if left > 1e-9:
+                    self._pending_demand[k] = left
+                else:
+                    self._pending_demand.pop(k, None)
+
     def enqueue(self, spec) -> None:
         with self._cv:
             self._pending.append(spec)
             self._queued_at[id(spec)] = time.monotonic()
+            self._demand_add(spec)
             self._cv.notify_all()
 
     def enqueue_front(self, spec) -> None:
         with self._cv:
             self._pending.appendleft(spec)
             self._queued_at[id(spec)] = time.monotonic()
+            self._demand_add(spec)
             self._cv.notify_all()
 
     def cancel_pending(self, task_id: str) -> Optional[TaskSpec]:
@@ -170,6 +192,7 @@ class Scheduler:
                 if isinstance(spec, TaskSpec) and spec.task_id == task_id:
                     self._pending.remove(spec)
                     self._queued_at.pop(id(spec), None)
+                    self._demand_sub(spec)
                     return spec
         return None
 
@@ -316,9 +339,8 @@ class Scheduler:
         wildly overstates capacity during placement bursts)."""
         with self._lock:
             eff = dict(self.avail)
-            for spec in self._pending:
-                for k, v in self._effective_need(spec).items():
-                    eff[k] = eff.get(k, 0.0) - v
+            for k, v in self._pending_demand.items():
+                eff[k] = eff.get(k, 0.0) - v
             return eff
 
     def utilization(self) -> float:
@@ -360,20 +382,31 @@ class Scheduler:
 
     def _spill_aged_locked(self) -> None:
         """Spillback (stage-1 redirect): hand unconstrained tasks that
-        aged past _SPILL_DELAY_S without resources back to the cluster
+        aged past the spill_delay_s knob without resources back to the cluster
         for re-placement on a node with room."""
         if self._cluster is None:
             return
         now = time.monotonic()
+        # Throttle: the scan is O(queue) with dict churn per spec; at
+        # most ~4 scans/s, and none when there is nowhere to spill to.
+        # NOTE: the node lock is held here — only the cluster's
+        # LOCK-FREE node count may be read (cluster-lock calls from
+        # under a node lock are the ABBA deadlock _fail_if_pg_removed
+        # documents).
+        if now - self._last_spill_scan < 0.25:
+            return
+        if self._cluster.alive_node_count() <= 1:
+            return
+        self._last_spill_scan = now
         for spec in list(self._pending):
             # The lock is dropped around try_spill below, so a concurrent
             # cancel_pending may have removed a later snapshot entry.
-            if spec not in self._pending:
+            if id(spec) not in self._queued_at:
                 continue
             if fits(self.avail, self._effective_need(spec)):
                 continue
             t0 = self._queued_at.get(id(spec))
-            if t0 is None or now - t0 < _SPILL_DELAY_S:
+            if t0 is None or now - t0 < _CFG.spill_delay_s:
                 continue
             spilled = getattr(spec, "_spill_count", 0)
             if spilled >= 3:
@@ -382,6 +415,7 @@ class Scheduler:
             # cluster lock; cluster->node calls take node locks).
             self._pending.remove(spec)
             self._queued_at.pop(id(spec), None)
+            self._demand_sub(spec)
             self._cv.release()
             try:
                 try:
@@ -394,6 +428,7 @@ class Scheduler:
             if not moved:
                 self._pending.appendleft(spec)
                 self._queued_at[id(spec)] = t0
+                self._demand_add(spec)
 
     def _reap_failed_spawns_locked(self) -> None:
         """A worker that exits (or hangs) before registering would otherwise
@@ -403,7 +438,7 @@ class Scheduler:
             if rec.state != STARTING:
                 continue
             exited = rec.proc is not None and rec.proc.poll() is not None
-            timed_out = now - rec.started_at > _SPAWN_TIMEOUT_S
+            timed_out = now - rec.started_at > _CFG.worker_spawn_timeout_s
             if exited or timed_out:
                 rec.state = DEAD
                 self._spawning = max(0, self._spawning - 1)
@@ -417,9 +452,15 @@ class Scheduler:
                         pass
 
     def _try_dispatch_locked(self) -> bool:
+        """One sweep over the queue, dispatching EVERY spec a free
+        worker + resources allow (a per-dispatch rescan made draining n
+        queued tasks O(n^2); reference LocalTaskManager::
+        DispatchScheduledTasksToWorkers drains its queue per wake the
+        same way)."""
+        dispatched = 0
         for spec in list(self._pending):
-            if spec not in self._pending:  # removed while lock was dropped
-                continue
+            if id(spec) not in self._queued_at:
+                continue              # removed while the lock was dropped
             need = self._effective_need(spec)
             pg_key = self._bundle_for(spec)
             if getattr(spec, "placement_group_id", None) and pg_key is None:
@@ -453,9 +494,10 @@ class Scheduler:
                         self.spawn_worker()
                     finally:
                         self._cv.acquire()
-                return False              # wait for registration
+                break                 # no free worker: stop the sweep
             self._pending.remove(spec)
             self._queued_at.pop(id(spec), None)
+            self._demand_sub(spec)
             acquire(pool, need)
             worker.acquired = need
             worker.pg_key = pg_key
@@ -470,8 +512,8 @@ class Scheduler:
                 worker.task = spec
                 self._rt.on_task_dispatched(spec, worker.worker_id)
                 worker.conn.send({"type": protocol.TASK, "spec": spec})
-            return True
-        return False
+            dispatched += 1
+        return dispatched > 0
 
     def _fail_if_pg_removed(self, spec) -> None:
         """A queued spec whose placement group was removed can never run;
@@ -489,10 +531,11 @@ class Scheduler:
             removed = pg is None or pg.state == "REMOVED"
         finally:
             self._cv.acquire()
-        if not removed or spec not in self._pending:
+        if not removed or id(spec) not in self._queued_at:
             return
         self._pending.remove(spec)
         self._queued_at.pop(id(spec), None)
+        self._demand_sub(spec)
         reason = (f"placement group {pg_id} was removed before "
                   f"{getattr(spec, 'name', spec)!r} could be scheduled")
         self._cv.release()

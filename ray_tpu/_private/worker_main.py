@@ -51,17 +51,27 @@ class WorkerContext(_context.BaseContext):
                     timeout: Optional[float]) -> list[Any]:
         out = []
         for oid in object_ids:
+            value, stored = self._get_one(oid, timeout)
+            if stored.is_error:
+                raise value
+            out.append(value)
+        return out
+
+    def _get_one(self, oid: str, timeout):
+        for attempt in (0, 1):
             reply = self.conn.request(
                 {"type": protocol.GET_OBJECT, "object_id": oid,
                  "timeout": timeout})
             if reply.get("timeout") or reply.get("stored") is None:
                 raise GetTimeoutError(f"get() timed out waiting for {oid}")
             stored: StoredObject = reply["stored"]
-            value = deserialize(stored)
-            if stored.is_error:
-                raise value
-            out.append(value)
-        return out
+            try:
+                return deserialize(stored), stored
+            except FileNotFoundError:
+                # driver spilled the object between reply and our shm
+                # map; one re-request restores it (inline buffers)
+                if attempt:
+                    raise
 
     def wait(self, object_ids: list[str], num_returns: int,
              timeout: Optional[float]):
@@ -142,6 +152,49 @@ class WorkerContext(_context.BaseContext):
 
     def node_resources(self) -> dict:
         return self.state_op("cluster_resources")
+
+
+def _apply_runtime_env(renv: Optional[dict]) -> dict:
+    """Apply a runtime_env in this process; returns undo info.
+
+    Parity: reference _private/runtime_env/ plugins, reduced to the two
+    locally-meaningful ones (env_vars fanout + working_dir); the key set
+    is validated at SUBMISSION time (api.validate_runtime_env). Atomic:
+    a failure mid-apply (working_dir vanished since validation) reverts
+    whatever was already applied before re-raising — a pooled worker
+    must never leak a half-applied env onto later tasks."""
+    undo: dict = {"env": {}, "cwd": None, "path": None}
+    if not renv:
+        return undo
+    try:
+        for k, v in (renv.get("env_vars") or {}).items():
+            undo["env"][k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        wd = renv.get("working_dir")
+        if wd:
+            undo["cwd"] = os.getcwd()
+            os.chdir(wd)
+            sys.path.insert(0, wd)
+            undo["path"] = wd
+    except BaseException:
+        _revert_runtime_env(undo)
+        raise
+    return undo
+
+
+def _revert_runtime_env(undo: dict) -> None:
+    for k, old in undo["env"].items():
+        if old is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = old
+    if undo["cwd"] is not None:
+        os.chdir(undo["cwd"])
+    if undo["path"] is not None:
+        try:
+            sys.path.remove(undo["path"])
+        except ValueError:
+            pass
 
 
 class WorkerExecutor:
@@ -235,7 +288,12 @@ class WorkerExecutor:
                             "error": error, **extra})
 
     def _run_task(self, spec: TaskSpec) -> None:
+        undo = None
         try:
+            # env first: the function/args may only UNPICKLE under the
+            # declared working_dir/env (the actor path does the same).
+            # Scoped: the pooled worker is reused by other tasks after.
+            undo = _apply_runtime_env(getattr(spec, "runtime_env", None))
             fn = self._load_function(spec.func_id)
             args, kwargs = self._resolve_args(spec.args, spec.kwargs)
             result = fn(*args, **kwargs)
@@ -244,11 +302,16 @@ class WorkerExecutor:
             result = e if isinstance(e, TaskError) else TaskError(
                 e, format_exception(e), task_name=spec.name)
             error = True
+        finally:
+            if undo is not None:
+                _revert_runtime_env(undo)
         self._send_results(spec.task_id, spec.return_ids, result,
                            spec.num_returns, error, name=spec.name)
 
     def _create_actor(self, spec: ActorSpec) -> None:
         try:
+            # permanent: this worker is dedicated to the actor for life
+            _apply_runtime_env(getattr(spec, "runtime_env", None))
             cls = self._load_function(spec.class_id)
             args, kwargs = self._resolve_args(spec.init_args,
                                               spec.init_kwargs)

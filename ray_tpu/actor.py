@@ -18,7 +18,8 @@ from ray_tpu._private.refs import ObjectRef
 from ray_tpu._private.specs import (ActorSpec, ActorTaskSpec,
                                     extract_ref_args, function_id,
                                     new_actor_id, new_task_id)
-from ray_tpu.api import _apply_scheduling, build_resources
+from ray_tpu.api import (_apply_scheduling, build_resources,
+                         validate_runtime_env)
 
 _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "name", "namespace",
@@ -46,6 +47,7 @@ class ActorClass:
         bad = set(self._opts) - _VALID_ACTOR_OPTIONS
         if bad:
             raise ValueError(f"invalid actor option(s): {sorted(bad)}")
+        validate_runtime_env(self._opts.get("runtime_env"))
         self._pickled: Optional[bytes] = None
         self._class_id: Optional[str] = None
 
@@ -91,7 +93,7 @@ class ActorClass:
             name=opts.get("name"),
             namespace=opts.get("namespace", "default"),
             lifetime=opts.get("lifetime"),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=validate_runtime_env(opts.get("runtime_env")),
         )
         _apply_scheduling(spec, opts)
         if ctx.is_driver:

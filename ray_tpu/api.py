@@ -24,6 +24,41 @@ _VALID_TASK_OPTIONS = {
 }
 
 
+_SUPPORTED_RUNTIME_ENV_KEYS = {"env_vars", "working_dir"}
+
+
+def validate_runtime_env(renv: Optional[dict]) -> Optional[dict]:
+    """Reject runtime_env keys this stack does not implement — options
+    must never be silently ignored (r1 verdict principle). Supported:
+    env_vars (dict[str,str], applied in the worker process) and
+    working_dir (local path: worker chdir + sys.path). Reference
+    surface: _private/runtime_env/ plugin set."""
+    if renv is None:
+        return None
+    if not isinstance(renv, dict):
+        raise TypeError(f"runtime_env must be a dict, got "
+                        f"{type(renv).__name__}")
+    unsupported = set(renv) - _SUPPORTED_RUNTIME_ENV_KEYS
+    if unsupported:
+        raise ValueError(
+            f"unsupported runtime_env key(s) {sorted(unsupported)}; "
+            f"this runtime implements {sorted(_SUPPORTED_RUNTIME_ENV_KEYS)}")
+    env_vars = renv.get("env_vars")
+    if env_vars is not None and not (
+            isinstance(env_vars, dict)
+            and all(isinstance(k, str) and isinstance(v, str)
+                    for k, v in env_vars.items())):
+        raise TypeError("runtime_env['env_vars'] must be dict[str, str]")
+    wd = renv.get("working_dir")
+    if wd is not None:
+        import os
+        if not os.path.isdir(wd):
+            raise ValueError(
+                f"runtime_env['working_dir'] {wd!r} is not a directory "
+                f"(remote URIs are not supported in this runtime)")
+    return renv
+
+
 def build_resources(opts: dict, default_cpus: float = 1.0) -> dict:
     res = dict(opts.get("resources") or {})
     if "num_cpus" in opts and opts["num_cpus"] is not None:
@@ -71,6 +106,7 @@ class RemoteFunction:
         bad = set(self._opts) - _VALID_TASK_OPTIONS
         if bad:
             raise ValueError(f"invalid @remote option(s): {sorted(bad)}")
+        validate_runtime_env(self._opts.get("runtime_env"))
         self._pickled: Optional[bytes] = None
         self._func_id: Optional[str] = None
         self._registered_in: set[int] = set()
@@ -106,7 +142,7 @@ class RemoteFunction:
             max_retries=int(opts.get("max_retries", 3)),
             name=opts.get("name") or getattr(self._fn, "__qualname__",
                                              "task"),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=validate_runtime_env(opts.get("runtime_env")),
             pinned_refs=pinned,
         )
         _apply_scheduling(spec, opts)

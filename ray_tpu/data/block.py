@@ -104,11 +104,22 @@ def block_concat(blocks: List[Block]) -> Block:
         filler[:] = None
         return filler
 
+    def obj_rows(c: np.ndarray) -> np.ndarray:
+        """(n, ...) array -> (n,) object array of row sub-arrays, so a
+        multi-dim column can concat with a None-filled stretch."""
+        if c.dtype == object and c.ndim == 1:
+            return c
+        out = np.empty(len(c), dtype=object)
+        for i in range(len(c)):
+            out[i] = c[i]
+        return out
+
     out: Block = {}
     for k in keys:
         cols = [col(b, k) for b in blocks]
-        if any(c.dtype == object for c in cols):
-            cols = [c.astype(object) for c in cols]
+        if any(c.dtype == object or c.ndim != cols[0].ndim
+               for c in cols):
+            cols = [obj_rows(c) for c in cols]
         out[k] = np.concatenate(cols)
     return out
 

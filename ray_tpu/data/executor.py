@@ -124,14 +124,10 @@ def _stream_local(tasks: List[ReadTask], ops: List[Op]) -> Iterator[Block]:
     SENTINEL = object()
     closed = threading.Event()
 
+    from ray_tpu.data._util import put_unless_closed
+
     def _put(item) -> bool:
-        while not closed.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
+        return put_unless_closed(q, item, closed)
 
     def producer():
         try:

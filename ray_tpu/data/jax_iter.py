@@ -42,14 +42,8 @@ class _Prefetcher:
         self._thread.start()
 
     def _put(self, item) -> bool:
-        import queue
-        while not self._closed.is_set():
-            try:
-                self._q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
+        from ray_tpu.data._util import put_unless_closed
+        return put_unless_closed(self._q, item, self._closed)
 
     def close(self) -> None:
         self._closed.set()

@@ -32,9 +32,19 @@ class TransformerConfig:
     # not re-run in bwd (~(b,s,d_model) bf16 + (b,h,s) f32 per layer).
     remat_policy: str = "full"
     use_ring_attention: bool = False      # seq-parallel attention (sp axis)
+    # >0 with a pp>1 mesh: run the layer stack as a GPipe microbatch
+    # pipeline over the pp axis (parallel/pipeline.py). Bubble fraction
+    # is (pp-1)/(M+pp-1) — pick M >= 4*pp.
+    pipeline_microbatches: int = 0
     attn_block_q: int = 128
     attn_block_k: int = 128
     loss_chunk: int = 0                   # >0: chunked LM loss (seq chunks)
+    # --- Mixture of Experts (0 = dense FFN). Experts shard over the ep
+    # mesh axis; see models/moe.py for dispatch semantics.
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01            # load-balance loss weight
 
     @property
     def kv_heads(self) -> int:
@@ -52,13 +62,22 @@ class TransformerConfig:
     def parameter_dtype(self):
         return jnp.dtype(self.param_dtype)
 
-    def num_params(self) -> int:
-        """Exact parameter count (embeddings + layers + head)."""
-        e, f, hd = self.d_model, self.d_ff, self.head_dim
+    def _ffn_params(self, active_only: bool = False) -> int:
+        e, f = self.d_model, self.d_ff
+        if not self.moe_num_experts:
+            return 3 * e * f
+        experts = self.moe_top_k if active_only else self.moe_num_experts
+        return experts * 3 * e * f + e * self.moe_num_experts  # + router
+
+    def num_params(self, active_only: bool = False) -> int:
+        """Parameter count (embeddings + layers + head). With MoE,
+        `active_only` counts router + top_k experts per token — the
+        number that matters for FLOPs."""
+        e, hd = self.d_model, self.head_dim
         per_layer = (e * self.n_heads * hd          # wq
                      + 2 * e * self.kv_heads * hd   # wk, wv
                      + self.n_heads * hd * e        # wo
-                     + 3 * e * f                    # gate, up, down
+                     + self._ffn_params(active_only)
                      + 2 * e)                       # two norms
         total = self.vocab_size * e + self.n_layers * per_layer + e
         if not self.tie_embeddings:
@@ -66,8 +85,9 @@ class TransformerConfig:
         return total
 
     def flops_per_token(self) -> float:
-        """Approximate training FLOPs/token (fwd+bwd ≈ 6·N + attention)."""
-        n = self.num_params()
+        """Approximate training FLOPs/token (fwd+bwd ≈ 6·N_active +
+        attention)."""
+        n = self.num_params(active_only=True)
         attn = 12 * self.n_layers * self.d_model * self.max_seq_len
         return 6.0 * n + attn
 

@@ -29,7 +29,7 @@ Params = Dict[str, Any]
 
 # Activation logical axes (all optional constraints; params use the
 # rules in parallel.sharding directly).
-_ACT_RULES_EXTRA = {"act_embed": None}
+_ACT_RULES_EXTRA = {"act_embed": None, "expert_capacity": None}
 
 
 def _rules():
@@ -71,19 +71,31 @@ class Transformer:
                     * scale).astype(pd)
 
         L = c.n_layers
-        params: Params = {
-            "embed": w(next(k), (c.vocab_size, e), std),
-            "layers": {
-                "attn_norm": jnp.zeros((L, e), pd),
-                "wq": w(next(k), (L, e, qd), std),
-                "wk": w(next(k), (L, e, kvd), std),
-                "wv": w(next(k), (L, e, kvd), std),
-                "wo": w(next(k), (L, qd, e), out_std),
-                "mlp_norm": jnp.zeros((L, e), pd),
+        layers: Params = {
+            "attn_norm": jnp.zeros((L, e), pd),
+            "wq": w(next(k), (L, e, qd), std),
+            "wk": w(next(k), (L, e, kvd), std),
+            "wv": w(next(k), (L, e, kvd), std),
+            "wo": w(next(k), (L, qd, e), out_std),
+            "mlp_norm": jnp.zeros((L, e), pd),
+        }
+        if c.moe_num_experts:
+            E = c.moe_num_experts
+            layers.update({
+                "router": w(next(k), (L, e, E), std),
+                "moe_gate": w(next(k), (L, E, e, f), std),
+                "moe_up": w(next(k), (L, E, e, f), std),
+                "moe_down": w(next(k), (L, E, f, e), out_std),
+            })
+        else:
+            layers.update({
                 "gate": w(next(k), (L, e, f), std),
                 "up": w(next(k), (L, e, f), std),
                 "down": w(next(k), (L, f, e), out_std),
-            },
+            })
+        params: Params = {
+            "embed": w(next(k), (c.vocab_size, e), std),
+            "layers": layers,
             "final_norm": jnp.zeros((e,), pd),
         }
         if not c.tie_embeddings:
@@ -91,19 +103,30 @@ class Transformer:
         return params
 
     def param_logical_axes(self) -> Params:
-        axes = {
-            "embed": ("vocab", "embed"),
-            "layers": {
-                "attn_norm": ("layers", "embed"),
-                "wq": ("layers", "embed", "heads"),
-                "wk": ("layers", "embed", "kv_heads"),
-                "wv": ("layers", "embed", "kv_heads"),
-                "wo": ("layers", "heads", "embed"),
-                "mlp_norm": ("layers", "embed"),
+        layers = {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "embed"),
+        }
+        if self.config.moe_num_experts:
+            layers.update({
+                "router": ("layers", "embed", None),
+                "moe_gate": ("layers", "experts", "embed", "mlp"),
+                "moe_up": ("layers", "experts", "embed", "mlp"),
+                "moe_down": ("layers", "experts", "mlp", "embed"),
+            })
+        else:
+            layers.update({
                 "gate": ("layers", "embed", "mlp"),
                 "up": ("layers", "embed", "mlp"),
                 "down": ("layers", "mlp", "embed"),
-            },
+            })
+        axes = {
+            "embed": ("vocab", "embed"),
+            "layers": layers,
             "final_norm": ("embed",),
         }
         if not self.config.tie_embeddings:
@@ -136,6 +159,7 @@ class Transformer:
                                        rules=_rules())
 
     def _layer(self, x, layer: Params, rope):
+        """One block; returns (x, moe_aux_loss) — 0.0 for dense FFN."""
         c = self.config
         ad = c.activation_dtype
         b, s, e = x.shape
@@ -159,24 +183,42 @@ class Transformer:
         x = self._constrain(x, ("batch", "seq", "act_embed"))
 
         h = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+        if c.moe_num_experts:
+            from ray_tpu.models.moe import moe_ffn
+            y, aux = moe_ffn(
+                h, layer["router"], layer["moe_gate"], layer["moe_up"],
+                layer["moe_down"], top_k=c.moe_top_k,
+                capacity_factor=c.moe_capacity_factor,
+                constrain=(None if self.mesh is None else
+                           lambda a, ax: self._constrain(a, ax)))
+            x = x + y
+            return (self._constrain(x, ("batch", "seq", "act_embed")),
+                    aux["moe_load_balance_loss"])
         gate = jax.nn.silu(h @ layer["gate"].astype(ad))
         up = h @ layer["up"].astype(ad)
         mlp = self._constrain(gate * up, ("batch", "seq", "mlp"))
         x = x + mlp @ layer["down"].astype(ad)
-        return self._constrain(x, ("batch", "seq", "act_embed"))
+        return (self._constrain(x, ("batch", "seq", "act_embed")),
+                jnp.float32(0.0))
 
     def hidden(self, params: Params, tokens: jax.Array,
                positions: Optional[jax.Array] = None) -> jax.Array:
         """Trunk: tokens (b, s) -> post-final-norm hidden states (b, s, e)."""
+        return self.hidden_and_aux(params, tokens, positions)[0]
+
+    def hidden_and_aux(self, params: Params, tokens: jax.Array,
+                       positions: Optional[jax.Array] = None):
+        """(hidden states, summed MoE load-balance loss across layers)."""
         from ray_tpu.ops.dispatch import compute_platform
         with compute_platform(self._platform()):
             return self._hidden(params, tokens, positions)
 
     def _hidden(self, params: Params, tokens: jax.Array,
-                positions: Optional[jax.Array] = None) -> jax.Array:
+                positions: Optional[jax.Array] = None):
         c = self.config
         ad = c.activation_dtype
         b, s = tokens.shape
+        custom_positions = positions is not None
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
         x = params["embed"].astype(ad)[tokens]
@@ -187,19 +229,60 @@ class Transformer:
         from ray_tpu.ops.rope import rope_cos_sin
         rope = rope_cos_sin(positions, c.head_dim, c.rope_theta)
 
-        def body(carry, layer):
-            return self._layer(carry, layer, rope), None
+        remat_policy = None
+        if c.remat and c.remat_policy == "save_attn":
+            from ray_tpu.ops.attention import attn_remat_policy
+            remat_policy = attn_remat_policy()
 
-        if c.remat:
-            # prevent_cse=False: scan's loop structure already blocks the
-            # CSE hazard; keeping it True inserts unfusable barriers.
-            policy = None
-            if c.remat_policy == "save_attn":
-                from ray_tpu.ops.attention import attn_remat_policy
-                policy = attn_remat_policy()
-            body = jax.checkpoint(body, prevent_cse=False, policy=policy)
-        x, _ = lax.scan(body, x, params["layers"])
-        return rms_norm(x, params["final_norm"], c.norm_eps)
+        def _checkpointed(body):
+            if c.remat:
+                # prevent_cse=False: scan's loop structure already blocks
+                # the CSE hazard; True inserts unfusable barriers.
+                return jax.checkpoint(body, prevent_cse=False,
+                                      policy=remat_policy)
+            return body
+
+        if (self.mesh is not None and self.mesh.shape.get("pp", 1) > 1
+                and c.pipeline_microbatches > 0):
+            if c.moe_num_experts:
+                raise NotImplementedError(
+                    "MoE + pipeline parallelism is not supported yet "
+                    "(the pipeline stage carries activations only)")
+            if custom_positions:
+                raise NotImplementedError(
+                    "pipeline parallelism assumes default positions "
+                    "(rope caches are sliced per microbatch, which is "
+                    "only exact when rows share the arange positions); "
+                    "pass positions=None with pp>1")
+            from ray_tpu.parallel.pipeline import pipeline_apply
+
+            # rope rides as explicit consts: closures over tracers don't
+            # cross the shard_map manual region. Caches are full-batch;
+            # rows are identical (positions broadcast from arange), so
+            # slicing to the microbatch is exact.
+            def stage(stage_layers, xm, cos, sin):
+                rope_mb = (cos[:xm.shape[0]], sin[:xm.shape[0]])
+
+                def sbody(carry, layer):
+                    y, _lb = self._layer(carry, layer, rope_mb)
+                    return y, None
+                out, _ = lax.scan(_checkpointed(sbody), xm, stage_layers)
+                return out
+
+            x = pipeline_apply(self.mesh, stage, params["layers"], x,
+                               c.pipeline_microbatches, consts=rope)
+            return (rms_norm(x, params["final_norm"], c.norm_eps),
+                    jnp.float32(0.0))
+
+        def body(carry, layer):
+            x, aux = carry
+            x, lb = self._layer(x, layer, rope)
+            return (x, aux + lb), None
+
+        (x, moe_aux), _ = lax.scan(_checkpointed(body),
+                                   (x, jnp.float32(0.0)),
+                                   params["layers"])
+        return rms_norm(x, params["final_norm"], c.norm_eps), moe_aux
 
     def _head(self, params: Params) -> jax.Array:
         return (params["embed"].T if self.config.tie_embeddings
@@ -223,22 +306,32 @@ class Transformer:
         c = self.config
         tokens = batch["tokens"]
         mask = batch.get("loss_mask")
+
+        def moe_term(aux):
+            if not c.moe_num_experts:
+                return 0.0
+            return c.moe_aux_coef * aux / c.n_layers
+
         if c.loss_chunk:
             # Full-length formulation (keeps seq divisible by the chunk):
             # labels[i] = tokens[i+1], with the final position masked out.
             from ray_tpu.ops.losses import chunked_lm_loss
             b, s = tokens.shape
-            x = self.hidden(params, tokens)
+            x, aux = self.hidden_and_aux(params, tokens)
             labels = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
             m = (jnp.ones((b, s), jnp.float32) if mask is None
                  else mask.astype(jnp.float32))
             m = jnp.concatenate([m[:, 1:], jnp.zeros((b, 1))], axis=1)
             head = self._head(params).astype(c.activation_dtype)
             return chunked_lm_loss(x, head, labels, m,
-                                   chunk_size=c.loss_chunk)
-        logits = self.apply(params, tokens)[:, :-1]
+                                   chunk_size=c.loss_chunk) + moe_term(aux)
+        x, aux = self.hidden_and_aux(params, tokens)
+        logits = x @ self._head(params).astype(c.activation_dtype)
+        logits = self._constrain(logits,
+                                 ("batch", "seq", "vocab"))
+        logits = logits.astype(jnp.float32)[:, :-1]
         labels = tokens[:, 1:]
         if mask is not None:
             mask = mask[:, 1:]
         loss, _ = softmax_cross_entropy(logits, labels, mask=mask)
-        return loss
+        return loss + moe_term(aux)

@@ -1,0 +1,117 @@
+"""GPipe pipeline parallelism over the `pp` mesh axis.
+
+The reference builds pipeline schedules out of compiled actor DAGs with
+NCCL p2p channels (reference python/ray/dag/dag_node_operation.py,
+experimental/channel/torch_tensor_nccl_channel.py). The TPU-native
+equivalent is a SPMD microbatch schedule INSIDE one XLA program:
+`jax.shard_map` manual over ONLY the pp axis (other mesh axes — dp,
+fsdp, tp, sp — stay auto, so pipeline composes with GSPMD sharding),
+with `lax.ppermute` rotating activations stage→stage over ICI/DCN.
+
+Schedule: classic GPipe fill-drain. With S stages and M microbatches
+the loop runs M+S-1 ticks; stage 0 injects microbatch t at tick t, the
+last stage emits microbatch t-(S-1). Bubble fraction (S-1)/(M+S-1)
+shrinks as M grows — choose M ≥ 4·S for <20% bubble (config knob
+`pipeline_microbatches`).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def split_stages(layer_params: Any, n_stages: int) -> Any:
+    """Reshape layer-stacked leaves (L, ...) -> (S, L//S, ...)."""
+    def reshape(p):
+        L = p.shape[0]
+        if L % n_stages:
+            raise ValueError(
+                f"{L} layers not divisible into {n_stages} pipeline "
+                f"stages")
+        return p.reshape(n_stages, L // n_stages, *p.shape[1:])
+    return jax.tree_util.tree_map(reshape, layer_params)
+
+
+def pipeline_apply(mesh: Mesh,
+                   stage_fn: Callable[..., jax.Array],
+                   layer_params: Any,
+                   x: jax.Array,
+                   num_microbatches: int,
+                   consts: tuple = ()) -> jax.Array:
+    """Run `stage_fn(stage_params, x_microbatch, *consts)` (one stage's
+    layer stack applied to one microbatch) over the pp axis with a
+    GPipe schedule.
+
+    x: (batch, ...) activations; `consts` are stage-invariant arrays
+    (e.g. rope caches) passed explicitly — closures over tracers don't
+    cross the shard_map boundary. Returns x's shape, replicated over pp
+    (downstream ops run outside the manual region).
+
+    NOTE: call this under an outer jit (the normal train step). The
+    inner jit below exists so EAGER callers work at all (partial-manual
+    shard_map only lowers under jit), but eager callers re-trace per
+    call — fine for debugging, wrong for a training loop.
+    """
+    n_stages = mesh.shape["pp"]
+    if n_stages <= 1:
+        raise ValueError("pipeline_apply needs a pp axis > 1")
+    M = num_microbatches
+    b = x.shape[0]
+    if b % M:
+        raise ValueError(f"batch {b} not divisible into {M} microbatches")
+    micro = x.reshape(M, b // M, *x.shape[1:])
+    stacked = split_stages(layer_params, n_stages)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, axis_names={"pp"},
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked),
+                  P(), jax.tree_util.tree_map(lambda _: P(),
+                                              tuple(consts))),
+        out_specs=P(), check_vma=False)
+    def run(stacked_local, micro_local, consts_local):
+        params_local = jax.tree_util.tree_map(lambda p: p[0],
+                                              stacked_local)
+        stage = lax.axis_index("pp")
+        state = jnp.zeros_like(micro_local[0])
+        outputs = jnp.zeros_like(micro_local)
+        ticks = M + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(t, carry):
+            state, outputs = carry
+            # stage 0 injects microbatch t (clamped; the tail ticks feed
+            # it stale data whose results never reach an emit slot)
+            inject = lax.dynamic_index_in_dim(
+                micro_local, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            x_in = jnp.where(stage == 0, inject, state)
+            y = stage_fn(params_local, x_in, *consts_local)
+            # last stage emits microbatch t-(S-1) once the fill ends
+            out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
+            emit = jnp.logical_and(stage == n_stages - 1,
+                                   t >= n_stages - 1)
+            cur = lax.dynamic_index_in_dim(outputs, out_idx, 0,
+                                           keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(emit, y, cur), out_idx, 0)
+            # rotate activations to the next stage
+            state = lax.ppermute(y, "pp", perm)
+            return state, outputs
+
+        _, outputs = lax.fori_loop(0, ticks, tick, (state, outputs))
+        # broadcast the last stage's outputs to every pp shard (sum of
+        # one non-zero contribution)
+        outputs = lax.psum(
+            jnp.where(stage == n_stages - 1, outputs,
+                      jnp.zeros_like(outputs)), "pp")
+        return outputs
+
+    # partial-manual shard_map only lowers under jit; wrapping here keeps
+    # eager callers (model.loss outside jit) working — jit-in-jit is a
+    # no-op when the caller already traces.
+    out = jax.jit(run)(stacked, micro, tuple(consts))
+    return out.reshape(b, *x.shape[1:])

@@ -254,6 +254,7 @@ class IMPALA:
         self._total_env_steps = 0
         self._dropped_batches = 0
         self._broadcast_count = 0
+        self._last_restore_probe = 0.0
         # prime the pipeline: everyone gets weights and starts sampling
         self.env_runner_group.sync_weights(self.learner.get_weights())
         for aid in self._mgr.healthy_actor_ids():
@@ -275,6 +276,13 @@ class IMPALA:
         ordering guarantees they apply before the next rollout) and
         re-submit `sample` to any runner with nothing in flight."""
         import ray_tpu
+        # Dead-runner recovery must not depend on the queue running
+        # dry (a healthy majority can keep it fed forever): probe
+        # unhealthy actors on a 1s cadence from the pump itself.
+        if (self._mgr.num_healthy_actors < self._mgr.num_actors
+                and time.time() - self._last_restore_probe > 1.0):
+            self._last_restore_probe = time.time()
+            self._restore_runners()
         results = self._mgr.fetch_ready_async_reqs(
             timeout_seconds=timeout, tags=["s"])
         for r in results:

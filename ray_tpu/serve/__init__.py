@@ -1,0 +1,339 @@
+"""ray_tpu.serve: model serving on the actor runtime.
+
+Parity (shape, not scale) with reference python/ray/serve:
+- `@serve.deployment` + `.bind()` + `serve.run`  <- serve/api.py:491
+- ServeController actor reconciling replica sets <- _private/controller.py:84,
+  deployment_state.py (replica FSM: start, health-check, restart, scale)
+- DeploymentHandle with power-of-two-choices routing on outstanding
+  requests                                       <- _private/router.py:315
+- optional HTTP ingress (JSON over POST)         <- _private/proxy.py
+
+Re-designed for this stack: the controller is one actor owning replica
+actors; handles route client-side (each handle tracks its own in-flight
+counts — the reference router does the same per-handle since 2.x);
+replicas execute with max_concurrency = max_ongoing_requests.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+_CONTROLLER_NAME = "_rtpu_serve_controller"
+
+
+# ------------------------------------------------------------ replica
+class _Replica:
+    """Actor wrapping one instance of the user's deployment class."""
+
+    def __init__(self, cls_or_fn, init_args, init_kwargs):
+        if isinstance(cls_or_fn, type):
+            self._obj = cls_or_fn(*init_args, **init_kwargs)
+        else:
+            self._obj = cls_or_fn       # function deployment
+
+    def ping(self):
+        return "pong"
+
+    def handle_request(self, method: str, args, kwargs):
+        if method == "__call__":
+            return self._obj(*args, **kwargs)
+        return getattr(self._obj, method)(*args, **kwargs)
+
+
+@dataclasses.dataclass
+class _DeploymentInfo:
+    name: str
+    cls_bytes: bytes
+    init_args: tuple
+    init_kwargs: dict
+    num_replicas: int
+    max_ongoing_requests: int
+    ray_actor_options: dict
+
+
+class ServeController:
+    """Owns deployment -> replica-set state; reconciles continuously
+    (reference deployment_state DeploymentStateManager.update loop)."""
+
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentInfo] = {}
+        self._replicas: Dict[str, List[Any]] = {}
+        self._lock = threading.Lock()
+        self._running = True
+        self._thread = threading.Thread(target=self._reconcile_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def ping(self):
+        return "pong"
+
+    # ------------------------------------------------------ deploy api
+    def deploy(self, info: _DeploymentInfo) -> None:
+        with self._lock:
+            self._deployments[info.name] = info
+        self._reconcile_once()
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            self._deployments.pop(name, None)
+            replicas = self._replicas.pop(name, [])
+        for r in replicas:
+            try:
+                ray_tpu.kill(r)
+            except BaseException:
+                pass
+
+    def get_replicas(self, name: str) -> List[Any]:
+        with self._lock:
+            if name not in self._deployments:
+                raise ValueError(f"no deployment named {name!r}")
+            return list(self._replicas.get(name, []))
+
+    def list_deployments(self) -> Dict[str, dict]:
+        with self._lock:
+            return {n: {"num_replicas": d.num_replicas,
+                        "live_replicas": len(self._replicas.get(n, []))}
+                    for n, d in self._deployments.items()}
+
+    def shutdown(self) -> None:
+        self._running = False
+        for name in list(self._deployments):
+            self.delete_deployment(name)
+
+    # ------------------------------------------------------- reconcile
+    def _reconcile_loop(self) -> None:
+        while self._running:
+            try:
+                self._reconcile_once()
+            except BaseException:
+                pass
+            time.sleep(1.0)
+
+    def _reconcile_once(self) -> None:
+        import cloudpickle
+        with self._lock:
+            items = list(self._deployments.items())
+        for name, info in items:
+            live = []
+            for r in self._replicas.get(name, []):
+                try:
+                    ray_tpu.get(r.ping.remote(), timeout=5.0)
+                    live.append(r)
+                except BaseException:
+                    pass                  # dead replica: dropped
+            while len(live) < info.num_replicas:
+                cls = cloudpickle.loads(info.cls_bytes)
+                opts = dict(info.ray_actor_options)
+                opts["max_concurrency"] = info.max_ongoing_requests
+                actor = ray_tpu.remote(**opts)(_Replica).remote(
+                    cls, info.init_args, info.init_kwargs)
+                live.append(actor)
+            while len(live) > info.num_replicas:
+                victim = live.pop()
+                try:
+                    ray_tpu.kill(victim)
+                except BaseException:
+                    pass
+            with self._lock:
+                self._replicas[name] = live
+
+
+# ------------------------------------------------------------- handle
+class DeploymentHandle:
+    """Client-side router: power-of-two-choices on this handle's
+    outstanding-request counts (reference router.py:315)."""
+
+    def __init__(self, name: str, controller):
+        self._name = name
+        self._controller = controller
+        self._replicas: List[Any] = []
+        self._inflight: Dict[int, int] = {}
+        self._refreshed = 0.0
+        self._rng = __import__("random").Random(id(self) & 0xffff)
+
+    def _refresh(self, force: bool = False) -> None:
+        if not force and time.time() - self._refreshed < 5.0:
+            return
+        self._replicas = ray_tpu.get(
+            self._controller.get_replicas.remote(self._name))
+        self._inflight = {i: self._inflight.get(i, 0)
+                          for i in range(len(self._replicas))}
+        self._refreshed = time.time()
+
+    def _pick(self) -> int:
+        n = len(self._replicas)
+        if n == 1:
+            return 0
+        a, b = self._rng.sample(range(n), 2)
+        return a if self._inflight[a] <= self._inflight[b] else b
+
+    def remote(self, *args, **kwargs):
+        return self.method("__call__", *args, **kwargs)
+
+    def method(self, method_name: str, *args, **kwargs):
+        self._refresh()
+        if not self._replicas:
+            self._refresh(force=True)
+            if not self._replicas:
+                raise RuntimeError(
+                    f"deployment {self._name!r} has no live replicas")
+        idx = self._pick()
+        self._inflight[idx] += 1
+        try:
+            return self._replicas[idx].handle_request.remote(
+                method_name, args, kwargs)
+        finally:
+            # decay immediately: the ref is async, queue-depth is
+            # approximated by submission concurrency within this tick
+            self._inflight[idx] = max(0, self._inflight[idx] - 1)
+
+
+# ---------------------------------------------------------- user API
+@dataclasses.dataclass
+class Application:
+    deployment: "Deployment"
+    init_args: tuple
+    init_kwargs: dict
+
+
+class Deployment:
+    def __init__(self, cls_or_fn, name: Optional[str] = None,
+                 num_replicas: int = 1, max_ongoing_requests: int = 8,
+                 ray_actor_options: Optional[dict] = None):
+        self._cls = cls_or_fn
+        self.name = name or getattr(cls_or_fn, "__name__", "deployment")
+        self.num_replicas = num_replicas
+        self.max_ongoing_requests = max_ongoing_requests
+        self.ray_actor_options = dict(ray_actor_options or {})
+
+    def options(self, **kw) -> "Deployment":
+        d = Deployment(self._cls, self.name, self.num_replicas,
+                       self.max_ongoing_requests, self.ray_actor_options)
+        for k, v in kw.items():
+            if not hasattr(d, k):
+                raise ValueError(f"unknown deployment option {k!r}")
+            setattr(d, k, v)
+        return d
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+
+def deployment(cls=None, **kwargs):
+    """`@serve.deployment` / `@serve.deployment(num_replicas=...)`."""
+    if cls is not None:
+        return Deployment(cls)
+    return lambda c: Deployment(c, **kwargs)
+
+
+def _get_controller():
+    return ray_tpu.remote(max_concurrency=16)(ServeController).options(
+        name=_CONTROLLER_NAME, get_if_exists=True).remote()
+
+
+def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
+    """Deploy an application; returns its handle (reference
+    serve.run, serve/api.py:491)."""
+    import cloudpickle
+    controller = _get_controller()
+    ray_tpu.get(controller.ping.remote())
+    d = app.deployment
+    dep_name = name or d.name
+    info = _DeploymentInfo(
+        name=dep_name, cls_bytes=cloudpickle.dumps(d._cls),
+        init_args=app.init_args, init_kwargs=app.init_kwargs,
+        num_replicas=d.num_replicas,
+        max_ongoing_requests=d.max_ongoing_requests,
+        ray_actor_options=d.ray_actor_options)
+    ray_tpu.get(controller.deploy.remote(info))
+    return DeploymentHandle(dep_name, controller)
+
+
+def get_handle(name: str) -> DeploymentHandle:
+    controller = _get_controller()
+    return DeploymentHandle(name, controller)
+
+
+def status() -> Dict[str, dict]:
+    controller = _get_controller()
+    return ray_tpu.get(controller.list_deployments.remote())
+
+
+def delete(name: str) -> None:
+    controller = _get_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name))
+
+
+def shutdown() -> None:
+    try:
+        controller = ray_tpu.get_actor(_CONTROLLER_NAME)
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=30)
+        ray_tpu.kill(controller)
+    except BaseException:
+        pass
+    # kill is async: wait for the name to actually clear, or the next
+    # serve.run's get_if_exists would grab the dying controller
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            ray_tpu.get_actor(_CONTROLLER_NAME)
+        except ValueError:
+            return
+        time.sleep(0.05)
+
+
+# ------------------------------------------------------- http ingress
+_HTTP_SERVER = None
+
+
+def start_http(port: int = 8000, host: str = "127.0.0.1") -> int:
+    """JSON-over-POST ingress on the driver: POST /<deployment> with a
+    JSON body calls the deployment and returns the JSON result
+    (reference proxy actor, reduced to a driver thread)."""
+    global _HTTP_SERVER
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    handles: Dict[str, DeploymentHandle] = {}
+
+    class Ingress(BaseHTTPRequestHandler):
+        def do_POST(self):
+            name = self.path.strip("/").split("/")[0]
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"null")
+                if name not in handles:
+                    handles[name] = get_handle(name)
+                result = ray_tpu.get(handles[name].remote(body),
+                                     timeout=60)
+                payload = json.dumps({"result": result}).encode()
+                self.send_response(200)
+            except BaseException as e:  # noqa: BLE001
+                payload = json.dumps({"error": repr(e)}).encode()
+                self.send_response(500)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):   # quiet
+            pass
+
+    _HTTP_SERVER = ThreadingHTTPServer((host, port), Ingress)
+    threading.Thread(target=_HTTP_SERVER.serve_forever,
+                     daemon=True).start()
+    return _HTTP_SERVER.server_address[1]
+
+
+def stop_http() -> None:
+    global _HTTP_SERVER
+    if _HTTP_SERVER is not None:
+        _HTTP_SERVER.shutdown()
+        _HTTP_SERVER = None

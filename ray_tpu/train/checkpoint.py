@@ -115,6 +115,8 @@ def save_pytree(tree: Any, path: str, engine: Optional[str] = None,
     save barrier), else None.
     """
     eng = _engine(engine)
+    if eng not in ("npz", "orbax"):
+        raise ValueError(f"unknown checkpoint engine {eng!r}")
     os.makedirs(path, exist_ok=True)
     if eng == "orbax":
         import orbax.checkpoint as ocp
@@ -132,6 +134,11 @@ def save_pytree(tree: Any, path: str, engine: Optional[str] = None,
             ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
             ckptr.save(target, args=ocp.args.PyTreeSave(tree))
             _ASYNC_CKPTRS[path] = ckptr
+            # bound the registry: each entry holds threads + tree refs;
+            # fresh-dir-per-step loops would otherwise grow it forever
+            while len(_ASYNC_CKPTRS) > 4:
+                old_path = next(iter(_ASYNC_CKPTRS))
+                _ASYNC_CKPTRS.pop(old_path).wait_until_finished()
             with open(marker, "w") as f:
                 f.write(eng)
             return ckptr           # .wait_until_finished() before reading
@@ -141,8 +148,6 @@ def save_pytree(tree: Any, path: str, engine: Optional[str] = None,
         return None
     with open(os.path.join(path, "engine"), "w") as f:
         f.write(eng)
-    if eng != "npz":
-        raise ValueError(f"unknown checkpoint engine {eng!r}")
     import jax
     leaves, treedef = jax.tree.flatten(
         jax.tree.map(lambda x: np.asarray(x), tree))
@@ -162,6 +167,9 @@ def load_pytree(path: str, target: Any = None) -> Any:
     """Load a pytree saved by `save_pytree`. `target` (an example tree)
     is only needed to rebuild custom treedefs from orbax-engine saves."""
     import jax
+    inflight = _ASYNC_CKPTRS.pop(path, None)
+    if inflight is not None:     # racing our own async save: barrier
+        inflight.wait_until_finished()
     marker = os.path.join(path, "engine")
     eng = "npz"
     if os.path.exists(marker):

@@ -85,14 +85,16 @@ class JaxTrainer:
                 fn_bytes = cloudpickle.dumps(self._fn)
                 # restore ships as tar bytes (workers may not share the
                 # driver's filesystem)
-                restore_bytes = None
+                restore_arg = None
                 if restore is not None:
                     from ray_tpu.train.checkpoint import pack_dir
-                    restore_bytes = pack_dir(restore.path)
+                    # put once, fan out the ref: workers resolve it to
+                    # the bytes via shm instead of N pickled copies
+                    restore_arg = ray_tpu.put(pack_dir(restore.path))
                 shard_bytes = self._dataset_shards(group.num_workers)
                 ray_tpu.get([
                     w.init_session.remote(fn_bytes, self._config,
-                                          restore_bytes, shard_bytes[i])
+                                          restore_arg, shard_bytes[i])
                     for i, w in enumerate(group.workers)])
                 backend.on_training_start(group, self._backend_config)
                 last_metrics = self._training_loop(

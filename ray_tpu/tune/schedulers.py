@@ -1,0 +1,67 @@
+"""Trial schedulers: FIFO and ASHA early stopping.
+
+Parity: reference tune/schedulers/async_hyperband.py
+(AsyncHyperBandScheduler/ASHAScheduler) — the asynchronous successive
+halving rule: rungs at grace_period * reduction_factor^k; when a trial
+reports at a rung, it continues only if it is in the top 1/rf of
+everything that has reached that rung so far.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    """Run every trial to completion (reference FIFOScheduler)."""
+
+    def on_result(self, trial_id: str, step: int, metrics: Dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    def __init__(self, metric: str, mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4):
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        # rung milestones: grace, grace*rf, grace*rf^2, ... < max_t
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        # rung milestone -> recorded metric values (sign-normalised: max)
+        self._recorded: Dict[int, List[float]] = {r: [] for r in self.rungs}
+        self._trial_rung: Dict[str, int] = {}   # highest rung passed
+
+    def _val(self, metrics: Dict) -> float:
+        v = float(metrics[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial_id: str, step: int, metrics: Dict) -> str:
+        if step >= self.max_t:
+            return STOP                      # budget exhausted (normal)
+        if self.metric not in metrics:
+            return CONTINUE
+        v = self._val(metrics)
+        decision = CONTINUE
+        for rung in self.rungs:
+            if step < rung or self._trial_rung.get(trial_id, -1) >= rung:
+                continue
+            self._trial_rung[trial_id] = rung
+            rec = self._recorded[rung]
+            rec.append(v)
+            if len(rec) >= self.rf:
+                # keep only the top 1/rf of what reached this rung
+                cutoff = sorted(rec, reverse=True)[
+                    max(0, len(rec) // self.rf - 1)]
+                if v < cutoff:
+                    decision = STOP
+        return decision

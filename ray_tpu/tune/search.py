@@ -1,0 +1,109 @@
+"""Search spaces + variant generation.
+
+Parity: reference tune/search/ (sample.py Domain/Categorical/Float,
+basic_variant.py BasicVariantGenerator) — trimmed to the deterministic
+core: grid_search cross-products, stochastic domains sampled
+`num_samples` times, every variant a plain config dict.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Dict, Iterator, List, Sequence
+
+
+class Domain:
+    """A stochastic hyperparameter domain; `sample(rng)` draws one."""
+
+    def sample(self, rng: random.Random) -> Any:  # pragma: no cover
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Uniform(Domain):
+    def __init__(self, lower: float, upper: float):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.uniform(self.lower, self.upper)
+
+
+class LogUniform(Domain):
+    def __init__(self, lower: float, upper: float):
+        if lower <= 0:
+            raise ValueError("loguniform needs lower > 0")
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.lower),
+                                    math.log(self.upper)))
+
+
+class RandInt(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+def choice(categories: Sequence[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(lower: float, upper: float) -> Uniform:
+    return Uniform(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> LogUniform:
+    return LogUniform(lower, upper)
+
+
+def randint(lower: int, upper: int) -> RandInt:
+    return RandInt(lower, upper)
+
+
+def grid_search(values: Sequence[Any]) -> Dict[str, List[Any]]:
+    """Marker dict, reference tune.grid_search: every value becomes its
+    own variant (cross-product with other grids)."""
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v) == {"grid_search"}
+
+
+class BasicVariantGenerator:
+    """Expand a param_space into concrete trial configs.
+
+    Grid dimensions cross-product; Domain dimensions re-sample per
+    variant; `num_samples` multiplies the whole set (reference
+    basic_variant semantics: num_samples repeats of each grid point)."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+
+    def variants(self, param_space: Dict[str, Any],
+                 num_samples: int = 1) -> Iterator[Dict[str, Any]]:
+        grid_keys = [k for k, v in param_space.items() if _is_grid(v)]
+        grid_vals = [param_space[k]["grid_search"] for k in grid_keys]
+        for _ in range(num_samples):
+            for combo in (itertools.product(*grid_vals)
+                          if grid_keys else [()]):
+                cfg = {}
+                for k, v in param_space.items():
+                    if k in grid_keys:
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self._rng)
+                    else:
+                        cfg[k] = v
+                yield cfg

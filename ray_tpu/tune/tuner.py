@@ -1,0 +1,263 @@
+"""Tuner + trial controller: concurrent trial actors, schedulers, resume.
+
+Parity: reference tune/execution/tune_controller.py (trial lifecycle
+state machine + event loop), tune/tuner.py (Tuner.fit/restore),
+tune/result_grid.py — re-shaped for this stack: each trial is ONE
+RayTrainWorker actor (the same session machinery JaxTrainer workers
+use), so `ray_tpu.train.report(metrics, checkpoint)` works unchanged
+inside a trainable, checkpoints ride the object store as tar bytes
+(no shared fs), and the controller multiplexes trials with
+`ray_tpu.wait` instead of a callback event loop.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import Result
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.search import BasicVariantGenerator
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"   # ran to completion (or scheduler max_t)
+STOPPED = "STOPPED"         # killed early by the scheduler
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: str = "loss"
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 2
+    scheduler: Any = None               # default FIFO
+    seed: int = 0
+    resources_per_trial: Optional[Dict[str, float]] = None
+    trial_poll_timeout: float = 120.0
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = PENDING
+    last_result: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    num_results: int = 0
+    best_checkpoint_path: Optional[str] = None
+    error: Optional[str] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "Trial":
+        return cls(**d)
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: str, mode: str,
+                 path: str):
+        self.trials = trials
+        self._metric, self._mode = metric, mode
+        self.path = path
+
+    def __len__(self) -> int:
+        return len(self.trials)
+
+    @property
+    def num_errors(self) -> int:
+        return sum(1 for t in self.trials if t.status == ERROR)
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        sign = 1.0 if mode == "max" else -1.0
+        best: Optional[Trial] = None
+        best_v = -float("inf")
+        for t in self.trials:
+            if metric not in t.last_result:
+                continue
+            v = sign * float(t.last_result[metric])
+            if v > best_v:
+                best, best_v = t, v
+        if best is None:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        ckpt = (Checkpoint(best.best_checkpoint_path)
+                if best.best_checkpoint_path else None)
+        return Result(metrics={**best.last_result,
+                               "config": best.config,
+                               "trial_id": best.trial_id},
+                      checkpoint=ckpt, path=self.path,
+                      metrics_history=[], error=None)
+
+
+class Tuner:
+    """Sweep a function trainable over a param space.
+
+    trainable(config) runs inside a trial actor and talks back through
+    `ray_tpu.train.report(metrics, checkpoint=...)` — identical to a
+    JaxTrainer loop body, and a trainable may itself construct and fit
+    a JaxTrainer (trial actors can create nested worker actors).
+    """
+
+    def __init__(self, trainable: Callable,
+                 *, param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config=None,
+                 _restored_trials: Optional[List[Trial]] = None):
+        from ray_tpu.train.config import RunConfig
+        self._trainable = trainable
+        self._param_space = dict(param_space or {})
+        self._tune = tune_config or TuneConfig()
+        self._run = run_config or RunConfig()
+        self._restored = _restored_trials
+
+    # --------------------------------------------------------- persist
+    def _state_path(self, exp_dir: str) -> str:
+        return os.path.join(exp_dir, "experiment_state.json")
+
+    def _save_state(self, exp_dir: str, trials: List[Trial]) -> None:
+        tmp = self._state_path(exp_dir) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"trials": [t.to_json() for t in trials],
+                       "metric": self._tune.metric,
+                       "mode": self._tune.mode}, f, indent=1)
+        os.replace(tmp, self._state_path(exp_dir))
+
+    @classmethod
+    def restore(cls, exp_dir: str, trainable: Callable,
+                tune_config: Optional[TuneConfig] = None,
+                run_config=None) -> "Tuner":
+        """Resume an interrupted experiment: finished trials keep their
+        results; RUNNING/PENDING/ERROR trials are re-run (reference
+        Tuner.restore + experiment_state semantics)."""
+        from ray_tpu.train.config import RunConfig
+        with open(os.path.join(exp_dir, "experiment_state.json")) as f:
+            state = json.load(f)
+        trials = [Trial.from_json(d) for d in state["trials"]]
+        run = run_config or RunConfig(
+            name=os.path.basename(exp_dir.rstrip("/")),
+            storage_path=os.path.dirname(exp_dir.rstrip("/")))
+        return cls(trainable, param_space={},
+                   tune_config=tune_config or TuneConfig(
+                       metric=state["metric"], mode=state["mode"]),
+                   run_config=run, _restored_trials=trials)
+
+    # ------------------------------------------------------------- fit
+    def fit(self) -> ResultGrid:
+        from ray_tpu.train.worker_group import RayTrainWorker
+        cfg = self._tune
+        run_name = self._run.name or f"tune_{int(time.time())}"
+        storage = (self._run.storage_path
+                   or os.path.expanduser("~/ray_tpu_results"))
+        exp_dir = os.path.join(storage, run_name)
+        os.makedirs(exp_dir, exist_ok=True)
+        scheduler = cfg.scheduler or FIFOScheduler()
+
+        if self._restored is not None:
+            trials = [
+                t if t.status in (TERMINATED, STOPPED)
+                else Trial(t.trial_id, t.config)
+                for t in self._restored]
+        else:
+            gen = BasicVariantGenerator(cfg.seed)
+            trials = [Trial(f"trial_{i:05d}", c) for i, c in enumerate(
+                gen.variants(self._param_space, cfg.num_samples))]
+        if not trials:
+            raise ValueError("param space produced no trials")
+
+        res = dict(cfg.resources_per_trial or {"CPU": 1.0})
+        actor_cls = ray_tpu.remote(**{
+            "num_cpus": res.pop("CPU", 1.0),
+            "num_tpus": res.pop("TPU", 0) or None,
+            "resources": res or None})(RayTrainWorker)
+        fn_bytes = cloudpickle.dumps(self._trainable)
+
+        pending = [t for t in trials if t.status == PENDING]
+        running: Dict[str, Any] = {}      # trial_id -> actor
+        inflight: Dict[str, Any] = {}     # ref.object_id -> trial
+        ref_of: Dict[str, Any] = {}       # trial_id -> ref
+        managers: Dict[str, CheckpointManager] = {}
+        ckpt_cfg = self._run.checkpoint_config
+
+        def launch(trial: Trial) -> None:
+            actor = actor_cls.remote(0, 1)
+            trial.status = RUNNING
+            actor.init_session.remote(fn_bytes, trial.config, None, None)
+            running[trial.trial_id] = actor
+            managers[trial.trial_id] = CheckpointManager(
+                os.path.join(exp_dir, trial.trial_id, "checkpoints"),
+                num_to_keep=ckpt_cfg.num_to_keep,
+                score_attribute=ckpt_cfg.checkpoint_score_attribute,
+                score_order=ckpt_cfg.checkpoint_score_order)
+            poll(trial)
+
+        def poll(trial: Trial) -> None:
+            ref = running[trial.trial_id].next_result.remote()
+            inflight[ref.object_id] = trial
+            ref_of[trial.trial_id] = ref
+
+        def finish(trial: Trial, status: str,
+                   error: Optional[str] = None) -> None:
+            trial.status = status
+            trial.error = error
+            actor = running.pop(trial.trial_id, None)
+            ref_of.pop(trial.trial_id, None)
+            if actor is not None:
+                try:
+                    ray_tpu.kill(actor)
+                except BaseException:
+                    pass
+            mgr = managers.get(trial.trial_id)
+            if mgr is not None and mgr.best is not None:
+                trial.best_checkpoint_path = mgr.best.path
+            self._save_state(exp_dir, trials)
+
+        while pending or running:
+            while pending and len(running) < cfg.max_concurrent_trials:
+                launch(pending.pop(0))
+            if not running:
+                break
+            ready, _ = ray_tpu.wait(
+                [ref_of[t] for t in running], num_returns=1,
+                timeout=cfg.trial_poll_timeout)
+            if not ready:
+                raise TimeoutError(
+                    f"no trial progressed within "
+                    f"{cfg.trial_poll_timeout}s: {sorted(running)}")
+            ref = ready[0]
+            trial = inflight.pop(ref.object_id)
+            try:
+                item = ray_tpu.get(ref, timeout=5.0)
+            except BaseException as e:
+                finish(trial, ERROR, error=repr(e))
+                continue
+            if item is None:
+                finish(trial, TERMINATED)
+                continue
+            metrics, ckpt_bytes = item
+            trial.num_results += 1
+            trial.last_result = metrics
+            if ckpt_bytes is not None:
+                managers[trial.trial_id].register_bytes(ckpt_bytes,
+                                                        metrics)
+            decision = scheduler.on_result(
+                trial.trial_id, trial.num_results, metrics)
+            if decision == STOP:
+                finish(trial, STOPPED)
+            else:
+                assert decision == CONTINUE
+                poll(trial)
+            self._save_state(exp_dir, trials)
+
+        self._save_state(exp_dir, trials)
+        return ResultGrid(trials, cfg.metric, cfg.mode, exp_dir)

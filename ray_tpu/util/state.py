@@ -1,0 +1,86 @@
+"""State API: list/summarize cluster entities.
+
+Parity: reference python/ray/util/state/api.py (`ray list actors/tasks/
+nodes/objects/placement-groups`, `ray summary tasks`) — served straight
+from the controller tables; also exposed as a CLI:
+``python -m ray_tpu.util.state list actors``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ray_tpu._private import context as _context
+
+
+def _op(op: str, **kw) -> Any:
+    return _context.get_ctx().state_op(op, **kw)
+
+
+def list_actors() -> List[Dict]:
+    return _op("list_actors")
+
+
+def list_tasks(limit: int = 1000) -> List[Dict]:
+    return _op("list_tasks", limit=limit)
+
+
+def list_nodes() -> List[Dict]:
+    return _op("list_nodes")
+
+
+def list_placement_groups() -> List[Dict]:
+    return _op("list_placement_groups")
+
+
+def summarize_tasks() -> Dict[str, int]:
+    return _op("summarize_tasks")
+
+
+def object_store_stats() -> Dict:
+    return _op("object_store_stats")
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _op("cluster_resources")
+
+
+def available_resources() -> Dict[str, float]:
+    return _op("available_resources")
+
+
+_LISTERS = {
+    "actors": list_actors,
+    "tasks": list_tasks,
+    "nodes": list_nodes,
+    "placement-groups": list_placement_groups,
+}
+
+
+def _main() -> None:     # pragma: no cover - thin CLI shim over the API
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu.util.state",
+        description="Inspect a ray_tpu runtime (from the driver process)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_list = sub.add_parser("list")
+    p_list.add_argument("entity", choices=sorted(_LISTERS))
+    sub.add_parser("summary")
+    sub.add_parser("resources")
+    args = parser.parse_args()
+
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    if args.cmd == "list":
+        print(json.dumps(_LISTERS[args.entity](), indent=1, default=str))
+    elif args.cmd == "summary":
+        print(json.dumps(summarize_tasks(), indent=1))
+    else:
+        print(json.dumps({"total": cluster_resources(),
+                          "available": available_resources()}, indent=1))
+
+
+if __name__ == "__main__":
+    _main()

@@ -278,3 +278,56 @@ def test_state_api_lists_actors(ray_cluster):
     actors = context.get_ctx().state_op("list_actors")
     assert isinstance(actors, list) and len(actors) >= 1
     assert {"actor_id", "state", "name"} <= set(actors[0])
+
+
+# -------------------------------------------------------- runtime envs
+def test_runtime_env_env_vars_task(ray_cluster):
+    """env_vars apply inside the task and are REVERTED afterwards (the
+    pooled worker is reused); reference _private/runtime_env semantics."""
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_TEST_FLAG": "on"}})
+    def probe():
+        import os
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    @ray_tpu.remote
+    def probe_clean():
+        import os
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    assert ray_tpu.get(probe.remote()) == "on"
+    assert ray_tpu.get(probe_clean.remote()) is None
+
+
+def test_runtime_env_working_dir_task(ray_cluster, tmp_path):
+    d = tmp_path / "wd"
+    d.mkdir()
+    (d / "marker.txt").write_text("here")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(d)})
+    def read_marker():
+        return open("marker.txt").read()
+
+    assert ray_tpu.get(read_marker.remote()) == "here"
+
+
+def test_runtime_env_actor_env_vars(ray_cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_ACTOR_VAR": "42"}})
+    class EnvActor:
+        def probe(self):
+            import os
+            return os.environ["RTPU_ACTOR_VAR"]
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.probe.remote()) == "42"
+
+
+def test_runtime_env_unsupported_keys_raise(ray_cluster):
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        ray_tpu.remote(runtime_env={"pip": ["requests"]})(lambda: 1)
+
+    with pytest.raises(TypeError, match="env_vars"):
+        ray_tpu.remote(runtime_env={"env_vars": {"A": 1}})(lambda: 1)
+
+    with pytest.raises(ValueError, match="working_dir"):
+        ray_tpu.remote(runtime_env={"working_dir": "/nonexistent_xyz"})(
+            lambda: 1)

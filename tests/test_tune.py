@@ -1,0 +1,213 @@
+"""ray_tpu.tune: searchers, ASHA, trial controller, resume.
+
+Mirrors the reference's tune test strategy (tune/tests/test_tune_*):
+variant generation units, scheduler decision units, then controller
+end-to-end sweeps with real trial actors — including the VERDICT r2
+gate: an lr sweep on the tiny transformer where ASHA kills
+underperformers and the best trial's checkpoint comes back.
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import CheckpointConfig, RunConfig
+from ray_tpu.tune.schedulers import CONTINUE, STOP
+from ray_tpu.tune.tuner import ERROR, STOPPED, TERMINATED, TuneConfig
+
+
+# ------------------------------------------------------------- search
+def test_grid_search_cross_product():
+    gen = tune.BasicVariantGenerator()
+    cfgs = list(gen.variants({"a": tune.grid_search([1, 2, 3]),
+                              "b": tune.grid_search(["x", "y"]),
+                              "c": 42}))
+    assert len(cfgs) == 6
+    assert all(c["c"] == 42 for c in cfgs)
+    assert {(c["a"], c["b"]) for c in cfgs} == {
+        (a, b) for a in (1, 2, 3) for b in ("x", "y")}
+
+
+def test_stochastic_domains_and_num_samples():
+    gen = tune.BasicVariantGenerator(seed=7)
+    cfgs = list(gen.variants({"lr": tune.loguniform(1e-5, 1e-1),
+                              "h": tune.choice([32, 64]),
+                              "n": tune.randint(0, 10),
+                              "u": tune.uniform(-1, 1)}, num_samples=20))
+    assert len(cfgs) == 20
+    assert all(1e-5 <= c["lr"] <= 1e-1 for c in cfgs)
+    assert {c["h"] for c in cfgs} <= {32, 64}
+    assert len({c["lr"] for c in cfgs}) > 10       # actually sampling
+    # deterministic under the same seed
+    again = list(tune.BasicVariantGenerator(seed=7).variants(
+        {"lr": tune.loguniform(1e-5, 1e-1), "h": tune.choice([32, 64]),
+         "n": tune.randint(0, 10), "u": tune.uniform(-1, 1)},
+        num_samples=20))
+    assert [c["lr"] for c in again] == [c["lr"] for c in cfgs]
+
+
+# ---------------------------------------------------------- scheduler
+def test_asha_stops_bottom_of_rung():
+    sched = tune.ASHAScheduler(metric="acc", mode="max", max_t=100,
+                               grace_period=2, reduction_factor=4)
+    # 8 trials reach rung t=2 in DESCENDING quality: later reporters
+    # fall below the rung's top-1/rf cutoff and must stop.
+    decisions = {}
+    for i in range(8):
+        decisions[i] = sched.on_result(f"t{i}", 2, {"acc": float(7 - i)})
+    assert decisions[0] == CONTINUE          # too early to judge
+    assert all(decisions[i] == STOP for i in range(3, 8)), decisions
+    # a later strong arrival at the same rung continues
+    assert sched.on_result("t9", 2, {"acc": 100.0}) == CONTINUE
+
+
+def test_asha_max_t_budget():
+    sched = tune.ASHAScheduler(metric="acc", mode="max", max_t=5,
+                               grace_period=1)
+    assert sched.on_result("t", 5, {"acc": 1.0}) == STOP
+
+
+def test_asha_min_mode():
+    sched = tune.ASHAScheduler(metric="loss", mode="min", max_t=100,
+                               grace_period=1, reduction_factor=2)
+    sched.on_result("a", 1, {"loss": 0.1})
+    sched.on_result("b", 1, {"loss": 0.2})
+    assert sched.on_result("c", 1, {"loss": 5.0}) == STOP
+    assert sched.on_result("d", 1, {"loss": 0.01}) == CONTINUE
+
+
+# ------------------------------------------------------- controller e2e
+def make_quadratic_trainable():
+    def trainable(config):
+        from ray_tpu import tune as rt_tune
+        x = config["x"]
+        for step in range(4):
+            rt_tune.report({"score": -(x - 3.0) ** 2, "step": step})
+    return trainable
+
+
+def test_tuner_grid_sweep_best_result(ray_cluster, tmp_path):
+    tuner = tune.Tuner(
+        make_quadratic_trainable(),
+        param_space={"x": tune.grid_search([0.0, 2.0, 3.0, 5.0])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               max_concurrent_trials=2),
+        run_config=RunConfig(name="quad", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 4
+    assert grid.num_errors == 0
+    assert all(t.status == TERMINATED for t in grid.trials)
+    best = grid.get_best_result()
+    assert best.metrics["config"]["x"] == 3.0
+    assert best.metrics["score"] == 0.0
+
+
+def test_tuner_trial_error_isolated(ray_cluster, tmp_path):
+    def make_trainable():
+        def trainable(config):
+            from ray_tpu import tune as rt_tune
+            if config["x"] == 1:
+                raise RuntimeError("bad trial")
+            rt_tune.report({"score": float(config["x"])})
+        return trainable
+
+    grid = tune.Tuner(
+        make_trainable(),
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="err", storage_path=str(tmp_path)),
+    ).fit()
+    assert grid.num_errors == 1
+    assert grid.get_best_result().metrics["config"]["x"] == 2
+
+
+def test_tuner_asha_kills_underperformers_tiny_transformer(
+        ray_cluster, tmp_path):
+    """VERDICT r2 item 6 gate: lr sweep on the tiny transformer; ASHA
+    stops hopeless lrs early; the best trial's checkpoint is returned
+    and loadable."""
+    def make_trainable():
+        def trainable(config):
+            import jax
+            import numpy as _np
+            import optax
+
+            from ray_tpu import tune as rt_tune
+            from ray_tpu.models import Transformer
+            from ray_tpu.models.config import tiny
+            from ray_tpu.train import Checkpoint
+            from ray_tpu.train.session import make_temp_checkpoint_dir
+
+            cfg = tiny(vocab_size=64)
+            model = Transformer(cfg)
+            params = model.init(jax.random.PRNGKey(0))
+            opt = optax.adam(config["lr"])
+            opt_state = opt.init(params)
+            tokens = _np.asarray(
+                jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                   cfg.vocab_size))
+
+            @jax.jit
+            def step(p, s):
+                loss, g = jax.value_and_grad(model.loss)(
+                    p, {"tokens": tokens})
+                up, s = opt.update(g, s)
+                return optax.apply_updates(p, up), s, loss
+
+            for i in range(6):
+                params, opt_state, loss = step(params, opt_state)
+                d = make_temp_checkpoint_dir()
+                ckpt = Checkpoint.from_state(
+                    d, {"params": params, "lr": _np.float64(config["lr"])})
+                rt_tune.report({"loss": float(loss), "iter": i}, ckpt)
+        return trainable
+
+    tuner = tune.Tuner(
+        make_trainable(),
+        # 1e-300 can't learn anything; 1e-2 learns fast on the tiny model
+        param_space={"lr": tune.grid_search([1e-300, 1e-300, 1e-300,
+                                             1e-2])},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", max_concurrent_trials=2,
+            scheduler=tune.ASHAScheduler(
+                metric="loss", mode="min", max_t=6, grace_period=2,
+                reduction_factor=2)),
+        run_config=RunConfig(
+            name="lr_sweep", storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=1, checkpoint_score_attribute="loss",
+                checkpoint_score_order="min")))
+    grid = tuner.fit()
+    statuses = [t.status for t in grid.trials]
+    assert statuses.count(STOPPED) >= 1, statuses   # ASHA killed some
+    best = grid.get_best_result()
+    assert best.metrics["config"]["lr"] == 1e-2
+    assert best.checkpoint is not None
+    state = best.checkpoint.load_state()
+    assert float(state["lr"]) == 1e-2               # right trial's ckpt
+
+
+def test_tuner_resume_from_experiment_state(ray_cluster, tmp_path):
+    """Completed trials keep results on restore; unfinished re-run."""
+    trainable = make_quadratic_trainable()
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1.0, 3.0])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="res", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    exp_dir = grid.path
+
+    # corrupt one trial back to PENDING, as if interrupted mid-flight
+    import json
+    import os
+    sp = os.path.join(exp_dir, "experiment_state.json")
+    state = json.load(open(sp))
+    state["trials"][0]["status"] = "RUNNING"   # interrupted
+    json.dump(state, open(sp, "w"))
+
+    restored = tune.Tuner.restore(exp_dir, trainable)
+    grid2 = restored.fit()
+    assert len(grid2) == 2
+    assert all(t.status == TERMINATED for t in grid2.trials)
+    assert grid2.get_best_result().metrics["config"]["x"] == 3.0

@@ -68,6 +68,11 @@ class ClusterTaskManager:
 
     def __init__(self, runtime):
         self._rt = runtime
+        # With an autoscaler attached, "no node fits" is pending demand
+        # (capacity may be provisioned), not a hard error; the
+        # Autoscaler flips this (reference: feasibility is judged
+        # against node TYPES, not live nodes, when autoscaling).
+        self.autoscaling_enabled = False
         self._lock = threading.RLock()
         self._nodes: Dict[str, NodeRecord] = {}
         self._pgs: Dict[str, PGRecord] = {}
@@ -91,6 +96,7 @@ class ClusterTaskManager:
             self._nodes[node_id] = rec
         self._rt.controller.register_node(node_id, resources,
                                           is_head=is_head, labels=labels)
+        self._rt.controller.publish_node_event(node_id, "ALIVE")
         sched.start()
         # New capacity: retry anything parked as infeasible + pending PGs.
         self._retry_infeasible()
@@ -296,7 +302,10 @@ class ClusterTaskManager:
 
     def _check_feasible_ever(self, pg: PGRecord) -> None:
         """Raise if no future availability could ever satisfy the PG
-        (VERDICT r1: unschedulable must raise, not silently ignore)."""
+        (VERDICT r1: unschedulable must raise, not silently ignore).
+        Skipped under autoscaling: new capacity can appear."""
+        if self.autoscaling_enabled:
+            return
         nodes = self.alive_nodes()
         if pg.strategy == "STRICT_SPREAD":
             if len(pg.bundles) > len(nodes):
@@ -468,6 +477,17 @@ class ClusterTaskManager:
                 "bundles": pg.bundles, "strategy": pg.strategy,
                 "name": pg.name, "bundle_nodes": list(pg.bundle_nodes)}
 
+    def cancel_parked(self, task_id: str):
+        """Remove + return a task parked as infeasible (cancel path:
+        parked tasks are in NO node queue, so node-level cancel misses
+        them)."""
+        with self._lock:
+            for spec in list(self._infeasible):
+                if getattr(spec, "task_id", None) == task_id:
+                    self._infeasible.remove(spec)
+                    return spec
+        return None
+
     def pg_table(self) -> List[dict]:
         with self._lock:
             return [self.pg_table_entry(pg) for pg in self._pgs.values()]
@@ -493,6 +513,8 @@ class ClusterTaskManager:
             if rec is None or not rec.alive:
                 return
             rec.alive = False
+            self._rt.controller.publish_node_event(node_id, "DEAD",
+                                                   cause=cause)
         self._rt.controller.set_node_state(node_id, alive=False,
                                            cause=cause)
         # 1. Tear down the node's workers; collect its queue + running work.

@@ -70,6 +70,8 @@ class Controller:
         self._nodes: dict[str, NodeTableRecord] = {}
         self._task_events: collections.deque = collections.deque(
             maxlen=task_event_capacity)
+        from ray_tpu._private.pubsub import Publisher
+        self.pubsub = Publisher()
         self._job_start = time.time()
 
     # ---- KV (GcsInternalKVManager parity) ----
@@ -185,6 +187,10 @@ class Controller:
             if state == DEAD and rec.spec.name is not None:
                 self._named_actors.pop(
                     (rec.spec.namespace, rec.spec.name), None)
+        from ray_tpu._private.pubsub import ACTOR_CHANNEL
+        self.pubsub.publish(ACTOR_CHANNEL, {
+            "actor_id": actor_id, "state": state,
+            "death_cause": death_cause})
 
     def list_actors(self) -> list[dict]:
         with self._lock:
@@ -206,6 +212,12 @@ class Controller:
             return [dict(e) for e in self._pgs.values()]
 
     # ---- node table (GcsNodeManager parity) ----
+    def publish_node_event(self, node_id: str, state: str,
+                           cause: str = "") -> None:
+        from ray_tpu._private.pubsub import NODE_CHANNEL
+        self.pubsub.publish(NODE_CHANNEL, {
+            "node_id": node_id, "state": state, "cause": cause})
+
     def register_node(self, node_id: str, resources: dict,
                       is_head: bool = False,
                       labels: Optional[dict] = None) -> None:

@@ -43,6 +43,7 @@ KV_OP = "kv_op"                  # worker -> driver: internal KV get/put/del
 DECREF = "decref"                # worker -> driver: ref-count release
 ADDREF = "addref"                # worker -> driver
 SHUTDOWN = "shutdown"            # driver -> worker
+CANCEL_TASK = "cancel_task"      # driver -> worker: interrupt a running task
 PING = "ping"                    # either
 REPLY = "reply"                  # either (generic reply)
 STATE_OP = "state_op"            # worker -> driver: state/metrics queries

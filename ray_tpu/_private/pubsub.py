@@ -1,0 +1,80 @@
+"""Pubsub: cursor-based channels on the control plane.
+
+Parity: reference src/ray/pubsub (long-poll publisher/subscriber used
+for actor/node/error channels) — re-shaped for this topology: the
+driver-resident `Publisher` keeps a bounded ring per channel; consumers
+poll with a cursor (workers via the STATE_OP RPC, driver-side readers
+directly), which gives the same at-least-once-in-order contract the
+reference's long-poll delivers without a push socket per subscriber.
+
+Wired publications: node lifecycle (cluster) and actor lifecycle
+(controller) — the channels the reference's GCS publishes.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+# Well-known channels (reference rpc::ChannelType)
+NODE_CHANNEL = "node"
+ACTOR_CHANNEL = "actor"
+ERROR_CHANNEL = "error"
+
+
+class StaleCursorError(Exception):
+    """The cursor predates the retained window: messages were evicted
+    and are unrecoverable (the caller must resync its view)."""
+
+
+class Publisher:
+    def __init__(self, maxlen_per_channel: int = 1000):
+        self._lock = threading.Condition()
+        self._maxlen = maxlen_per_channel
+        # channel -> (next_seq, ring of (seq, ts, message))
+        self._channels: Dict[str, Tuple[int, deque]] = {}
+
+    def publish(self, channel: str, message: Any) -> int:
+        with self._lock:
+            seq, ring = self._channels.get(channel, (0, None))
+            if ring is None:
+                ring = deque(maxlen=self._maxlen)
+            ring.append((seq, time.time(), message))
+            self._channels[channel] = (seq + 1, ring)
+            self._lock.notify_all()
+            return seq
+
+    def poll(self, channel: str, cursor: int = 0,
+             timeout: Optional[float] = None
+             ) -> Tuple[List[Any], int]:
+        """Messages with seq >= cursor and the next cursor. With a
+        timeout, blocks until at least one message lands (long-poll)."""
+        deadline = None if timeout is None else time.time() + timeout
+
+        def fetch():
+            seq, ring = self._channels.get(channel, (0, None))
+            if ring is None:
+                return [], 0
+            if ring and cursor < ring[0][0]:
+                # at-least-once contract: never silently skip evicted
+                # messages — the subscriber fell too far behind
+                raise StaleCursorError(
+                    f"channel {channel!r}: cursor {cursor} predates "
+                    f"oldest retained seq {ring[0][0]}")
+            msgs = [(s, m) for s, _, m in ring if s >= cursor]
+            return msgs, seq
+
+        with self._lock:
+            msgs, next_cursor = fetch()
+            while not msgs and deadline is not None:
+                left = deadline - time.time()
+                if left <= 0:
+                    break
+                self._lock.wait(timeout=min(left, 0.25))
+                msgs, next_cursor = fetch()
+            return [m for _, m in msgs], max(next_cursor, cursor)
+
+    def channels(self) -> List[str]:
+        with self._lock:
+            return sorted(self._channels)

@@ -142,6 +142,13 @@ class Runtime(_context.BaseContext):
     def _recover_task(self, spec: TaskSpec) -> None:
         """Reference parity: task retries on worker failure
         (task_manager.cc retry bookkeeping; max_retries option)."""
+        if getattr(spec, "cancelled", False):
+            self._store_error(spec.return_ids, TaskError(
+                TaskCancelledError(spec.task_id), task_name=spec.name))
+            self._unpin(spec.pinned_refs)
+            self.controller.record_task_event(
+                spec.task_id, spec.name, "CANCELLED")
+            return
         if spec.retries_used < spec.max_retries:
             spec.retries_used += 1
             self.controller.record_task_event(
@@ -554,18 +561,47 @@ class Runtime(_context.BaseContext):
                 sched.kill_worker(wid)
 
     def cancel_task(self, object_id: str, force: bool = False) -> None:
-        # v0: cancel only reaches queued (not yet running) tasks, matching
-        # the reference's non-force semantics for unscheduled tasks.
+        """Cancel a task by its return ref (reference core_worker
+        CancelTask): queued tasks are removed; RUNNING tasks get
+        TaskCancelledError raised in their executor thread, or their
+        worker killed outright with force=True. Either way the task is
+        marked non-retriable first so worker-death recovery doesn't
+        resurrect it."""
         # Return ids are "<task_id>r<i>" and task ids are hex, so 'r' splits.
         task_id = object_id.split("r", 1)[0]
-        spec = self.scheduler.cancel_pending(task_id)
+        for node in self.cluster.alive_nodes():
+            spec = node.scheduler.cancel_pending(task_id)
+            if spec is not None:
+                err = TaskCancelledError(task_id)
+                self._store_error(spec.return_ids, TaskError(
+                    err, task_name=spec.name))
+                self._unpin(spec.pinned_refs)
+                self.controller.record_task_event(task_id, spec.name,
+                                                  "CANCELLED")
+                return
+        # parked as infeasible (autoscaler may be provisioning)?
+        spec = self.cluster.cancel_parked(task_id)
         if spec is not None:
-            err = TaskCancelledError(task_id)
             self._store_error(spec.return_ids, TaskError(
-                err, task_name=spec.name))
+                TaskCancelledError(task_id), task_name=spec.name))
             self._unpin(spec.pinned_refs)
             self.controller.record_task_event(task_id, spec.name,
                                               "CANCELLED")
+            return
+        # not queued: running somewhere?
+        for node in self.cluster.alive_nodes():
+            hit = node.scheduler.worker_running_task(task_id)
+            if hit is None:
+                continue
+            worker_id, spec = hit
+            spec.cancelled = True        # no retry on worker death
+            self.controller.record_task_event(task_id, spec.name,
+                                              "CANCELLING")
+            if force:
+                node.scheduler.kill_worker(worker_id)
+            else:
+                node.scheduler.cancel_running(worker_id, task_id)
+            return
 
     def get_actor_handle(self, name: str, namespace: str = "default"):
         actor_id = self.controller.get_named_actor(name, namespace)
@@ -602,6 +638,17 @@ class Runtime(_context.BaseContext):
             return self.cluster.stats()
         if op == "object_store_stats":
             return self.store.stats()
+        if op == "pubsub_poll":
+            return self.controller.pubsub.poll(
+                kwargs["channel"], kwargs.get("cursor", 0),
+                kwargs.get("timeout"))
+        if op == "pubsub_publish":
+            return self.controller.pubsub.publish(
+                kwargs["channel"], kwargs["message"])
+        if op == "cancel_task":
+            self.cancel_task(kwargs["object_id"],
+                             kwargs.get("force", False))
+            return True
         if op == "kill_actor":
             self.kill_actor(kwargs["actor_id"],
                             kwargs.get("no_restart", True))

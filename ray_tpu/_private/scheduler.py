@@ -248,6 +248,27 @@ class Scheduler:
             self._cv.notify_all()
             return task, actor_id
 
+    def worker_running_task(self, task_id: str):
+        """(worker_id, spec) currently executing task_id, or None."""
+        with self._lock:
+            for rec in self._workers.values():
+                if (rec.state == BUSY and rec.task is not None
+                        and rec.task.task_id == task_id):
+                    return rec.worker_id, rec.task
+        return None
+
+    def cancel_running(self, worker_id: str, task_id: str) -> bool:
+        with self._lock:
+            rec = self._workers.get(worker_id)
+        if rec is None or rec.conn is None:
+            return False
+        try:
+            rec.conn.send({"type": protocol.CANCEL_TASK,
+                           "task_id": task_id})
+            return True
+        except protocol.ConnectionClosed:
+            return False
+
     def kill_worker(self, worker_id: str) -> None:
         with self._lock:
             rec = self._workers.get(worker_id)
@@ -342,6 +363,33 @@ class Scheduler:
             for k, v in self._pending_demand.items():
                 eff[k] = eff.get(k, 0.0) - v
             return eff
+
+    def pending_shapes(self) -> list[dict[str, float]]:
+        """Resource shapes of queued specs beyond current availability
+        (autoscaler demand units): simulate dispatch against a copy of
+        avail; what doesn't fit is unmet demand."""
+        with self._lock:
+            eff = dict(self.avail)
+            unmet = []
+            for spec in self._pending:
+                need = self._effective_need(spec)
+                if fits(eff, need):
+                    acquire(eff, need)
+                else:
+                    unmet.append(need)
+            return unmet
+
+    def is_idle(self) -> bool:
+        """Nothing queued, nothing running, no PG bundles, full
+        availability — evaluated atomically (autoscaler scale-down)."""
+        with self._lock:
+            if self._pending or self._bundles or self._spawning:
+                return False
+            if any(r.state in (BUSY, ACTOR) for r in
+                   self._workers.values()):
+                return False
+            return all(abs(self.avail.get(k, 0.0) - v) < 1e-6
+                       for k, v in self.total.items())
 
     def utilization(self) -> float:
         """Max per-resource utilization fraction incl. queued demand

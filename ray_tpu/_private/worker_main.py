@@ -123,7 +123,7 @@ class WorkerContext(_context.BaseContext):
         self.state_op("kill_actor", actor_id=actor_id)
 
     def cancel_task(self, object_id: str, force: bool = False) -> None:
-        pass
+        self.state_op("cancel_task", object_id=object_id, force=force)
 
     # ---- control plane ----
     def kv_op(self, op: str, key: str, value: Any = None,
@@ -201,6 +201,8 @@ class WorkerExecutor:
     def __init__(self, ctx: WorkerContext):
         self.ctx = ctx
         self._fn_cache: dict[str, Any] = {}
+        self._running_tasks: dict[str, threading.Thread] = {}
+        self._cancel_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=1,
                                         thread_name_prefix="rtpu-exec")
         self._actor: Any = None
@@ -230,10 +232,32 @@ class WorkerExecutor:
                     self._run_actor_task_async(aspec), self._loop)
             else:
                 self._pool.submit(self._run_actor_task, aspec)
+        elif mtype == protocol.CANCEL_TASK:
+            self._cancel_running(msg["task_id"])
         elif mtype == protocol.SHUTDOWN:
             self.stop_event.set()
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)
+
+    def _cancel_running(self, task_id: str) -> None:
+        """Interrupt a running task by raising TaskCancelledError in its
+        executor thread (reference CancelTask path: the worker raises in
+        the executing thread; tasks blocked in C extensions only observe
+        it at the next bytecode boundary — same limitation there)."""
+        import ctypes
+
+        from ray_tpu.exceptions import TaskCancelledError
+        with self._cancel_lock:
+            # registration is popped under this same lock with the
+            # pending-exception cleared, so a cancel can never land on a
+            # thread after its task is done (it would brick the reused
+            # pool thread)
+            thread = self._running_tasks.get(task_id)
+            if thread is None or not thread.is_alive():
+                return
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(thread.ident),
+                ctypes.py_object(TaskCancelledError))
 
     def _ensure_loop(self) -> None:
         if self._loop is None:
@@ -289,6 +313,7 @@ class WorkerExecutor:
 
     def _run_task(self, spec: TaskSpec) -> None:
         undo = None
+        self._running_tasks[spec.task_id] = threading.current_thread()
         try:
             # env first: the function/args may only UNPICKLE under the
             # declared working_dir/env (the actor path does the same).
@@ -303,6 +328,14 @@ class WorkerExecutor:
                 e, format_exception(e), task_name=spec.name)
             error = True
         finally:
+            import ctypes
+            with self._cancel_lock:
+                self._running_tasks.pop(spec.task_id, None)
+                # clear any not-yet-delivered async cancel: the task is
+                # over; a raced cancel must not detonate in the pool
+                # thread's idle loop or in _send_results below
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_long(threading.get_ident()), None)
             if undo is not None:
                 _revert_runtime_env(undo)
         self._send_results(spec.task_id, spec.return_ids, result,

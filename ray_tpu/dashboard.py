@@ -1,0 +1,117 @@
+"""Dashboard: HTTP observability endpoints + minimal HTML view.
+
+Parity (shape): reference dashboard head (dashboard/head.py:61) with
+its per-entity modules — reduced to a driver-thread HTTP server over
+the state API + metrics registry. Endpoints:
+
+  GET /api/nodes /api/actors /api/tasks /api/placement_groups
+  GET /api/cluster      (total/available resources + object store)
+  GET /api/task_summary
+  GET /metrics          (Prometheus exposition of util.metrics)
+  GET /                 (HTML tables auto-refreshing off the JSON API)
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+_SERVER = None
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title><style>
+body{font-family:monospace;margin:1.5em;background:#111;color:#ddd}
+h2{color:#7ac}table{border-collapse:collapse;margin-bottom:1.5em}
+td,th{border:1px solid #444;padding:3px 9px;text-align:left}
+th{background:#223}</style></head><body>
+<h1>ray_tpu</h1>
+<div id="out">loading…</div>
+<script>
+const SECTIONS = ["cluster","nodes","actors","task_summary",
+                  "placement_groups"];
+function table(rows){
+  if(!Array.isArray(rows)) rows=[rows];
+  if(!rows.length) return "<i>none</i>";
+  const keys=Object.keys(rows[0]);
+  return "<table><tr>"+keys.map(k=>`<th>${k}</th>`).join("")+"</tr>"+
+    rows.map(r=>"<tr>"+keys.map(k=>
+      `<td>${JSON.stringify(r[k])}</td>`).join("")+"</tr>").join("")+
+    "</table>";
+}
+async function refresh(){
+  let html="";
+  for(const s of SECTIONS){
+    const r=await fetch("/api/"+s); const data=await r.json();
+    html+=`<h2>${s}</h2>`+table(data);
+  }
+  document.getElementById("out").innerHTML=html;
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>"""
+
+
+def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> int:
+    """Serve the dashboard from the driver; returns the bound port."""
+    global _SERVER
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from ray_tpu.util import state as state_api
+    from ray_tpu.util.metrics import DEFAULT_REGISTRY
+
+    def api(path: str):
+        if path == "nodes":
+            return state_api.list_nodes()
+        if path == "actors":
+            return state_api.list_actors()
+        if path == "tasks":
+            return state_api.list_tasks()
+        if path == "task_summary":
+            return state_api.summarize_tasks()
+        if path == "placement_groups":
+            return state_api.list_placement_groups()
+        if path == "cluster":
+            return {"total": state_api.cluster_resources(),
+                    "available": state_api.available_resources(),
+                    "object_store": state_api.object_store_stats()}
+        raise KeyError(path)
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            try:
+                if self.path == "/" or self.path == "/index.html":
+                    body = _INDEX_HTML.encode()
+                    ctype = "text/html"
+                elif self.path == "/metrics":
+                    body = DEFAULT_REGISTRY.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/api/"):
+                    body = json.dumps(api(self.path[5:]),
+                                      default=str).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+            except BaseException as e:  # noqa: BLE001
+                body = json.dumps({"error": repr(e)}).encode()
+                ctype = "application/json"
+                self.send_response(500)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    _SERVER = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=_SERVER.serve_forever, daemon=True).start()
+    return _SERVER.server_address[1]
+
+
+def stop_dashboard() -> None:
+    global _SERVER
+    if _SERVER is not None:
+        _SERVER.shutdown()
+        _SERVER = None

@@ -1,0 +1,141 @@
+"""Job submission: run driver scripts as managed subprocesses.
+
+Parity: reference dashboard/modules/job (JobSubmissionClient + JobManager
+driving a supervisor that spawns the entrypoint with its runtime_env,
+tracking status and capturing logs). Re-shaped for this stack: jobs are
+subprocesses of the submitting driver's host (the single-head topology),
+with env fanout, captured logs, status polling, and stop.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import tempfile
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+
+
+@dataclasses.dataclass
+class JobInfo:
+    job_id: str
+    entrypoint: str
+    status: str = PENDING
+    return_code: Optional[int] = None
+    submitted_at: float = dataclasses.field(default_factory=time.time)
+    ended_at: Optional[float] = None
+    log_path: str = ""
+    metadata: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class JobSubmissionClient:
+    """Submit/inspect/stop jobs (reference JobSubmissionClient API:
+    submit_job, get_job_status, get_job_logs, list_jobs, stop_job)."""
+
+    def __init__(self, log_dir: Optional[str] = None):
+        self._log_dir = log_dir or os.path.join(
+            tempfile.gettempdir(), f"rtpu_jobs_{os.getpid()}")
+        os.makedirs(self._log_dir, exist_ok=True)
+        self._jobs: Dict[str, JobInfo] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[Dict[str, str]] = None,
+                   job_id: Optional[str] = None) -> str:
+        from ray_tpu.api import validate_runtime_env
+        renv = validate_runtime_env(runtime_env) or {}
+        job_id = job_id or "job_" + uuid.uuid4().hex[:10]
+        with self._lock:
+            if job_id in self._jobs:
+                raise ValueError(f"job {job_id!r} already exists")
+        log_path = os.path.join(self._log_dir, f"{job_id}.log")
+        env = dict(os.environ)
+        env.update(renv.get("env_vars") or {})
+        env["RAY_TPU_JOB_ID"] = job_id
+        cwd = renv.get("working_dir") or None
+        info = JobInfo(job_id=job_id, entrypoint=entrypoint,
+                       log_path=log_path, metadata=dict(metadata or {}))
+        log_f = open(log_path, "wb")
+        proc = subprocess.Popen(
+            entrypoint, shell=True, stdout=log_f, stderr=log_f,
+            env=env, cwd=cwd)
+        log_f.close()
+        info.status = RUNNING
+        with self._lock:
+            self._jobs[job_id] = info
+            self._procs[job_id] = proc
+        threading.Thread(target=self._reap, args=(job_id,),
+                         daemon=True).start()
+        return job_id
+
+    def _reap(self, job_id: str) -> None:
+        proc = self._procs[job_id]
+        rc = proc.wait()
+        with self._lock:
+            info = self._jobs[job_id]
+            if info.status == RUNNING:
+                info.status = SUCCEEDED if rc == 0 else FAILED
+            info.return_code = rc
+            info.ended_at = time.time()
+
+    def get_job_status(self, job_id: str) -> str:
+        return self._info(job_id).status
+
+    def get_job_info(self, job_id: str) -> JobInfo:
+        return self._info(job_id)
+
+    def get_job_logs(self, job_id: str) -> str:
+        info = self._info(job_id)
+        try:
+            with open(info.log_path, "r", errors="replace") as f:
+                return f.read()
+        except FileNotFoundError:
+            return ""
+
+    def list_jobs(self) -> List[JobInfo]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def stop_job(self, job_id: str) -> bool:
+        info = self._info(job_id)
+        proc = self._procs.get(job_id)
+        if proc is None or proc.poll() is not None:
+            return False
+        with self._lock:
+            info.status = STOPPED
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        return True
+
+    def wait_until_finished(self, job_id: str,
+                            timeout: float = 300.0) -> str:
+        deadline = time.time() + timeout
+        status = self.get_job_status(job_id)
+        while True:
+            if status in (SUCCEEDED, FAILED, STOPPED):
+                return status
+            if time.time() >= deadline:
+                raise TimeoutError(f"job {job_id} still {status} after "
+                                   f"{timeout}s")
+            time.sleep(0.2)
+            status = self.get_job_status(job_id)
+
+    def _info(self, job_id: str) -> JobInfo:
+        with self._lock:
+            info = self._jobs.get(job_id)
+        if info is None:
+            raise ValueError(f"no job {job_id!r}")
+        return info

@@ -1,3 +1,4 @@
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, QModule
 from ray_tpu.rllib.algorithms.impala import (IMPALA, IMPALAConfig,
                                              IMPALALearner,
                                              IMPALALearnerConfig,
@@ -5,4 +6,5 @@ from ray_tpu.rllib.algorithms.impala import (IMPALA, IMPALAConfig,
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 
 __all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "IMPALALearner",
-           "IMPALALearnerConfig", "vtrace_returns"]
+           "IMPALALearnerConfig", "vtrace_returns", "DQN", "DQNConfig",
+           "QModule"]

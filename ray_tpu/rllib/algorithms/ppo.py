@@ -95,6 +95,7 @@ class PPO:
                 num_epochs=config.num_epochs,
                 num_minibatches=config.num_minibatches,
                 target_kl=config.target_kl,
+                continuous=self._continuous,
                 seed=config.seed),
             num_learners=config.num_learners)
         self.iteration = 0
@@ -106,7 +107,10 @@ class PPO:
         import gymnasium as gym
         env = gym.make(self.config.env)
         self._obs_dim = int(np.prod(env.observation_space.shape))
-        self._num_actions = int(env.action_space.n)
+        space = env.action_space
+        self._continuous = not hasattr(space, "n")
+        self._num_actions = (int(np.prod(space.shape))
+                             if self._continuous else int(space.n))
         env.close()
 
     # ------------------------------------------------------------ api

@@ -43,6 +43,7 @@ class PPOLearnerConfig:
     num_epochs: int = 4
     num_minibatches: int = 4
     target_kl: float = 0.03   # stop epoch/minibatch SGD when exceeded
+    continuous: bool = False  # Box action space (diag-gaussian head)
     seed: int = 0
     # Data-parallel width INSIDE the learner: the batch's env axis is
     # sharded over a `dp` mesh of this many local devices and XLA
@@ -73,7 +74,8 @@ class PPOLearner:
         pin_platform_from_env()
         self.config = config
         self.module = module or ActorCriticModule(
-            config.obs_dim, config.num_actions, tuple(config.hidden))
+            config.obs_dim, config.num_actions, tuple(config.hidden),
+            continuous=config.continuous)
         self.mesh = mesh
         self._tx = optax.chain(
             optax.clip_by_global_norm(config.max_grad_norm),
@@ -137,7 +139,7 @@ class PPOLearner:
 
         def loss_fn(params, mb):
             logits, value = module.forward(params, mb["obs"])
-            logp = Categorical.log_prob(logits, mb["actions"])
+            logp = module.dist_log_prob(params, logits, mb["actions"])
             ratio = jnp.exp(logp - mb["logp"])
             adv = mb["adv"]
             pg = -jnp.minimum(
@@ -147,7 +149,7 @@ class PPOLearner:
             v_clipped = mb["vpred"] + jnp.clip(
                 value - mb["vpred"], -c.vf_clip, c.vf_clip)
             v_err = jnp.maximum(v_err, jnp.square(v_clipped - mb["vtarg"]))
-            ent = Categorical.entropy(logits)
+            ent = module.dist_entropy(params, logits)
             m = mb["mask"]
             denom = jnp.maximum(jnp.sum(m), 1.0)
             pg_loss = jnp.sum(pg * m) / denom
@@ -174,9 +176,11 @@ class PPOLearner:
             var = jnp.sum(jnp.square(adv - mu) * mask) / denom
             adv = (adv - mu) * jax.lax.rsqrt(var + 1e-8)
 
+            act = batch["actions"]
             flat = {
                 "obs": obs[:-1].reshape(T * N, -1),
-                "actions": batch["actions"].reshape(T * N),
+                "actions": (act.reshape(T * N, -1) if act.ndim == 3
+                            else act.reshape(T * N)),
                 "logp": batch["logp"].reshape(T * N),
                 "adv": adv.reshape(T * N),
                 "vtarg": vtarg.reshape(T * N),

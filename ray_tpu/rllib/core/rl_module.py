@@ -37,18 +37,51 @@ class Categorical:
         return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
 
 
+_LOG_2PI = 1.8378770664093453
+
+
+class DiagGaussian:
+    """Diagonal gaussian over continuous actions (state-independent
+    log_std, the reference's default for Box spaces). All shapes
+    (..., A); log_prob/entropy reduce over the action dim."""
+
+    @staticmethod
+    def sample(mean: jax.Array, log_std: jax.Array,
+               key: jax.Array) -> jax.Array:
+        return mean + jnp.exp(log_std) * jax.random.normal(
+            key, mean.shape)
+
+    @staticmethod
+    def log_prob(mean: jax.Array, log_std: jax.Array,
+                 actions: jax.Array) -> jax.Array:
+        z = (actions - mean) * jnp.exp(-log_std)
+        return jnp.sum(-0.5 * jnp.square(z) - log_std - 0.5 * _LOG_2PI,
+                       axis=-1)
+
+    @staticmethod
+    def entropy(log_std: jax.Array,
+                like: jax.Array) -> jax.Array:
+        """Entropy broadcast to `like`'s leading shape (state-independent
+        std makes it constant per state)."""
+        ent = jnp.sum(log_std + 0.5 * (_LOG_2PI + 1.0), axis=-1)
+        return jnp.broadcast_to(ent, like.shape[:-1])
+
+
 @dataclasses.dataclass(frozen=True)
 class ActorCriticModule:
-    """MLP torso with separate policy/value heads (discrete actions).
+    """MLP torso with separate policy/value heads.
 
     Mirrors the reference's default RLModule for classic-control tasks
     (rllib/core/rl_module/default_model_config.py): tanh MLP encoder,
-    categorical action head, scalar value head.
+    scalar value head, and either a categorical head (Discrete spaces;
+    `num_actions` = n) or a diag-gaussian head with state-independent
+    log_std (Box spaces; `continuous=True`, `num_actions` = action dim).
     """
 
     obs_dim: int
     num_actions: int
     hidden: Sequence[int] = (64, 64)
+    continuous: bool = False
 
     def init(self, key: jax.Array) -> Params:
         keys = jax.random.split(key, 2 * len(self.hidden) + 2)
@@ -69,7 +102,24 @@ class ActorCriticModule:
                 din = h
             layers.append(dense(next(ki), din, out_dim, out_scale))
             params[head] = layers
+        if self.continuous:
+            params["log_std"] = jnp.zeros((self.num_actions,),
+                                          jnp.float32)
         return params
+
+    # ------------------------------------------- distribution dispatch
+    def dist_log_prob(self, params: Params, pi_out: jax.Array,
+                      actions: jax.Array) -> jax.Array:
+        if self.continuous:
+            return DiagGaussian.log_prob(pi_out, params["log_std"],
+                                         actions)
+        return Categorical.log_prob(pi_out, actions)
+
+    def dist_entropy(self, params: Params,
+                     pi_out: jax.Array) -> jax.Array:
+        if self.continuous:
+            return DiagGaussian.entropy(params["log_std"], pi_out)
+        return Categorical.entropy(pi_out)
 
     @staticmethod
     def _mlp(layers, x):
@@ -108,10 +158,20 @@ class ActorCriticModule:
             x = np.tanh(x @ layer["w"] + layer["b"])
         return x @ layers[-1]["w"] + layers[-1]["b"]
 
-    @staticmethod
-    def sample_np(logits, rng):
-        """Categorical sample + log-prob in numpy (Gumbel-max trick)."""
+    def sample_np(self, logits, rng, params_np: Params = None):
+        """Numpy action sample + log-prob (env-runner side).
+
+        Discrete: Gumbel-max categorical. Continuous (needs params_np
+        for log_std): diag-gaussian around the mean head."""
         import numpy as np
+        if self.continuous:
+            log_std = np.asarray(params_np["log_std"])
+            std = np.exp(log_std)
+            action = logits + std * rng.standard_normal(logits.shape)
+            z = (action - logits) / std
+            logp = (-0.5 * np.square(z) - log_std
+                    - 0.5 * _LOG_2PI).sum(-1)
+            return action.astype(np.float32), logp.astype(np.float32)
         z = logits - logits.max(axis=-1, keepdims=True)
         logp_all = z - np.log(np.exp(z).sum(axis=-1, keepdims=True))
         g = rng.gumbel(size=logits.shape)

@@ -47,13 +47,18 @@ class SingleAgentEnvRunner:
             vectorization_mode="sync")
         obs_space = self._envs.single_observation_space
         act_space = self._envs.single_action_space
-        if not hasattr(act_space, "n"):
-            raise NotImplementedError(
-                "SingleAgentEnvRunner currently supports discrete action "
-                "spaces (reference parity for continuous is tracked)")
-        self.module = ActorCriticModule(
-            int(np.prod(obs_space.shape)), int(act_space.n),
-            tuple(config.hidden))
+        self._continuous = not hasattr(act_space, "n")
+        if self._continuous:
+            self._act_dim = int(np.prod(act_space.shape))
+            self._act_low = np.asarray(act_space.low, np.float32)
+            self._act_high = np.asarray(act_space.high, np.float32)
+            self.module = ActorCriticModule(
+                int(np.prod(obs_space.shape)), self._act_dim,
+                tuple(config.hidden), continuous=True)
+        else:
+            self.module = ActorCriticModule(
+                int(np.prod(obs_space.shape)), int(act_space.n),
+                tuple(config.hidden))
         self.set_weights(self.module.init(jax.random.PRNGKey(seed)))
         self._rng = np.random.default_rng(seed + 1)
         self._obs, _ = self._envs.reset(seed=seed)
@@ -94,7 +99,8 @@ class SingleAgentEnvRunner:
         T = rollout_length or self.config.rollout_length
         N = self.config.num_envs
         obs_buf = np.empty((T + 1, N) + self._obs.shape[1:], np.float32)
-        act_buf = np.empty((T, N), np.int32)
+        act_buf = (np.empty((T, N, self._act_dim), np.float32)
+                   if self._continuous else np.empty((T, N), np.int32))
         logp_buf = np.empty((T, N), np.float32)
         rew_buf = np.empty((T, N), np.float32)
         term_buf = np.empty((T, N), np.float32)
@@ -105,8 +111,15 @@ class SingleAgentEnvRunner:
             obs_buf[t] = self._obs
             logits = self.module.forward_policy_np(
                 self.params, self._obs.astype(np.float32))
-            action, logp = self.module.sample_np(logits, self._rng)
-            nobs, reward, term, trunc, _ = self._envs.step(action)
+            action, logp = self.module.sample_np(logits, self._rng,
+                                                 self.params)
+            env_action = action
+            if self._continuous:
+                # learner sees the UNCLIPPED action (its logp is exact);
+                # the env gets the in-bounds projection
+                env_action = np.clip(action, self._act_low,
+                                     self._act_high)
+            nobs, reward, term, trunc, _ = self._envs.step(env_action)
             done = np.logical_or(term, trunc)
             act_buf[t] = action
             logp_buf[t] = np.asarray(logp)

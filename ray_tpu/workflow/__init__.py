@@ -1,0 +1,161 @@
+"""Durable workflows: step-checkpointed task graphs.
+
+Parity: reference python/ray/workflow (workflow_executor.py — each step
+persists its result; a resumed workflow replays completed steps from
+storage instead of re-executing them). Re-shaped for this stack:
+
+- `@workflow.step` wraps a function; inside a running workflow each
+  invocation is one durable unit. Step identity = call order + function
+  name (deterministic workflows, the reference's contract too).
+- `workflow.run(entry_fn, *args, workflow_id=..., storage=...)`
+  executes the entry function; every step result is pickled under
+  `<storage>/<workflow_id>/steps/`.
+- `workflow.resume(workflow_id, storage=...)` re-runs the entry
+  function (persisted at first run); completed steps return their
+  stored results without executing, so the workflow continues from the
+  first incomplete step.
+
+Steps execute as ray_tpu tasks (isolation + retries ride the task
+layer). Non-step code in the entry function re-runs on resume — keep
+side effects inside steps, exactly as the reference demands.
+"""
+from __future__ import annotations
+
+import contextvars
+import functools
+import os
+import pickle
+from typing import Any, Callable, Optional
+
+import cloudpickle
+
+import ray_tpu
+
+_DEFAULT_STORAGE = os.path.expanduser("~/ray_tpu_workflows")
+
+_ctx: contextvars.ContextVar[Optional["_WorkflowContext"]] = (
+    contextvars.ContextVar("rtpu_workflow_ctx", default=None))
+
+
+class WorkflowNotFoundError(Exception):
+    pass
+
+
+class _WorkflowContext:
+    def __init__(self, workflow_id: str, storage: str):
+        self.workflow_id = workflow_id
+        self.dir = os.path.join(storage, workflow_id)
+        self.steps_dir = os.path.join(self.dir, "steps")
+        os.makedirs(self.steps_dir, exist_ok=True)
+        self.call_index = 0
+        self.num_replayed = 0
+        self.num_executed = 0
+
+    def step_path(self, name: str) -> str:
+        idx = self.call_index
+        self.call_index += 1
+        return os.path.join(self.steps_dir, f"{idx:05d}_{name}.pkl")
+
+
+class WorkflowStep:
+    """A durable unit. Called inside workflow.run: executes as a task
+    and persists; outside a workflow: plain call."""
+
+    def __init__(self, fn: Callable, name: Optional[str] = None,
+                 max_retries: int = 3):
+        self._fn = fn
+        self.name = name or fn.__name__
+        self._remote = ray_tpu.remote(max_retries=max_retries)(fn)
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        ctx = _ctx.get()
+        if ctx is None:
+            return self._fn(*args, **kwargs)
+        path = ctx.step_path(self.name)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                ctx.num_replayed += 1
+                return pickle.load(f)["result"]
+        result = ray_tpu.get(self._remote.remote(*args, **kwargs))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"result": result}, f)
+        os.replace(tmp, path)            # atomic: crash-safe commit
+        ctx.num_executed += 1
+        return result
+
+
+def step(fn: Optional[Callable] = None, *, name: Optional[str] = None,
+         max_retries: int = 3):
+    """`@workflow.step` / `@workflow.step(name=..., max_retries=...)`."""
+    if fn is not None:
+        return WorkflowStep(fn)
+    return lambda f: WorkflowStep(f, name=name, max_retries=max_retries)
+
+
+def run(entry_fn: Callable, *args, workflow_id: str,
+        storage: Optional[str] = None, **kwargs) -> Any:
+    """Execute a workflow to completion; durable against re-runs."""
+    storage = storage or _DEFAULT_STORAGE
+    ctx = _WorkflowContext(workflow_id, storage)
+    # persist the entry point + args so resume() can replay it
+    entry_path = os.path.join(ctx.dir, "entry.pkl")
+    if not os.path.exists(entry_path):
+        with open(entry_path, "wb") as f:
+            cloudpickle.dump({"fn": entry_fn, "args": args,
+                              "kwargs": kwargs}, f)
+    global _LAST_STATS
+    token = _ctx.set(ctx)
+    try:
+        result = entry_fn(*args, **kwargs)
+    finally:
+        _ctx.reset(token)
+        _LAST_STATS = {"replayed": ctx.num_replayed,
+                       "executed": ctx.num_executed}
+    with open(os.path.join(ctx.dir, "result.pkl"), "wb") as f:
+        pickle.dump({"result": result}, f)
+    return result
+
+
+def resume(workflow_id: str, storage: Optional[str] = None) -> Any:
+    """Re-run a workflow: finished steps replay from storage; a stored
+    final result short-circuits entirely."""
+    storage = storage or _DEFAULT_STORAGE
+    wdir = os.path.join(storage, workflow_id)
+    result_path = os.path.join(wdir, "result.pkl")
+    if os.path.exists(result_path):
+        with open(result_path, "rb") as f:
+            return pickle.load(f)["result"]
+    entry_path = os.path.join(wdir, "entry.pkl")
+    if not os.path.exists(entry_path):
+        raise WorkflowNotFoundError(
+            f"no workflow {workflow_id!r} under {storage}")
+    with open(entry_path, "rb") as f:
+        entry = cloudpickle.load(f)
+    return run(entry["fn"], *entry["args"], workflow_id=workflow_id,
+               storage=storage, **entry["kwargs"])
+
+
+def get_status(workflow_id: str,
+               storage: Optional[str] = None) -> dict:
+    storage = storage or _DEFAULT_STORAGE
+    wdir = os.path.join(storage, workflow_id)
+    if not os.path.isdir(wdir):
+        raise WorkflowNotFoundError(workflow_id)
+    steps = sorted(os.listdir(os.path.join(wdir, "steps")))
+    return {
+        "workflow_id": workflow_id,
+        "finished": os.path.exists(os.path.join(wdir, "result.pkl")),
+        "steps_completed": len(steps),
+        "steps": steps,
+    }
+
+
+_LAST_STATS: dict = {}
+
+
+def last_run_stats() -> dict:
+    """Replay/execute counters of the most recent run/resume in this
+    process (observability + tests)."""
+    return dict(_LAST_STATS)

@@ -331,3 +331,64 @@ def test_runtime_env_unsupported_keys_raise(ray_cluster):
     with pytest.raises(ValueError, match="working_dir"):
         ray_tpu.remote(runtime_env={"working_dir": "/nonexistent_xyz"})(
             lambda: 1)
+
+
+# ------------------------------------------------------------- cancel
+def test_cancel_running_task_nonforce(ray_cluster):
+    """Non-force cancel raises TaskCancelledError inside the running
+    task (reference CancelTask); pure-Python loops observe it."""
+    from ray_tpu.exceptions import TaskCancelledError, TaskError
+
+    @ray_tpu.remote
+    def spin(n):
+        import time
+        t0 = time.time()
+        x = 0
+        while time.time() - t0 < n:   # bytecode loop: async-exc lands
+            x += 1
+        return x
+
+    ref = spin.remote(60)
+    import time
+    time.sleep(2.0)                   # let it start executing
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskError) as ei:
+        ray_tpu.get(ref, timeout=30)
+    assert isinstance(ei.value.cause, TaskCancelledError)
+
+
+def test_cancel_running_task_force_no_retry(ray_cluster):
+    """force=True kills the worker; the task must NOT be retried even
+    with retries budgeted (cancel beats recovery)."""
+    from ray_tpu.exceptions import TaskCancelledError, TaskError
+
+    @ray_tpu.remote(max_retries=3)
+    def sleep_forever():
+        import time
+        time.sleep(600)
+
+    ref = sleep_forever.remote()
+    import time
+    time.sleep(2.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskError) as ei:
+        ray_tpu.get(ref, timeout=30)
+    assert isinstance(ei.value.cause, TaskCancelledError)
+
+
+def test_cancel_infeasible_parked_task(ray_cluster):
+    """A task parked as infeasible (no node can fit it) must still be
+    cancellable — it sits in no node queue."""
+    from ray_tpu.exceptions import TaskCancelledError, TaskError
+
+    @ray_tpu.remote(num_cpus=10_000)
+    def impossible():
+        return 1
+
+    ref = impossible.remote()
+    import time
+    time.sleep(0.3)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskError) as ei:
+        ray_tpu.get(ref, timeout=20)
+    assert isinstance(ei.value.cause, TaskCancelledError)

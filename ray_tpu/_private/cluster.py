@@ -73,6 +73,7 @@ class ClusterTaskManager:
         # Autoscaler flips this (reference: feasibility is judged
         # against node TYPES, not live nodes, when autoscaling).
         self.autoscaling_enabled = False
+        self.autoscaler_node_types: List[dict] = []
         self._lock = threading.RLock()
         self._nodes: Dict[str, NodeRecord] = {}
         self._pgs: Dict[str, PGRecord] = {}
@@ -303,8 +304,17 @@ class ClusterTaskManager:
     def _check_feasible_ever(self, pg: PGRecord) -> None:
         """Raise if no future availability could ever satisfy the PG
         (VERDICT r1: unschedulable must raise, not silently ignore).
-        Skipped under autoscaling: new capacity can appear."""
+        Under autoscaling, feasibility is judged against the
+        autoscaler's node TYPES (capacity can appear) instead of live
+        nodes."""
         if self.autoscaling_enabled:
+            types = self.autoscaler_node_types
+            if types:
+                for b in pg.bundles:
+                    if not any(fits(t, b) for t in types):
+                        raise PlacementGroupUnschedulableError(
+                            f"no autoscaler node type can fit bundle "
+                            f"{b} (types: {types})")
             return
         nodes = self.alive_nodes()
         if pg.strategy == "STRICT_SPREAD":
@@ -476,6 +486,21 @@ class ClusterTaskManager:
         return {"placement_group_id": pg.pg_id, "state": pg.state,
                 "bundles": pg.bundles, "strategy": pg.strategy,
                 "name": pg.name, "bundle_nodes": list(pg.bundle_nodes)}
+
+    def fail_type_infeasible(self, type_fits) -> None:
+        """Fail parked tasks whose shape NO autoscaler node type can
+        satisfy (they would otherwise wait forever; reference
+        autoscaler surfaces these as infeasible-request errors)."""
+        with self._lock:
+            doomed = [s for s in self._infeasible
+                      if not type_fits(dict(getattr(s, "resources", None)
+                                            or {"CPU": 1.0}))]
+            for s in doomed:
+                self._infeasible.remove(s)
+        for s in doomed:
+            self._rt.on_unplaceable(
+                s, "no autoscaler node type can satisfy "
+                   f"{getattr(s, 'resources', None)}")
 
     def cancel_parked(self, task_id: str):
         """Remove + return a task parked as infeasible (cancel path:

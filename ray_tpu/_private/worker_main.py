@@ -202,6 +202,7 @@ class WorkerExecutor:
         self.ctx = ctx
         self._fn_cache: dict[str, Any] = {}
         self._running_tasks: dict[str, threading.Thread] = {}
+        self._task_undo: dict[str, dict] = {}
         self._cancel_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=1,
                                         thread_name_prefix="rtpu-exec")
@@ -311,33 +312,50 @@ class WorkerExecutor:
                             "task_id": task_id, "results": stored_list,
                             "error": error, **extra})
 
+    def _finish_task_cleanup(self, spec: TaskSpec) -> None:
+        """Idempotent post-task cleanup: deregister from the cancel
+        table, CLEAR any pending async cancel on this thread (a raced
+        cancel must not detonate in the pool thread's idle loop or in
+        _send_results), and revert the task's runtime env."""
+        import ctypes
+        with self._cancel_lock:
+            self._running_tasks.pop(spec.task_id, None)
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(threading.get_ident()), None)
+        undo = self._task_undo.pop(spec.task_id, None)
+        if undo is not None:
+            _revert_runtime_env(undo)
+
     def _run_task(self, spec: TaskSpec) -> None:
-        undo = None
-        self._running_tasks[spec.task_id] = threading.current_thread()
+        from ray_tpu.exceptions import TaskCancelledError
         try:
-            # env first: the function/args may only UNPICKLE under the
-            # declared working_dir/env (the actor path does the same).
-            # Scoped: the pooled worker is reused by other tasks after.
-            undo = _apply_runtime_env(getattr(spec, "runtime_env", None))
-            fn = self._load_function(spec.func_id)
-            args, kwargs = self._resolve_args(spec.args, spec.kwargs)
-            result = fn(*args, **kwargs)
-            error = False
-        except BaseException as e:  # noqa: BLE001
-            result = e if isinstance(e, TaskError) else TaskError(
-                e, format_exception(e), task_name=spec.name)
+            try:
+                self._running_tasks[spec.task_id] = \
+                    threading.current_thread()
+                # env first: the function/args may only UNPICKLE under
+                # the declared working_dir/env (the actor path does the
+                # same). Scoped: the pooled worker is reused after.
+                self._task_undo[spec.task_id] = _apply_runtime_env(
+                    getattr(spec, "runtime_env", None))
+                fn = self._load_function(spec.func_id)
+                args, kwargs = self._resolve_args(spec.args, spec.kwargs)
+                result = fn(*args, **kwargs)
+                error = False
+            except BaseException as e:  # noqa: BLE001
+                result = e if isinstance(e, TaskError) else TaskError(
+                    e, format_exception(e), task_name=spec.name)
+                error = True
+            finally:
+                self._finish_task_cleanup(spec)
+        except TaskCancelledError as e:
+            # the async cancel landed INSIDE the finally (between task
+            # completion and the pending-exc clear): redo the cleanup —
+            # the exception has fired, so this pass cannot be interrupted
+            # again — and report the task cancelled.
+            self._finish_task_cleanup(spec)
+            result = TaskError(e, format_exception(e),
+                               task_name=spec.name)
             error = True
-        finally:
-            import ctypes
-            with self._cancel_lock:
-                self._running_tasks.pop(spec.task_id, None)
-                # clear any not-yet-delivered async cancel: the task is
-                # over; a raced cancel must not detonate in the pool
-                # thread's idle loop or in _send_results below
-                ctypes.pythonapi.PyThreadState_SetAsyncExc(
-                    ctypes.c_long(threading.get_ident()), None)
-            if undo is not None:
-                _revert_runtime_env(undo)
         self._send_results(spec.task_id, spec.return_ids, result,
                            spec.num_returns, error, name=spec.name)
 

@@ -139,6 +139,12 @@ class ActorMethod:
         refs = [ObjectRef(oid) for oid in spec.return_ids]
         return refs[0] if num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node from this method (reference dag bind API);
+        compose with InputNode and experimental_compile (ray_tpu.dag)."""
+        from ray_tpu.dag import ClassMethodNode
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(f"Actor method {self._name!r} must be invoked "
                         f"with .remote()")

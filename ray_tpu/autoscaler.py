@@ -23,6 +23,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ray_tpu._private.scheduler import fits as _fits_with_eps
+
 
 @dataclasses.dataclass
 class NodeTypeConfig:
@@ -66,7 +68,16 @@ class Autoscaler:
         self._thread: Optional[threading.Thread] = None
         self.num_scale_ups = 0
         self.num_scale_downs = 0
+        # launches whose node hasn't registered yet (async providers):
+        # counted as planned capacity so repeated updates don't
+        # re-launch for the same demand. (node_id, resources, at)
+        self._in_flight_launches: List[tuple] = []
+        self.provision_grace_s = 60.0
         cluster.autoscaling_enabled = True
+        # type-level feasibility: demand NO node type can ever satisfy
+        # is a hard error, not pending demand
+        cluster.autoscaler_node_types = [dict(t.resources)
+                                         for t in node_types]
 
     # --------------------------------------------------------- control
     def start(self) -> None:
@@ -79,6 +90,7 @@ class Autoscaler:
     def stop(self) -> None:
         self._running = False
         self._cluster.autoscaling_enabled = False
+        self._cluster.autoscaler_node_types = []
 
     def _loop(self) -> None:
         import sys
@@ -103,16 +115,23 @@ class Autoscaler:
         for spec in infeasible:
             demand.append(dict(getattr(spec, "resources", None)
                                or {"CPU": 1.0}))
-        # pending placement groups: unreserved bundles
+        # pending/rescheduling placement groups: bundles without a
+        # LIVE node (node death knocks CREATED PGs into RESCHEDULING —
+        # their displaced bundles are demand too)
+        alive = {n.node_id for n in self._cluster.alive_nodes()}
         for pg in self._cluster.pg_table():
-            if pg["state"] == "PENDING":
-                for bundle in pg["bundles"]:
+            if pg["state"] not in ("PENDING", "RESCHEDULING"):
+                continue
+            for bundle, node in zip(pg["bundles"], pg["bundle_nodes"]):
+                if node is None or node not in alive:
                     demand.append(dict(bundle))
         return demand
 
     def _fits(self, shape: Dict[str, float],
               resources: Dict[str, float]) -> bool:
-        return all(resources.get(k, 0.0) >= v for k, v in shape.items())
+        # one feasibility definition for the whole runtime (epsilon'd):
+        # scheduler.fits(avail, need)
+        return _fits_with_eps(resources, shape)
 
     def _count_type(self, name: str) -> int:
         return sum(1 for t in self._managed.values() if t == name)
@@ -121,6 +140,27 @@ class Autoscaler:
     def update(self) -> None:
         """One reconcile step (call directly in tests; the background
         loop calls it on update_interval_s)."""
+        now = time.monotonic()
+        alive = {n.node_id for n in self._cluster.alive_nodes()}
+        # forget managed nodes that died (else a crashed node counts
+        # toward max_workers forever and blocks its own replacement)
+        for nid in list(self._managed):
+            if nid not in alive:
+                self._managed.pop(nid, None)
+                self._idle_since.pop(nid, None)
+        # launches leave the in-flight set once the node has
+        # REGISTERED with the cluster (alive or since dead — a
+        # registered-then-crashed node is dead capacity, not pending
+        # capacity) or the grace window lapses
+        registered = {n.node_id for n in self._cluster.nodes()}
+        self._in_flight_launches = [
+            (nid, res, at) for nid, res, at in self._in_flight_launches
+            if nid not in registered
+            and now - at < self.provision_grace_s]
+        # demand NO node type can satisfy fails fast instead of parking
+        self._cluster.fail_type_infeasible(
+            lambda shape: any(self._fits(shape, t.resources)
+                              for t in self._types.values()))
         # min_workers floors
         for t in self._types.values():
             while self._count_type(t.name) < t.min_workers:
@@ -128,7 +168,8 @@ class Autoscaler:
         # demand-driven scale up with planned-capacity packing: fill
         # nodes launched THIS cycle before launching more (reference
         # v2 scheduler bin-packs demand into node-type bins)
-        planned: List[Dict[str, float]] = []
+        planned: List[Dict[str, float]] = [
+            dict(res) for _, res, _ in self._in_flight_launches]
         for shape in self._unmet_demand():
             placed = False
             for cap in planned:
@@ -151,7 +192,6 @@ class Autoscaler:
                 planned.append(cap)
                 break
         # idle scale down
-        now = time.monotonic()
         for node in self._cluster.alive_nodes():
             nid = node.node_id
             if node.is_head or nid not in self._managed:
@@ -169,6 +209,8 @@ class Autoscaler:
     def _scale_up(self, t: NodeTypeConfig) -> None:
         nid = self._provider.create_node(t)
         self._managed[nid] = t.name
+        self._in_flight_launches.append(
+            (nid, dict(t.resources), time.monotonic()))
         self.num_scale_ups += 1
 
     def _scale_down(self, node_id: str) -> None:

@@ -1,0 +1,161 @@
+"""Compiled DAGs: pre-wired actor-task graphs executed as one unit.
+
+Parity: reference python/ray/dag (DAGNode.bind / InputNode /
+MultiOutputNode, dag.experimental_compile -> CompiledDAG:664,
+execute:2118). Re-shaped for this stack: compilation validates the
+graph, computes a topological schedule, and `execute()` submits EVERY
+hop's actor task up front with upstream RESULT REFS wired as arguments
+— workers resolve refs themselves, so consecutive hops never block on
+a driver round-trip and consecutive `execute()` calls pipeline through
+the actors (the property the reference gets from its persistent
+per-actor exec loops; our per-actor ordered call queues provide it).
+
+Usage::
+
+    with InputNode() as inp:
+        x = worker_a.preprocess.bind(inp)
+        y = worker_b.infer.bind(x)
+    dag = y.experimental_compile()
+    ref = dag.execute(batch)          # one ObjectRef out
+    out = ray_tpu.get(ref)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+
+_CURRENT_INPUT: List["InputNode"] = []
+
+
+class DAGNode:
+    """Base graph node; `bind` on actor methods creates ClassMethodNode."""
+
+    def __init__(self, upstream: List["DAGNode"]):
+        self.upstream = upstream
+
+    def experimental_compile(self) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+    # convenience: execute without explicit compile (reference
+    # dag.execute on an uncompiled DAG)
+    def execute(self, *args):
+        return self.experimental_compile().execute(*args)
+
+
+class InputNode(DAGNode):
+    """The DAG's runtime input placeholder (context manager, reference
+    dag/input_node.py)."""
+
+    def __init__(self):
+        super().__init__([])
+
+    def __enter__(self) -> "InputNode":
+        _CURRENT_INPUT.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _CURRENT_INPUT.pop()
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor, method_name: str, args: Tuple,
+                 kwargs: Dict):
+        ups = [a for a in list(args) + list(kwargs.values())
+               if isinstance(a, DAGNode)]
+        super().__init__(ups)
+        self.actor = actor
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(list(outputs))
+        self.outputs = list(outputs)
+
+
+class _BoundMethod:
+    def __init__(self, actor, name: str):
+        self._actor = actor
+        self._name = name
+
+    def bind(self, *args, **kwargs) -> ClassMethodNode:
+        return ClassMethodNode(self._actor, self._name, args, kwargs)
+
+
+def bind_method(actor, method_name: str) -> _BoundMethod:
+    """`actor.method.bind(...)` sugar lives on ActorMethod (see
+    actor.py); this is the functional spelling."""
+    return _BoundMethod(actor, method_name)
+
+
+class CompiledDAG:
+    """Validated + scheduled DAG, reusable across executes."""
+
+    def __init__(self, output: DAGNode):
+        self._output = output
+        self._order = self._toposort(output)
+        self._input = self._find_input()
+        self._lock = threading.Lock()
+        self.num_executions = 0
+
+    def _toposort(self, root: DAGNode) -> List[DAGNode]:
+        order: List[DAGNode] = []
+        seen: Dict[int, int] = {}        # id -> 0 visiting / 1 done
+
+        def visit(node: DAGNode) -> None:
+            state = seen.get(id(node))
+            if state == 1:
+                return
+            if state == 0:
+                raise ValueError("cycle detected in DAG")
+            seen[id(node)] = 0
+            for up in node.upstream:
+                visit(up)
+            seen[id(node)] = 1
+            order.append(node)
+
+        visit(root)
+        return order
+
+    def _find_input(self) -> Optional[InputNode]:
+        inputs = [n for n in self._order if isinstance(n, InputNode)]
+        if len(inputs) > 1:
+            raise ValueError("a DAG has at most one InputNode")
+        return inputs[0] if inputs else None
+
+    def execute(self, *args):
+        """Submit the whole graph; returns the output ObjectRef (or a
+        list for MultiOutputNode). Upstream results flow as refs the
+        workers resolve — no driver hop between stages."""
+        if self._input is not None and len(args) != 1:
+            raise TypeError(
+                f"DAG takes exactly 1 input, got {len(args)}")
+        with self._lock:                  # per-actor ordering across hops
+            values: Dict[int, Any] = {}
+            if self._input is not None:
+                values[id(self._input)] = args[0]
+            for node in self._order:
+                if isinstance(node, InputNode):
+                    continue
+                if isinstance(node, MultiOutputNode):
+                    values[id(node)] = [values[id(o)]
+                                        for o in node.outputs]
+                    continue
+                resolve = (lambda v: values[id(v)]
+                           if isinstance(v, DAGNode) else v)
+                call_args = tuple(resolve(a) for a in node.args)
+                call_kwargs = {k: resolve(v)
+                               for k, v in node.kwargs.items()}
+                method = getattr(node.actor, node.method_name)
+                values[id(node)] = method.remote(*call_args,
+                                                 **call_kwargs)
+            self.num_executions += 1
+            return values[id(self._output)]
+
+    def teardown(self) -> None:
+        """Reference parity hook (the reference kills its exec loops;
+        our actors keep serving normal calls)."""

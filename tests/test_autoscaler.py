@@ -118,3 +118,58 @@ def test_max_workers_cap(scaled_cluster):
         asc.update()
     assert asc.stats()["managed_nodes"] == 1     # cap enforced
     ray_tpu.get(refs, timeout=180)
+
+
+def test_dead_managed_node_is_replaced(scaled_cluster):
+    """A crashed managed node must stop counting toward max_workers so
+    its replacement can launch."""
+    from ray_tpu._private import context
+    cluster = context.get_ctx().cluster
+    asc = Autoscaler(cluster,
+                     [NodeTypeConfig("solo", {"CPU": 8}, max_workers=1)],
+                     idle_timeout_s=9999)
+
+    @ray_tpu.remote(num_cpus=6)
+    def heavy(x):
+        return x
+
+    ref = heavy.remote(1)
+    time.sleep(0.3)
+    asc.update()
+    assert ray_tpu.get(ref, timeout=120) == 1
+    nid = next(iter(asc._managed))
+    cluster.remove_node(nid, graceful=False)     # crash it
+    deadline = time.time() + 30                  # health monitor marks dead
+    while time.time() < deadline and any(
+            n.node_id == nid for n in cluster.alive_nodes()):
+        time.sleep(0.5)
+    ref2 = heavy.remote(2)
+    time.sleep(0.3)
+    asc.update()                                 # must launch replacement
+    assert ray_tpu.get(ref2, timeout=120) == 2
+    assert asc.stats()["managed_nodes"] == 1
+
+
+def test_type_infeasible_demand_fails_fast(scaled_cluster):
+    """Demand no node type can EVER satisfy errors instead of hanging."""
+    from ray_tpu._private import context
+    from ray_tpu.exceptions import TaskError
+    cluster = context.get_ctx().cluster
+    asc = Autoscaler(cluster,
+                     [NodeTypeConfig("small", {"CPU": 4}, max_workers=4)],
+                     idle_timeout_s=9999)
+
+    @ray_tpu.remote(num_cpus=100)
+    def impossible():
+        return 1
+
+    ref = impossible.remote()
+    time.sleep(0.3)
+    asc.update()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=30)
+
+    from ray_tpu.util.placement_group import placement_group
+    from ray_tpu.exceptions import PlacementGroupUnschedulableError
+    with pytest.raises(PlacementGroupUnschedulableError):
+        placement_group([{"CPU": 100}])

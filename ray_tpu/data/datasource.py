@@ -186,6 +186,8 @@ def write_parquet(blocks: Iterator[Block], path: str) -> List[str]:
 def _json_safe(v):
     if isinstance(v, np.ndarray):
         return v.tolist()
+    if isinstance(v, np.bool_):
+        return bool(v)
     if isinstance(v, (np.integer,)):
         return int(v)
     if isinstance(v, (np.floating,)):

@@ -1,0 +1,155 @@
+"""ConnectorV2: composable transform pipelines on the env↔module edges.
+
+Parity: reference rllib/connectors (env_to_module/, module_to_env/ —
+ConnectorV2 pieces composed into ConnectorPipelineV2, living on env
+runners). Re-shaped for this stack: a connector is a callable
+`(data, runner) -> data` over numpy batches; pipelines run on the
+env-runner hot path — obs connectors before policy inference, action
+connectors before env.step.
+
+Built-ins mirror the reference's defaults: observation flattening,
+running-stat normalization (the classic MeanStdFilter), observation
+clipping, action clipping/unsquashing for Box spaces.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+class Connector:
+    """Base transform; subclass or wrap a function with FnConnector."""
+
+    def __call__(self, data: np.ndarray, runner=None) -> np.ndarray:
+        raise NotImplementedError
+
+    def get_state(self) -> dict:
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
+
+class FnConnector(Connector):
+    def __init__(self, fn: Callable[[np.ndarray], np.ndarray],
+                 name: Optional[str] = None):
+        self._fn = fn
+        self.name = name or getattr(fn, "__name__", "fn")
+
+    def __call__(self, data, runner=None):
+        return self._fn(data)
+
+
+class FlattenObs(Connector):
+    """(N, *obs_shape) -> (N, prod(obs_shape))."""
+
+    def __call__(self, data, runner=None):
+        return np.asarray(data).reshape(len(data), -1)
+
+
+class ClipObs(Connector):
+    def __init__(self, low: float = -10.0, high: float = 10.0):
+        self.low, self.high = low, high
+
+    def __call__(self, data, runner=None):
+        return np.clip(data, self.low, self.high)
+
+
+class NormalizeObs(Connector):
+    """Running mean/std filter (reference MeanStdFilter connector).
+    Stats update online during sampling and ride get/set_state so
+    restored runners keep their normalization."""
+
+    def __init__(self, eps: float = 1e-8, update: bool = True):
+        self.eps = eps
+        self.update = update
+        self._count = 0.0
+        self._mean: Optional[np.ndarray] = None
+        self._m2: Optional[np.ndarray] = None
+
+    def __call__(self, data, runner=None):
+        batch = np.asarray(data, np.float64)
+        if self._mean is None:
+            self._mean = np.zeros(batch.shape[1:], np.float64)
+            self._m2 = np.ones(batch.shape[1:], np.float64)
+        if self.update and len(batch):
+            # Chan's parallel Welford merge: one O(1)-numpy-call update
+            # per batch (a per-row Python loop would sit on the sampling
+            # hot path)
+            n_b = float(len(batch))
+            mean_b = batch.mean(axis=0)
+            m2_b = ((batch - mean_b) ** 2).sum(axis=0)
+            delta = mean_b - self._mean
+            total = self._count + n_b
+            self._mean = self._mean + delta * (n_b / total)
+            self._m2 = (self._m2 + m2_b
+                        + (delta ** 2) * (self._count * n_b / total))
+            self._count = total
+        var = (self._m2 / max(self._count, 1.0)) if self._count else \
+            np.ones_like(self._mean)
+        return ((batch - self._mean)
+                / np.sqrt(var + self.eps)).astype(np.float32)
+
+    def get_state(self) -> dict:
+        return {"count": self._count,
+                "mean": None if self._mean is None else self._mean.copy(),
+                "m2": None if self._m2 is None else self._m2.copy()}
+
+    def set_state(self, state: dict) -> None:
+        self._count = state["count"]
+        self._mean = state["mean"]
+        self._m2 = state["m2"]
+
+
+class ClipActions(Connector):
+    """Clip continuous actions into the env's Box bounds."""
+
+    def __call__(self, data, runner=None):
+        if runner is not None and getattr(runner, "_continuous", False):
+            return np.clip(data, runner._act_low, runner._act_high)
+        return data
+
+
+class ConnectorPipeline(Connector):
+    """Ordered composition with the reference pipeline's edit API."""
+
+    def __init__(self, connectors: Optional[List[Connector]] = None):
+        self.connectors: List[Connector] = list(connectors or [])
+
+    def __call__(self, data, runner=None):
+        for c in self.connectors:
+            data = c(data, runner)
+        return data
+
+    def append(self, connector: Connector) -> "ConnectorPipeline":
+        self.connectors.append(connector)
+        return self
+
+    def prepend(self, connector: Connector) -> "ConnectorPipeline":
+        self.connectors.insert(0, connector)
+        return self
+
+    def insert_before(self, cls: type,
+                      connector: Connector) -> "ConnectorPipeline":
+        for i, c in enumerate(self.connectors):
+            if isinstance(c, cls):
+                self.connectors.insert(i, connector)
+                return self
+        raise ValueError(f"no connector of type {cls.__name__}")
+
+    def insert_after(self, cls: type,
+                     connector: Connector) -> "ConnectorPipeline":
+        for i, c in enumerate(self.connectors):
+            if isinstance(c, cls):
+                self.connectors.insert(i + 1, connector)
+                return self
+        raise ValueError(f"no connector of type {cls.__name__}")
+
+    def get_state(self) -> dict:
+        return {i: c.get_state() for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state: dict) -> None:
+        for i, c in enumerate(self.connectors):
+            if i in state:
+                c.set_state(state[i])

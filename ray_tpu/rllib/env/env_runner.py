@@ -21,6 +21,12 @@ from ray_tpu.rllib.core.rl_module import ActorCriticModule
 @dataclasses.dataclass
 class EnvRunnerConfig:
     env: str = "CartPole-v1"
+    # ConnectorV2 pipelines (rllib/connectors.py): obs transforms run
+    # before policy inference (and are what gets STORED, so the learner
+    # sees the same inputs); action transforms run before env.step.
+    # None = defaults (identity obs; Box-bound clipping for actions).
+    env_to_module: Optional[list] = None
+    module_to_env: Optional[list] = None
     # Wide-and-short default (32x32 rather than the GPU-classic 8x128):
     # each rollout step costs one jitted-dispatch round-trip, so for
     # cheap CPU envs more parallel envs per step is strictly better.
@@ -45,26 +51,38 @@ class SingleAgentEnvRunner:
         self._envs = gym.make_vec(
             config.env, num_envs=config.num_envs,
             vectorization_mode="sync")
-        obs_space = self._envs.single_observation_space
         act_space = self._envs.single_action_space
         self._continuous = not hasattr(act_space, "n")
         if self._continuous:
             self._act_dim = int(np.prod(act_space.shape))
             self._act_low = np.asarray(act_space.low, np.float32)
             self._act_high = np.asarray(act_space.high, np.float32)
-            self.module = ActorCriticModule(
-                int(np.prod(obs_space.shape)), self._act_dim,
-                tuple(config.hidden), continuous=True)
-        else:
-            self.module = ActorCriticModule(
-                int(np.prod(obs_space.shape)), int(act_space.n),
-                tuple(config.hidden))
-        self.set_weights(self.module.init(jax.random.PRNGKey(seed)))
         self._rng = np.random.default_rng(seed + 1)
         self._obs, _ = self._envs.reset(seed=seed)
         self._prev_done = np.zeros(config.num_envs, bool)
         self._ep_return = np.zeros(config.num_envs, np.float64)
         self._ep_len = np.zeros(config.num_envs, np.int64)
+        from ray_tpu.rllib.connectors import (ClipActions,
+                                               ConnectorPipeline)
+        self._env_to_module = ConnectorPipeline(config.env_to_module)
+        self._module_to_env = ConnectorPipeline(
+            config.module_to_env if config.module_to_env is not None
+            else [ClipActions()])
+        # probe the pipeline with the real initial obs (counts once in
+        # stateful connectors and is reused as the first sample step):
+        # the MODULE is sized from the TRANSFORMED obs, which connectors
+        # may reshape (FlattenObs, frame stacking, ...)
+        self._proc_obs = self._env_to_module(
+            self._obs.astype(np.float32), self)
+        obs_dim = int(np.prod(self._proc_obs.shape[1:]))
+        if self._continuous:
+            self.module = ActorCriticModule(
+                obs_dim, self._act_dim, tuple(config.hidden),
+                continuous=True)
+        else:
+            self.module = ActorCriticModule(
+                obs_dim, int(act_space.n), tuple(config.hidden))
+        self.set_weights(self.module.init(jax.random.PRNGKey(seed)))
         self._recent_returns: deque = deque(
             maxlen=config.episode_metric_window)
         self._recent_lens: deque = deque(
@@ -98,7 +116,16 @@ class SingleAgentEnvRunner:
         """
         T = rollout_length or self.config.rollout_length
         N = self.config.num_envs
-        obs_buf = np.empty((T + 1, N) + self._obs.shape[1:], np.float32)
+        # each raw observation is transformed EXACTLY once: the rollout
+        # boundary obs is cached so batch k's bootstrap row and batch
+        # k+1's first row are the same array (stateful connectors like
+        # NormalizeObs must not double-count it), and buffers take the
+        # TRANSFORMED shape (connectors may reshape, e.g. FlattenObs).
+        if self._proc_obs is None:
+            self._proc_obs = self._env_to_module(
+                self._obs.astype(np.float32), self)
+        proc = self._proc_obs
+        obs_buf = np.empty((T + 1, N) + proc.shape[1:], np.float32)
         act_buf = (np.empty((T, N, self._act_dim), np.float32)
                    if self._continuous else np.empty((T, N), np.int32))
         logp_buf = np.empty((T, N), np.float32)
@@ -108,17 +135,13 @@ class SingleAgentEnvRunner:
         mask_buf = np.empty((T, N), np.float32)
 
         for t in range(T):
-            obs_buf[t] = self._obs
-            logits = self.module.forward_policy_np(
-                self.params, self._obs.astype(np.float32))
+            obs_buf[t] = proc
+            logits = self.module.forward_policy_np(self.params, proc)
             action, logp = self.module.sample_np(logits, self._rng,
                                                  self.params)
-            env_action = action
-            if self._continuous:
-                # learner sees the UNCLIPPED action (its logp is exact);
-                # the env gets the in-bounds projection
-                env_action = np.clip(action, self._act_low,
-                                     self._act_high)
+            # learner sees the RAW action (its logp is exact); the env
+            # gets the connector-transformed one (clipping by default)
+            env_action = self._module_to_env(action, self)
             nobs, reward, term, trunc, _ = self._envs.step(env_action)
             done = np.logical_or(term, trunc)
             act_buf[t] = action
@@ -143,7 +166,9 @@ class SingleAgentEnvRunner:
                 self._ep_len[i] = 0
             self._prev_done = done
             self._obs = nobs
-        obs_buf[T] = self._obs
+            proc = self._env_to_module(nobs.astype(np.float32), self)
+        obs_buf[T] = proc
+        self._proc_obs = proc
         self._total_steps += int(mask_buf.sum())
         return {"obs": obs_buf, "actions": act_buf, "logp": logp_buf,
                 "rewards": rew_buf, "terminateds": term_buf,
@@ -162,10 +187,16 @@ class SingleAgentEnvRunner:
         }
 
     def get_state(self) -> Dict[str, Any]:
-        return {"weights": self.get_weights()}
+        return {"weights": self.get_weights(),
+                "connectors": {
+                    "env_to_module": self._env_to_module.get_state(),
+                    "module_to_env": self._module_to_env.get_state()}}
 
     def set_state(self, state: Dict[str, Any]) -> None:
         self.set_weights(state["weights"])
+        conn = state.get("connectors") or {}
+        self._env_to_module.set_state(conn.get("env_to_module", {}))
+        self._module_to_env.set_state(conn.get("module_to_env", {}))
 
     def stop(self) -> None:
         self._envs.close()

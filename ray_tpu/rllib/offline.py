@@ -1,0 +1,174 @@
+"""Offline RL: experience recording + behavior cloning on ray_tpu.data.
+
+Parity: reference rllib/offline (offline_data.py readers/writers feeding
+the learner; the BC/MARWIL family trains from recorded episodes). The
+TPU-shaped version: experiences are ray_tpu.data Datasets (jsonl/parquet
+— the same substrate as SFT data), and BC is a single-jit supervised
+update maximizing log pi(a|s) over dataset batches.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.core.rl_module import ActorCriticModule
+
+
+def record_transitions(env_name: str, policy_fn: Callable, path: str,
+                       num_steps: int = 5000, num_envs: int = 8,
+                       seed: int = 0) -> str:
+    """Roll a policy (obs_batch -> action_batch) and write transitions
+    as jsonl rows {obs, action, reward, terminated} (reference offline
+    output writer shape). Returns the written path."""
+    import gymnasium as gym
+
+    from ray_tpu import data as rd
+    envs = gym.make_vec(env_name, num_envs=num_envs,
+                        vectorization_mode="sync")
+    obs, _ = envs.reset(seed=seed)
+    prev_done = np.zeros(num_envs, bool)
+    rows = []
+    while len(rows) < num_steps:
+        action = np.asarray(policy_fn(obs.astype(np.float32)))
+        nobs, reward, term, trunc, _ = envs.step(action)
+        valid = ~prev_done
+        for i in np.nonzero(valid)[0]:
+            rows.append({"obs": obs[i].astype(np.float32),
+                         "action": action[i],
+                         "reward": float(reward[i]),
+                         "terminated": bool(term[i])})
+        prev_done = term | trunc
+        obs = nobs
+    envs.close()
+    ds = rd.from_items(rows, override_num_blocks=8)
+    ds.write_jsonl(path)
+    return path
+
+
+@dataclasses.dataclass
+class BCConfig:
+    env: str = "CartPole-v1"
+    input_path: str = ""                 # jsonl dir/file of transitions
+    hidden: Sequence[int] = (64, 64)
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    num_batches_per_iteration: int = 50
+    seed: int = 0
+
+    def environment(self, env: str) -> "BCConfig":
+        self.env = env
+        return self
+
+    def offline_data(self, input_path: str) -> "BCConfig":
+        self.input_path = input_path
+        return self
+
+    def training(self, **kw) -> "BCConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown BC option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC:
+    """Behavior cloning: maximize log pi(a|s) over the offline dataset."""
+
+    def __init__(self, config: BCConfig):
+        if not config.input_path:
+            raise ValueError("BC needs offline_data(input_path=...)")
+        import gymnasium as gym
+
+        from ray_tpu import data as rd
+        self.config = config
+        env = gym.make(config.env)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        space = env.action_space
+        self._continuous = not hasattr(space, "n")
+        num_actions = (int(np.prod(space.shape)) if self._continuous
+                       else int(space.n))
+        env.close()
+        self.module = ActorCriticModule(obs_dim, num_actions,
+                                        tuple(config.hidden),
+                                        continuous=self._continuous)
+        self.params = self.module.init(jax.random.PRNGKey(config.seed))
+        self._tx = optax.adam(config.lr)
+        self.opt_state = self._tx.init(self.params)
+        self._dataset = rd.read_json(config.input_path)
+        self._update_fn = jax.jit(self._build_update())
+        self.iteration = 0
+
+    def _build_update(self):
+        module = self.module
+
+        def loss_fn(params, obs, actions):
+            logits, _ = module.forward(params, obs)
+            logp = module.dist_log_prob(params, logits, actions)
+            return -jnp.mean(logp)
+
+        def update(params, opt_state, obs, actions):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs,
+                                                      actions)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return update
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.perf_counter()
+        losses = []
+        batches = self._dataset.iter_batches(
+            batch_size=c.train_batch_size, drop_last=True,
+            local_shuffle_buffer_size=4 * c.train_batch_size,
+            seed=c.seed + self.iteration)
+        for _, batch in zip(range(c.num_batches_per_iteration), batches):
+            obs = np.stack([np.asarray(o, np.float32)
+                            for o in batch["obs"]])
+            if self._continuous:
+                actions = np.stack([np.asarray(a, np.float32)
+                                    for a in batch["action"]])
+            else:
+                actions = np.asarray(batch["action"], np.int64)
+            self.params, self.opt_state, loss = self._update_fn(
+                self.params, self.opt_state, jnp.asarray(obs),
+                jnp.asarray(actions))
+            losses.append(float(loss))
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "bc_loss": float(np.mean(losses)) if losses else
+                float("nan"),
+                "num_batches": len(losses),
+                "time_iteration_s": time.perf_counter() - t0}
+
+    def evaluate(self, num_episodes: int = 10,
+                 seed: int = 123) -> Dict[str, float]:
+        """Greedy rollout return of the cloned policy."""
+        import gymnasium as gym
+        env = gym.make(self.config.env)
+        params_np = jax.tree_util.tree_map(np.asarray, self.params)
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            total, done = 0.0, False
+            while not done:
+                pi_out = self.module.forward_policy_np(
+                    params_np, obs.astype(np.float32)[None])
+                action = (pi_out[0] if self._continuous
+                          else int(np.argmax(pi_out[0])))
+                obs, r, term, trunc, _ = env.step(action)
+                total += float(r)
+                done = term or trunc
+            returns.append(total)
+        env.close()
+        return {"episode_return_mean": float(np.mean(returns)),
+                "num_episodes": num_episodes}

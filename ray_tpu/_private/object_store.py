@@ -289,16 +289,28 @@ class LocalStore:
                     self._spilled_bytes_total += obj.nbytes
                 self._cv.notify_all()
 
-    def _restore(self, oid: str) -> Optional[StoredObject]:
+    def _restore(self, oid: str,
+                 timeout: Optional[float] = None) -> Optional[StoredObject]:
         """Two-phase restore mirroring the spill write: claim the
         spill record under the lock, READ THE FILE OUTSIDE IT (a large
         restore must not stall the whole object plane), re-admit under
         the lock. Concurrent getters of the same oid wait on the
-        condvar via the _restoring marker."""
+        condvar via the _restoring marker. `timeout` bounds how long a
+        losing racer waits for the winner's re-admission (0 = don't
+        block: the non-blocking-probe contract of get_stored)."""
         with self._cv:
             rec = self._spilled.pop(oid, None)
             if rec is None:
-                return self._objects.get(oid)   # someone else restored
+                # Someone else claimed the spill record. If their disk
+                # read is still in flight the object is in neither map
+                # yet — wait for re-admission instead of reporting a
+                # spurious miss to the loser of the race.
+                if oid in self._restoring and timeout != 0:
+                    self._cv.wait_for(
+                        lambda: oid in self._objects
+                        or oid not in self._restoring,
+                        timeout=timeout)
+                return self._objects.get(oid)
             self._restoring.add(oid)
         try:
             with open(rec.path, "rb") as f:
@@ -365,7 +377,7 @@ class LocalStore:
                         self._touched_at[object_id] = time.monotonic()
                     return obj
                 return None
-        obj = self._restore(object_id)
+        obj = self._restore(object_id, timeout=timeout)
         if obj is not None:
             with self._lock:
                 self._touched_at[object_id] = time.monotonic()

@@ -203,6 +203,7 @@ class WorkerExecutor:
         self._fn_cache: dict[str, Any] = {}
         self._running_tasks: dict[str, threading.Thread] = {}
         self._task_undo: dict[str, dict] = {}
+        self._pending_cancels: set[str] = set()
         self._cancel_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=1,
                                         thread_name_prefix="rtpu-exec")
@@ -255,6 +256,15 @@ class WorkerExecutor:
             # pool thread)
             thread = self._running_tasks.get(task_id)
             if thread is None or not thread.is_alive():
+                # Cancel raced ahead of registration (the pool thread
+                # hasn't started the task yet): record it so _run_task
+                # aborts before user code runs instead of silently
+                # completing while the driver shows CANCELLING. Bounded:
+                # a cancel that arrives AFTER completion leaves a stale
+                # id here (its task never runs again), so cap the set.
+                if len(self._pending_cancels) >= 1024:
+                    self._pending_cancels.pop()
+                self._pending_cancels.add(task_id)
                 return
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
                 ctypes.c_long(thread.ident),
@@ -320,6 +330,7 @@ class WorkerExecutor:
         import ctypes
         with self._cancel_lock:
             self._running_tasks.pop(spec.task_id, None)
+            self._pending_cancels.discard(spec.task_id)
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
                 ctypes.c_long(threading.get_ident()), None)
         undo = self._task_undo.pop(spec.task_id, None)
@@ -330,8 +341,12 @@ class WorkerExecutor:
         from ray_tpu.exceptions import TaskCancelledError
         try:
             try:
-                self._running_tasks[spec.task_id] = \
-                    threading.current_thread()
+                with self._cancel_lock:
+                    if spec.task_id in self._pending_cancels:
+                        self._pending_cancels.discard(spec.task_id)
+                        raise TaskCancelledError(spec.task_id)
+                    self._running_tasks[spec.task_id] = \
+                        threading.current_thread()
                 # env first: the function/args may only UNPICKLE under
                 # the declared working_dir/env (the actor path does the
                 # same). Scoped: the pooled worker is reused after.

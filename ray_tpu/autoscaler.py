@@ -106,9 +106,27 @@ class Autoscaler:
     def _unmet_demand(self) -> List[Dict[str, float]]:
         """Resource shapes that cannot be placed on current capacity."""
         demand: List[Dict[str, float]] = []
-        # queued specs beyond each node's availability, one unit each
-        for node in self._cluster.alive_nodes():
-            demand.extend(node.scheduler.pending_shapes())
+        # Queued specs beyond each node's own availability are only
+        # demand if NO other alive node could absorb them either —
+        # spillback (spill_delay_s) will move them before a new node
+        # could boot, so simulate placement against the other nodes'
+        # effective availability before counting a shape as unmet.
+        alive_nodes = self._cluster.alive_nodes()
+        sim_avail = {n.node_id: dict(n.scheduler.effective_avail())
+                     for n in alive_nodes}
+        for node in alive_nodes:
+            for shape in node.scheduler.pending_shapes():
+                placed = False
+                for nid, avail in sim_avail.items():
+                    if nid == node.node_id:
+                        continue   # pending_shapes already proved no fit
+                    if self._fits(shape, avail):
+                        for k, v in shape.items():
+                            avail[k] = avail.get(k, 0.0) - v
+                        placed = True
+                        break
+                if not placed:
+                    demand.append(shape)
         # tasks no node fits at all
         with self._cluster._lock:
             infeasible = list(self._cluster._infeasible)

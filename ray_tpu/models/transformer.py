@@ -158,6 +158,22 @@ class Transformer:
         return with_logical_constraint(x, axes, mesh=self.mesh,
                                        rules=_rules())
 
+    def _embed_lookup(self, table, tokens):
+        """Token embedding. With the table sharded (vocab->tp,
+        embed->fsdp) a gather forces SPMD involuntary full
+        rematerialization (xla spmd_partitioner.cc:652); the one-hot
+        contraction partitions cleanly (the vocab axis reduces with a
+        psum over tp) and runs on the MXU, so it is what the sharded
+        path uses — the same trade MaxText makes on TPU."""
+        m = self.mesh
+        if m is None or (m.shape.get("tp", 1) == 1
+                         and m.shape.get("fsdp", 1) == 1):
+            return table[tokens]
+        onehot = jax.nn.one_hot(tokens, self.config.vocab_size,
+                                dtype=table.dtype)
+        onehot = self._constrain(onehot, ("batch", "seq", "vocab"))
+        return onehot @ table
+
     def _layer(self, x, layer: Params, rope):
         """One block; returns (x, moe_aux_loss) — 0.0 for dense FFN."""
         c = self.config
@@ -221,7 +237,7 @@ class Transformer:
         custom_positions = positions is not None
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-        x = params["embed"].astype(ad)[tokens]
+        x = self._embed_lookup(params["embed"].astype(ad), tokens)
         x = self._constrain(x, ("batch", "seq", "act_embed"))
 
         # cos/sin computed once; identical for every layer and cheap to

@@ -151,7 +151,10 @@ class DeploymentHandle:
         self._name = name
         self._controller = controller
         self._replicas: List[Any] = []
-        self._inflight: Dict[int, int] = {}
+        # idx -> weakrefs of pending ObjectRefs. Weak so an idle handle
+        # never pins results: once the caller drops a result ref, it
+        # stops counting as (and stops being kept) in flight.
+        self._inflight: Dict[int, List[Any]] = {}
         self._refreshed = 0.0
         self._rng = __import__("random").Random(id(self) & 0xffff)
 
@@ -160,16 +163,41 @@ class DeploymentHandle:
             return
         self._replicas = ray_tpu.get(
             self._controller.get_replicas.remote(self._name))
-        self._inflight = {i: self._inflight.get(i, 0)
+        self._inflight = {i: self._inflight.get(i, [])
                           for i in range(len(self._replicas))}
         self._refreshed = time.time()
+
+    def _drain_done(self) -> None:
+        """Opportunistically drop refs that have resolved (or were
+        dropped by the caller) so in-flight counts reflect genuinely
+        outstanding requests (not just submission concurrency within
+        one tick)."""
+        import weakref as _wr
+        for idx, wrefs in self._inflight.items():
+            if not wrefs:
+                continue
+            live = [(w, w()) for w in wrefs]
+            refs = [r for _, r in live if r is not None]
+            done = set()
+            if refs:
+                ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                        timeout=0)
+                done = {id(r) for r in ready}
+            self._inflight[idx] = [w for w, r in live
+                                   if r is not None and id(r) not in done]
 
     def _pick(self) -> int:
         n = len(self._replicas)
         if n == 1:
             return 0
         a, b = self._rng.sample(range(n), 2)
-        return a if self._inflight[a] <= self._inflight[b] else b
+        return (a if len(self._inflight[a]) <= len(self._inflight[b])
+                else b)
+
+    def inflight_count(self) -> int:
+        """Outstanding requests on this handle (autoscaling signal)."""
+        self._drain_done()
+        return sum(len(v) for v in self._inflight.values())
 
     def remote(self, *args, **kwargs):
         return self.method("__call__", *args, **kwargs)
@@ -181,15 +209,13 @@ class DeploymentHandle:
             if not self._replicas:
                 raise RuntimeError(
                     f"deployment {self._name!r} has no live replicas")
+        self._drain_done()
         idx = self._pick()
-        self._inflight[idx] += 1
-        try:
-            return self._replicas[idx].handle_request.remote(
-                method_name, args, kwargs)
-        finally:
-            # decay immediately: the ref is async, queue-depth is
-            # approximated by submission concurrency within this tick
-            self._inflight[idx] = max(0, self._inflight[idx] - 1)
+        ref = self._replicas[idx].handle_request.remote(
+            method_name, args, kwargs)
+        import weakref as _wr
+        self._inflight[idx].append(_wr.ref(ref))
+        return ref
 
 
 # ---------------------------------------------------------- user API

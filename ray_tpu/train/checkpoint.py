@@ -206,7 +206,26 @@ def pack_dir(path: str) -> bytes:
 def unpack_dir(data: bytes, dest: str) -> str:
     os.makedirs(dest, exist_ok=True)
     with tarfile.open(fileobj=io.BytesIO(data), mode="r") as tar:
-        tar.extractall(dest, filter="data")
+        try:
+            tar.extractall(dest, filter="data")
+        except TypeError:
+            # filter= needs >=3.10.12/3.11.4; validate members manually
+            # on older patch releases before falling back.
+            root = os.path.realpath(dest)
+            members = tar.getmembers()
+            for m in members:
+                target = os.path.realpath(os.path.join(dest, m.name))
+                if not (target == root
+                        or target.startswith(root + os.sep)):
+                    raise RuntimeError(
+                        f"unsafe path in checkpoint tar: {m.name!r}")
+                if not (m.isreg() or m.isdir()):
+                    # filter="data" parity: no links, FIFOs, devices
+                    raise RuntimeError(
+                        f"non-regular member in checkpoint tar: "
+                        f"{m.name!r}")
+                m.mode &= 0o777   # strip setuid/setgid/sticky
+            tar.extractall(dest, members=members)
     return dest
 
 

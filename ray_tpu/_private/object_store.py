@@ -184,6 +184,10 @@ class LocalStore:
         self._restored_bytes_total = 0
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # Seal hook: called AFTER an object lands (outside the lock)
+        # with its id — the runtime's waiter registry resolves blocked
+        # gets/waits on it (event-driven, no parked threads).
+        self.on_seal = None
 
     # ------------------------------------------------------------- put
     def put_stored(self, obj: StoredObject) -> None:
@@ -204,6 +208,8 @@ class LocalStore:
         for name in stale:
             unlink_segment(name)
         self._write_spills(victims)
+        if self.on_seal is not None:
+            self.on_seal(obj.object_id)
 
     def put(self, value: Any, object_id: Optional[str] = None) -> str:
         obj = serialize(value, object_id)
@@ -340,6 +346,10 @@ class LocalStore:
             victims = self._pick_victims_locked()
             self._cv.notify_all()
         self._write_spills(victims)
+        # Re-admission is a seal: wake registry waiters that parked in
+        # the gap before this restore claimed the spill record.
+        if self.on_seal is not None:
+            self.on_seal(oid)
         return obj
 
     # ------------------------------------------------------------- get
@@ -347,10 +357,15 @@ class LocalStore:
         with self._lock:
             return (object_id in self._objects
                     or object_id in self._spilled
-                    or object_id in self._spilling)
+                    or object_id in self._spilling
+                    or object_id in self._restoring)
 
     def get_stored(self, object_id: str,
-                   timeout: Optional[float] = None) -> Optional[StoredObject]:
+                   timeout: Optional[float] = None,
+                   restore: bool = True) -> Optional[StoredObject]:
+        """restore=False is a residency-only probe: spilled objects
+        report a miss instead of triggering a synchronous disk read —
+        event-driven callers route restores to a worker pool."""
         with self._cv:
             def present():
                 return (object_id in self._objects
@@ -376,6 +391,8 @@ class LocalStore:
                     if obj is not None:
                         self._touched_at[object_id] = time.monotonic()
                     return obj
+                return None
+            if not restore:
                 return None
         obj = self._restore(object_id, timeout=timeout)
         if obj is not None:

@@ -73,6 +73,17 @@ class Connection:
                  name: str = ""):
         self._sock = sock
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Bound sends only (recv stays blocking: connections idle for
+        # minutes legitimately): waiter-registry replies run inline on
+        # sealing threads, so a wedged peer (full TCP buffer) must
+        # surface as a ConnectionClosed after this budget instead of
+        # hanging the sender forever — peer-death recovery then runs.
+        try:
+            self._sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                struct.pack("ll", 30, 0))
+        except OSError:
+            pass
         self._handler = handler
         self._on_close = on_close
         self.name = name

@@ -72,6 +72,15 @@ class Runtime(_context.BaseContext):
         # capacity via RAY_TPU_OBJECT_STORE_MEMORY (bytes); spill policy
         # must never touch objects pinned by in-flight tasks.
         self.store = LocalStore(pinned_fn=self.controller.pinned_ids)
+        from concurrent.futures import ThreadPoolExecutor
+        from ray_tpu._private.waiters import WaiterRegistry
+        # Blocked worker gets/waits park here (no thread each); the
+        # store's seal hook resolves them. Spill restores run on a small
+        # pool so disk reads never block connection reader threads.
+        self.waiters = WaiterRegistry(self.store.contains)
+        self.store.on_seal = self.waiters.notify
+        self._restore_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="rtpu-restore")
         self._shutdown = False
         self._actor_states: dict[str, _ActorState] = {}
         self._actor_lock = threading.Lock()
@@ -345,50 +354,97 @@ class Runtime(_context.BaseContext):
                                               worker_id=worker_id)
 
     def _on_get_object(self, conn: protocol.Connection, msg: dict) -> None:
+        """Event-driven get: a fast residency probe on the reader
+        thread; on miss the request parks in the waiter registry (no
+        thread) and the put_stored seal hook resolves it. Spilled
+        objects restore on a small worker pool so the disk read never
+        runs on a connection reader thread."""
         oid = msg["object_id"]
-        stored = self.store.get_stored(oid, timeout=0)
+        stored = self.store.get_stored(oid, timeout=0, restore=False)
         if stored is not None:
             conn.reply(msg, stored=stored)
             return
+        timeout = msg.get("timeout")
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         wid = conn.meta.get("worker_id")
         wsched = self._scheduler_for_worker(wid) if wid else None
+        if self.store.contains(oid):
+            self._restore_pool.submit(
+                self._blocking_get_reply, conn, msg, oid, deadline,
+                wsched, wid)
+            return
+        if wsched is not None:
+            wsched.worker_blocked(wid)
 
-        def waiter():
-            if wsched is not None:
-                wsched.worker_blocked(wid)
+        def reply(w, timed_out: bool) -> None:
             try:
-                got = self.store.get_stored(oid, timeout=msg.get("timeout"))
+                if timed_out:
+                    conn.reply(msg, stored=None, timeout=True)
+                    return
+                got = self.store.get_stored(oid, timeout=0, restore=False)
                 if got is not None:
                     conn.reply(msg, stored=got)
+                elif self.store.contains(oid):
+                    # sealed then instantly spilled: remaining budget only
+                    self._restore_pool.submit(
+                        self._blocking_get_reply, conn, msg, oid,
+                        deadline, wsched, wid)
                 else:
+                    # sealed then evicted in the gap: genuine miss
                     conn.reply(msg, stored=None, timeout=True)
             except protocol.ConnectionClosed:
                 pass
-            finally:
-                if wsched is not None:
-                    wsched.worker_unblocked(wid)
-        threading.Thread(target=waiter, daemon=True).start()
+
+        self.waiters.add_get(
+            oid, reply, timeout,
+            on_done=((lambda: wsched.worker_unblocked(wid))
+                     if wsched is not None else None))
+
+    def _blocking_get_reply(self, conn, msg, oid,
+                            deadline: Optional[float],
+                            wsched=None, wid=None) -> None:
+        """Restore-pool path: blocking fetch (may read a spill file).
+        The worker stays marked blocked for the duration so its
+        scheduler slot is released (oversubscription parity with the
+        old thread-per-get path)."""
+        if wsched is not None:
+            wsched.worker_blocked(wid)
+        try:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            got = self.store.get_stored(oid, timeout=remaining)
+            if got is not None:
+                conn.reply(msg, stored=got)
+            else:
+                conn.reply(msg, stored=None, timeout=True)
+        except protocol.ConnectionClosed:
+            pass
+        finally:
+            if wsched is not None:
+                wsched.worker_unblocked(wid)
 
     def _on_wait(self, conn: protocol.Connection, msg: dict) -> None:
         ids, num_returns = msg["object_ids"], msg["num_returns"]
-        timeout = msg.get("timeout")
+        ready_now = [o for o in ids if self.store.contains(o)]
+        if len(ready_now) >= num_returns:
+            conn.reply(msg, ready=ready_now[:num_returns])
+            return
         wid = conn.meta.get("worker_id")
         wsched = self._scheduler_for_worker(wid) if wid else None
+        if wsched is not None:
+            wsched.worker_blocked(wid)
 
-        def waiter():
-            if wsched is not None:
-                wsched.worker_blocked(wid)
+        def reply(w, ready: list[str]) -> None:
             try:
-                ready = self.store.wait_any(ids, num_returns, timeout)
-                ready_set = set(ready)
-                capped = [o for o in ids if o in ready_set][:num_returns]
-                conn.reply(msg, ready=capped)
+                conn.reply(msg, ready=ready[:num_returns])
             except protocol.ConnectionClosed:
                 pass
-            finally:
-                if wsched is not None:
-                    wsched.worker_unblocked(wid)
-        threading.Thread(target=waiter, daemon=True).start()
+
+        self.waiters.add_wait(
+            ids, num_returns, reply, msg.get("timeout"),
+            on_done=((lambda: wsched.worker_unblocked(wid))
+                     if wsched is not None else None))
 
     def _kv_dispatch(self, msg: dict) -> Any:
         op = msg["op"]
@@ -638,6 +694,8 @@ class Runtime(_context.BaseContext):
             return self.cluster.stats()
         if op == "object_store_stats":
             return self.store.stats()
+        if op == "waiter_stats":
+            return self.waiters.stats()
         if op == "pubsub_poll":
             return self.controller.pubsub.poll(
                 kwargs["channel"], kwargs.get("cursor", 0),
@@ -664,6 +722,8 @@ class Runtime(_context.BaseContext):
             return
         self._shutdown = True
         self.cluster.shutdown()
+        self.waiters.shutdown()
+        self._restore_pool.shutdown(wait=False)
         try:
             self._listener.close()
         except OSError:

@@ -104,6 +104,40 @@ class ClusterTaskManager:
         self._retry_pending_pgs()
         return rec
 
+    def add_remote_node(self, conn, resources: Dict[str, float],
+                        labels: Optional[Dict[str, str]] = None,
+                        advertise_addr: Optional[tuple] = None,
+                        node_id: Optional[str] = None) -> NodeRecord:
+        """A node-agent process registered over TCP (reference
+        GcsNodeManager::HandleRegisterNode, gcs_node_manager.h:62). The
+        node's scheduler is a RemoteNodeHandle proxy; the real scheduler
+        + worker pool run in the agent. The agent mints its own node id
+        (its scheduler must exist before the head can route to it)."""
+        from ray_tpu._private.remote_node import RemoteNodeHandle
+        node_id = node_id or ("node_" + uuid.uuid4().hex[:8])
+        proxy = RemoteNodeHandle(node_id, conn, dict(resources),
+                                 advertise_addr or ("127.0.0.1", 0))
+        rec = NodeRecord(node_id=node_id, scheduler=proxy, is_head=False,
+                         labels=dict(labels or {}))
+        with self._lock:
+            self._nodes[node_id] = rec
+        self._rt.controller.register_node(node_id, resources,
+                                          is_head=False, labels=labels)
+        self._rt.controller.publish_node_event(node_id, "ALIVE")
+        # Deferred: retries may issue bundle-reserve RPCs on THIS conn,
+        # and we are on its reader thread (a blocking request here would
+        # deadlock against ourselves).
+        threading.Thread(target=self._retry_after_join,
+                         name="rtpu-join-retry", daemon=True).start()
+        return rec
+
+    def _retry_after_join(self) -> None:
+        try:
+            self._retry_infeasible()
+            self._retry_pending_pgs()
+        except Exception:
+            pass
+
     def remove_node(self, node_id: str, graceful: bool = True) -> None:
         """Graceful drain or simulated abrupt node death."""
         with self._lock:
@@ -553,6 +587,10 @@ class ClusterTaskManager:
             self._rt._recover_task(task)
         for actor_id in actor_ids:
             self._rt._recover_actor(actor_id)
+        # 3b. Objects whose only copy lived on the dead node: lineage
+        #     reconstruction (ResubmitTask parity).
+        if hasattr(self._rt, "on_node_objects_lost"):
+            self._rt.on_node_objects_lost(node_id)
         # 4. PG bundles reserved on the dead node go back to pending and
         #    try to re-reserve elsewhere (GcsPlacementGroupManager
         #    rescheduling path).

@@ -72,6 +72,20 @@ _define("worker_pool_max", 0,
         "pinned workers are dedicated processes outside the cap.")
 _define("task_event_history", 10_000,
         "Bounded task-event history length in the controller.")
+_define("remote_inline_max_bytes", 64 * 1024,
+        "Task results at or below this size are forwarded inline from a "
+        "node agent to the head (owner-inline parity, reference "
+        "core_worker.h AllocateReturnObject); larger results stay in "
+        "the agent's store and register a location.")
+_define("bind_host", "127.0.0.1",
+        "Head listener bind host. Set 0.0.0.0 (or a NIC address) to "
+        "accept remote node agents; loopback by default.")
+_define("port", 0,
+        "Head listener port; 0 picks an ephemeral port.")
+_define("lineage_max_resubmits", 3,
+        "Cap on per-task lineage re-executions when a node death "
+        "orphans a still-referenced object (reference task_manager "
+        "ResubmitTask bookkeeping).")
 
 
 class _Config:

@@ -68,6 +68,16 @@ class Controller:
         self._pins: dict[str, int] = collections.defaultdict(int)
         self._pgs: dict[str, dict] = {}
         self._nodes: dict[str, NodeTableRecord] = {}
+        # Object directory: object_id -> {node_id} holding a copy
+        # (reference ownership_based_object_directory.cc role; here the
+        # head IS the owner of record for every object).
+        self._locations: dict[str, set[str]] = {}
+        self._location_nbytes: dict[str, int] = {}
+        # Lineage: return object_id -> producing TaskSpec, kept while
+        # the object is referenced so a lost copy can be re-executed
+        # (reference task_manager.h:269 ResubmitTask,
+        # object_recovery_manager.h:41).
+        self._lineage: dict[str, Any] = {}
         self._task_events: collections.deque = collections.deque(
             maxlen=task_event_capacity)
         from ray_tpu._private.pubsub import Publisher
@@ -148,6 +158,65 @@ class Controller:
         with self._lock:
             return (self._refcounts.get(object_id, 0) == 0
                     and self._pins[object_id] == 0)
+
+    # ---- object directory (ownership_based_object_directory parity) ----
+    def add_location(self, object_id: str, node_id: str,
+                     nbytes: int = 0) -> None:
+        with self._lock:
+            self._locations.setdefault(object_id, set()).add(node_id)
+            if nbytes:
+                self._location_nbytes[object_id] = nbytes
+
+    def remove_location(self, object_id: str,
+                        node_id: Optional[str] = None) -> None:
+        with self._lock:
+            if node_id is None:
+                self._locations.pop(object_id, None)
+                self._location_nbytes.pop(object_id, None)
+                return
+            s = self._locations.get(object_id)
+            if s is not None:
+                s.discard(node_id)
+                if not s:
+                    self._locations.pop(object_id, None)
+                    self._location_nbytes.pop(object_id, None)
+
+    def locations(self, object_id: str) -> list[str]:
+        with self._lock:
+            return list(self._locations.get(object_id, ()))
+
+    def has_location(self, object_id: str) -> bool:
+        with self._lock:
+            return bool(self._locations.get(object_id))
+
+    def purge_node_locations(self, node_id: str) -> list[str]:
+        """Drop `node_id` from every directory entry; returns object ids
+        that now have NO copy anywhere (lineage-recovery candidates)."""
+        orphaned: list[str] = []
+        with self._lock:
+            for oid in list(self._locations):
+                s = self._locations[oid]
+                if node_id in s:
+                    s.discard(node_id)
+                    if not s:
+                        self._locations.pop(oid, None)
+                        self._location_nbytes.pop(oid, None)
+                        orphaned.append(oid)
+        return orphaned
+
+    # ---- lineage (ResubmitTask parity) ----
+    def record_lineage(self, spec: Any) -> None:
+        with self._lock:
+            for oid in getattr(spec, "return_ids", ()):
+                self._lineage[oid] = spec
+
+    def lineage_for(self, object_id: str) -> Any:
+        with self._lock:
+            return self._lineage.get(object_id)
+
+    def drop_lineage(self, object_id: str) -> None:
+        with self._lock:
+            self._lineage.pop(object_id, None)
 
     # ---- actors ----
     def register_actor(self, spec: ActorSpec) -> ActorRecord:

@@ -69,7 +69,17 @@ class StoredObject:
 def _create_segment(name: str, data: memoryview) -> None:
     """Create + fill a named segment, then release all process-local
     resources; the segment persists by name until shm_unlink."""
-    shm = shared_memory.SharedMemory(name=name, create=True, size=len(data))
+    try:
+        shm = shared_memory.SharedMemory(name=name, create=True,
+                                         size=len(data))
+    except FileExistsError:
+        # Stale segment from a killed process re-running the same task
+        # (lineage resubmission re-uses the object id, and same-host
+        # node agents share /dev/shm). The name encodes the producing
+        # task, so reclaiming is safe.
+        unlink_segment(name)
+        shm = shared_memory.SharedMemory(name=name, create=True,
+                                         size=len(data))
     shm.buf[:len(data)] = data
     try:
         resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]

@@ -48,6 +48,28 @@ PING = "ping"                    # either
 REPLY = "reply"                  # either (generic reply)
 STATE_OP = "state_op"            # worker -> driver: state/metrics queries
 
+# ---- multi-host: node agent <-> head (reference raylet <-> GCS,
+# gcs_node_manager.h:62 HandleRegisterNode; ray_syncer.h:88 resource
+# gossip; object_manager.cc node-to-node transfer) ----
+NODE_REGISTER = "node_register"        # agent -> head (reply: node_id)
+NODE_HEARTBEAT = "node_heartbeat"      # agent -> head: resource view
+NODE_ENQUEUE = "node_enqueue"          # head -> agent: spec to queue
+NODE_CANCEL_PENDING = "node_cancel_pending"  # head -> agent (reply found)
+NODE_CANCEL_RUNNING = "node_cancel_running"  # head -> agent
+NODE_KILL_WORKER = "node_kill_worker"  # head -> agent
+NODE_SEND_ACTOR_TASK = "node_send_actor_task"  # head -> agent (reply ok)
+NODE_RESERVE_BUNDLE = "node_reserve_bundle"    # head -> agent (reply ok)
+NODE_RELEASE_BUNDLE = "node_release_bundle"    # head -> agent
+NODE_EVENT = "node_event"              # agent -> head: dispatch/lost/
+                                       #   object_at location registers/...
+NODE_TASK_DONE = "node_task_done"      # agent -> head: control + results
+NODE_DELETE_OBJECT = "node_delete_object"      # head -> agent
+NODE_SHUTDOWN = "node_shutdown"        # head -> agent
+OBJECT_LOOKUP = "object_lookup"        # agent -> head (reply: stored |
+                                       #   location | timeout)
+PULL_OBJECT = "pull_object"            # any -> holder (reply: pull meta)
+PULL_CHUNK = "pull_chunk"              # any -> holder (reply: data)
+
 
 def dumps(obj: Any) -> bytes:
     """Serialize a message. cloudpickle handles closures/lambdas in specs."""
@@ -107,6 +129,11 @@ class Connection:
             try:
                 self._sock.sendall(header + data)
             except OSError as e:
+                # A failed sendall may have written a PARTIAL frame
+                # (e.g. the SO_SNDTIMEO budget expired mid-write); the
+                # stream is desynced, so the connection must die — a
+                # later send would be parsed as garbage by the peer.
+                self.close()
                 raise ConnectionClosed(str(e)) from e
 
     def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
@@ -195,14 +222,37 @@ class _Future:
         self._event = threading.Event()
         self._value: Any = None
         self._error: Optional[BaseException] = None
+        self._callbacks: list[Callable[["_Future"], None]] = []
+        self._cb_lock = threading.Lock()
+
+    def add_done_callback(self, fn: Callable[["_Future"], None]) -> None:
+        """Run `fn(self)` when the reply lands (on the reader thread) —
+        relays pipe replies onward without parking a thread. Runs
+        immediately if already done."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _fire(self) -> None:
+        with self._cb_lock:
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            try:
+                fn(self)
+            except Exception:
+                pass
 
     def set(self, value: Any) -> None:
         self._value = value
         self._event.set()
+        self._fire()
 
     def set_error(self, err: BaseException) -> None:
         self._error = err
         self._event.set()
+        self._fire()
 
     def done(self) -> bool:
         return self._event.is_set()

@@ -66,21 +66,30 @@ class Runtime(_context.BaseContext):
                  num_tpus: Optional[float] = None,
                  resources: Optional[dict] = None,
                  max_workers: Optional[int] = None,
-                 namespace: str = "default"):
+                 namespace: str = "default",
+                 bind_host: Optional[str] = None,
+                 port: Optional[int] = None):
         self.namespace = namespace
         self.controller = Controller()
         # capacity via RAY_TPU_OBJECT_STORE_MEMORY (bytes); spill policy
         # must never touch objects pinned by in-flight tasks.
         self.store = LocalStore(pinned_fn=self.controller.pinned_ids)
         from concurrent.futures import ThreadPoolExecutor
+        from ray_tpu._private.object_transfer import PullServer
         from ray_tpu._private.waiters import WaiterRegistry
         # Blocked worker gets/waits park here (no thread each); the
-        # store's seal hook resolves them. Spill restores run on a small
-        # pool so disk reads never block connection reader threads.
-        self.waiters = WaiterRegistry(self.store.contains)
+        # store's seal hook resolves them. "Present" means a local copy
+        # OR a known remote location (multi-host). Spill restores and
+        # remote pulls run on a small pool so disk reads / network
+        # fetches never block connection reader threads.
+        self.waiters = WaiterRegistry(
+            lambda oid: (self.store.contains(oid)
+                         or self.controller.has_location(oid)))
         self.store.on_seal = self.waiters.notify
         self._restore_pool = ThreadPoolExecutor(
             max_workers=2, thread_name_prefix="rtpu-restore")
+        self._pull_server = PullServer(self.store,
+                                       executor=self._restore_pool)
         self._shutdown = False
         self._actor_states: dict[str, _ActorState] = {}
         self._actor_lock = threading.Lock()
@@ -99,9 +108,11 @@ class Runtime(_context.BaseContext):
         if resources:
             node_res.update({k: float(v) for k, v in resources.items()})
 
+        from ray_tpu._private.config import CONFIG as _CFG2
+        bind = bind_host or _CFG2.bind_host
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(("127.0.0.1", 0))
+        self._listener.bind((bind, int(port or _CFG2.port)))
         self._listener.listen(128)
         self.address = self._listener.getsockname()
 
@@ -135,8 +146,15 @@ class Runtime(_context.BaseContext):
             conn.start()
 
     def _on_conn_closed(self, conn: protocol.Connection) -> None:
+        if self._shutdown:
+            return
+        nid = conn.meta.get("node_id")
+        if nid is not None:
+            # an agent's control connection dropped: node death
+            self.cluster._on_node_death(nid, cause="agent disconnected")
+            return
         wid = conn.meta.get("worker_id")
-        if wid is None or self._shutdown:
+        if wid is None:
             return
         sched = self._scheduler_for_worker(wid)
         if sched is None:
@@ -241,7 +259,7 @@ class Runtime(_context.BaseContext):
     def _unpin(self, object_ids: list[str]) -> None:
         for oid in object_ids:
             if self.controller.unpin(oid):
-                self.store.delete(oid)
+                self._delete_everywhere(oid)
 
     # ================= scheduler callbacks =================
     def on_task_dispatched(self, spec: TaskSpec, worker_id: str) -> None:
@@ -297,6 +315,29 @@ class Runtime(_context.BaseContext):
         elif mtype == protocol.STATE_OP:
             conn.reply(msg, value=self.state_op(msg["op"], **msg.get(
                 "kwargs", {})))
+        elif mtype == protocol.NODE_REGISTER:
+            rec = self.cluster.add_remote_node(
+                conn, msg["resources"], labels=msg.get("labels"),
+                advertise_addr=tuple(msg["advertise_addr"]),
+                node_id=msg.get("node_id"))
+            conn.meta["node_id"] = rec.node_id
+            conn.reply(msg, node_id=rec.node_id)
+        elif mtype == protocol.NODE_HEARTBEAT:
+            nid = msg["node_id"]
+            self.cluster.heartbeat(nid)
+            rec = self.cluster.get_node(nid)
+            if rec is not None:
+                rec.scheduler.on_heartbeat(msg)
+        elif mtype == protocol.NODE_EVENT:
+            self._on_node_event(conn, msg)
+        elif mtype == protocol.NODE_TASK_DONE:
+            self._on_node_task_done(conn, msg)
+        elif mtype == protocol.OBJECT_LOOKUP:
+            self._on_object_lookup(conn, msg)
+        elif mtype == protocol.PULL_OBJECT:
+            self._pull_server.handle_pull(conn, msg)
+        elif mtype == protocol.PULL_CHUNK:
+            self._pull_server.handle_chunk(conn, msg)
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)
 
@@ -353,6 +394,187 @@ class Runtime(_context.BaseContext):
             self.controller.record_task_event(spec.task_id, spec.name, state,
                                               worker_id=worker_id)
 
+    # ================= node-agent message handlers =================
+    def _proxy_for(self, node_id: str):
+        rec = self.cluster.get_node(node_id)
+        return rec.scheduler if rec is not None else None
+
+    def _on_node_event(self, conn: protocol.Connection, msg: dict) -> None:
+        kind = msg["kind"]
+        proxy = self._proxy_for(msg["node_id"])
+        if kind == "task_dispatched":
+            if proxy is not None:
+                proxy.on_dispatched(msg["key"], msg["worker_id"])
+            self.controller.record_task_event(
+                msg["key"], msg.get("name", ""), "RUNNING",
+                worker_id=msg["worker_id"])
+        elif kind == "actor_dispatched":
+            if proxy is not None:
+                proxy.on_dispatched(msg["key"], msg["worker_id"],
+                                    actor_id=msg["actor_id"])
+            self.controller.set_actor_state(msg["actor_id"], PENDING,
+                                            worker_id=msg["worker_id"])
+        elif kind == "worker_lost":
+            if proxy is not None:
+                proxy.on_worker_lost(msg["worker_id"])
+            task = msg.get("task")
+            if task is not None:
+                if proxy is not None:
+                    proxy.on_finished(task.task_id)
+                self._recover_task(task)
+            actor_id = msg.get("actor_id")
+            if actor_id is not None:
+                if proxy is not None:
+                    proxy.on_finished("actor:" + actor_id)
+                self._recover_actor(actor_id)
+        elif kind == "unplaceable":
+            if proxy is not None:
+                proxy.on_finished(proxy._key(msg["spec"]))
+            self.on_unplaceable(msg["spec"], msg["reason"])
+        elif kind == "object_at":
+            if msg.get("addref"):
+                self.controller.addref(msg["object_id"])
+            self.controller.add_location(msg["object_id"], msg["node_id"],
+                                         msg.get("nbytes", 0))
+            self.waiters.notify(msg["object_id"])
+        elif kind == "location_gone":
+            holder = msg.get("holder")
+            if holder:
+                self.controller.remove_location(msg["object_id"], holder)
+        elif kind == "actor_task_undeliverable":
+            # the agent couldn't hand the pushed task to its worker
+            # (worker died in the gap): requeue unless recovery already
+            # claimed it (mirrors the local send-failure path)
+            spec = msg["spec"]
+            st = self._actor_state(msg["actor_id"])
+            with st.lock:
+                if st.inflight.pop(spec.task_id, None) is not None:
+                    st.queued.append(spec)
+
+    def _on_node_task_done(self, conn: protocol.Connection,
+                           msg: dict) -> None:
+        """NODE_TASK_DONE: the control half of a remote TASK_DONE. Bulk
+        results either arrived inline (small / errors) or stayed in the
+        agent's store with a location registered here."""
+        node_id = msg["node_id"]
+        proxy = self._proxy_for(node_id)
+        for stored in msg.get("inline", []):
+            self.store.put_stored(stored)
+            if self.controller.unreferenced(stored.object_id):
+                self.store.delete(stored.object_id)
+        for oid, nbytes in msg.get("located", []):
+            self.controller.add_location(oid, node_id, nbytes)
+            self.waiters.notify(oid)
+        worker_id = msg.get("worker_id", "")
+        if msg.get("is_actor_create"):
+            actor_id = msg["actor_id"]
+            if proxy is not None:
+                proxy.on_finished("actor:" + actor_id)
+                # keep the actor's mirror entry: restarts need the spec
+                rec0 = self.controller.get_actor(actor_id)
+                if rec0 is not None and not msg.get("error"):
+                    proxy.track_live_actor(actor_id, rec0.spec)
+            if msg.get("error"):
+                rec = self.controller.get_actor(actor_id)
+                if rec is not None:
+                    rec.spec.max_restarts = 0
+                self.controller.set_actor_state(
+                    actor_id, DEAD, death_cause="creation failed")
+                st = self._actor_state(actor_id)
+                with st.lock:
+                    dead = st.queued
+                    st.queued = []
+                cause = msg.get("error_repr", "actor __init__ raised")
+                for t in dead:
+                    self._store_error(t.return_ids, TaskError(
+                        ActorDiedError(actor_id, cause), task_name=t.name))
+            else:
+                self.controller.set_actor_state(actor_id, ALIVE,
+                                                worker_id=worker_id)
+                self._flush_actor_queue(actor_id)
+            return
+        task_id = msg["task_id"]
+        if msg.get("is_actor_task"):
+            st = self._actor_states.get(msg.get("actor_id", ""))
+            if st is not None:
+                with st.lock:
+                    spec = st.inflight.pop(task_id, None)
+                if spec is not None:
+                    self._unpin(spec.pinned_refs)
+            state = "FAILED" if msg.get("error") else "FINISHED"
+            self.controller.record_task_event(task_id, msg.get("name", ""),
+                                              state, worker_id=worker_id)
+            return
+        spec = proxy.on_finished(task_id) if proxy is not None else None
+        if spec is not None:
+            self._unpin(spec.pinned_refs)
+            state = "FAILED" if msg.get("error") else "FINISHED"
+            self.controller.record_task_event(spec.task_id, spec.name,
+                                              state, worker_id=worker_id)
+
+    def _on_object_lookup(self, conn: protocol.Connection,
+                          msg: dict) -> None:
+        """An agent asks where an object lives; parks here until it
+        exists anywhere (the head owns waiter parking cluster-wide)."""
+        oid = msg["object_id"]
+
+        def answer(w=None, timed_out: bool = False) -> None:
+            try:
+                if timed_out:
+                    conn.reply(msg, stored=None, location=None)
+                    return
+                stored = self.store.get_stored(oid, timeout=0,
+                                               restore=False)
+                if stored is None and self.store.contains(oid):
+                    # spilled head-side: restore off-thread, then serve
+                    self._restore_pool.submit(self._lookup_restore_reply,
+                                              conn, msg, oid)
+                    return
+                if stored is not None:
+                    from ray_tpu._private.config import CONFIG as _C
+                    from ray_tpu._private.object_transfer import materialize
+                    if stored.nbytes <= _C.remote_inline_max_bytes:
+                        conn.reply(msg, stored=materialize(stored))
+                    else:
+                        conn.reply(msg, stored=None, head_pull=True)
+                    return
+                locs = self.controller.locations(oid)
+                alive = {n.node_id: n for n in self.cluster.alive_nodes()}
+                for nid in locs:
+                    rec = alive.get(nid)
+                    addr = getattr(rec.scheduler, "advertise_addr",
+                                   None) if rec else None
+                    if addr is not None:
+                        conn.reply(msg, stored=None,
+                                   location={"host": addr[0],
+                                             "port": addr[1],
+                                             "node_id": nid})
+                        return
+                conn.reply(msg, stored=None, location=None)
+            except protocol.ConnectionClosed:
+                pass
+
+        if (self.store.contains(oid)
+                or self.controller.has_location(oid)):
+            answer()
+            return
+        self.waiters.add_get(oid, lambda w, to: answer(w, to),
+                             msg.get("timeout"))
+
+    def _lookup_restore_reply(self, conn, msg, oid: str) -> None:
+        from ray_tpu._private.config import CONFIG as _C
+        from ray_tpu._private.object_transfer import materialize
+        try:
+            stored = self.store.get_stored(oid, timeout=30)
+            if stored is None:
+                conn.reply(msg, stored=None, location=None)
+            elif stored.nbytes <= _C.remote_inline_max_bytes:
+                conn.reply(msg, stored=materialize(stored))
+            else:
+                conn.reply(msg, stored=None, head_pull=True)
+        except protocol.ConnectionClosed:
+            pass
+
     def _on_get_object(self, conn: protocol.Connection, msg: dict) -> None:
         """Event-driven get: a fast residency probe on the reader
         thread; on miss the request parks in the waiter registry (no
@@ -369,13 +591,22 @@ class Runtime(_context.BaseContext):
                     else time.monotonic() + timeout)
         wid = conn.meta.get("worker_id")
         wsched = self._scheduler_for_worker(wid) if wid else None
-        if self.store.contains(oid):
+        if self.store.contains(oid) or self.controller.has_location(oid):
             self._restore_pool.submit(
                 self._blocking_get_reply, conn, msg, oid, deadline,
                 wsched, wid)
             return
+        self._park_get(conn, msg, oid, deadline, wsched, wid)
+
+    def _park_get(self, conn, msg, oid, deadline: Optional[float],
+                  wsched, wid) -> None:
+        """Park a get in the waiter registry until the object seals
+        locally or a location registers; resolution routes any actual
+        disk/network work back to the restore pool."""
         if wsched is not None:
             wsched.worker_blocked(wid)
+        remaining = (None if deadline is None
+                     else max(0.0, deadline - time.monotonic()))
 
         def reply(w, timed_out: bool) -> None:
             try:
@@ -385,8 +616,9 @@ class Runtime(_context.BaseContext):
                 got = self.store.get_stored(oid, timeout=0, restore=False)
                 if got is not None:
                     conn.reply(msg, stored=got)
-                elif self.store.contains(oid):
-                    # sealed then instantly spilled: remaining budget only
+                elif (self.store.contains(oid)
+                      or self.controller.has_location(oid)):
+                    # spilled or remote: remaining budget only
                     self._restore_pool.submit(
                         self._blocking_get_reply, conn, msg, oid,
                         deadline, wsched, wid)
@@ -397,32 +629,151 @@ class Runtime(_context.BaseContext):
                 pass
 
         self.waiters.add_get(
-            oid, reply, timeout,
+            oid, reply, remaining,
             on_done=((lambda: wsched.worker_unblocked(wid))
                      if wsched is not None else None))
 
     def _blocking_get_reply(self, conn, msg, oid,
                             deadline: Optional[float],
                             wsched=None, wid=None) -> None:
-        """Restore-pool path: blocking fetch (may read a spill file).
-        The worker stays marked blocked for the duration so its
-        scheduler slot is released (oversubscription parity with the
-        old thread-per-get path)."""
+        """Restore/pull-pool path: does only work that is actionable NOW
+        (spill restore, remote pull). If the object becomes truly absent
+        — stale location dropped, nothing local — the request goes BACK
+        to the waiter registry instead of parking a pool thread: the
+        2-thread pool must never be consumed by indefinite waits. The
+        worker stays marked blocked while we do actual work here
+        (oversubscription parity with the old thread-per-get path)."""
         if wsched is not None:
             wsched.worker_blocked(wid)
         try:
-            remaining = (None if deadline is None
-                         else max(0.0, deadline - time.monotonic()))
-            got = self.store.get_stored(oid, timeout=remaining)
-            if got is not None:
-                conn.reply(msg, stored=got)
-            else:
-                conn.reply(msg, stored=None, timeout=True)
+            while True:
+                got = self.store.get_stored(oid, timeout=0)
+                if got is not None:
+                    conn.reply(msg, stored=got)
+                    return
+                if self.controller.has_location(oid):
+                    got = self._pull_remote(oid)
+                    if got is not None:
+                        conn.reply(msg, stored=got)
+                        return
+                    continue            # stale location dropped; re-check
+                if (deadline is not None
+                        and time.monotonic() >= deadline):
+                    conn.reply(msg, stored=None, timeout=True)
+                    return
+                # nothing actionable: hand back to the registry
+                self._park_get(conn, msg, oid, deadline, wsched, wid)
+                return
         except protocol.ConnectionClosed:
             pass
         finally:
             if wsched is not None:
                 wsched.worker_unblocked(wid)
+
+    # ================= cross-host object fetch =================
+    def _get_stored_anywhere(self, oid: str,
+                             timeout: Optional[float]) -> Optional[
+                                 StoredObject]:
+        """Blocking fetch that spans the cluster: local store (incl.
+        spill restore), else chunked pull from whichever alive agent
+        holds a copy (reference pull_manager.cc role). Stale locations
+        (holder died/evicted) are dropped and the wait resumes, which
+        gives lineage resubmission time to regenerate the object."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            stored = self.store.get_stored(oid, timeout=0)
+            if stored is not None:
+                return stored
+            if self.controller.has_location(oid):
+                stored = self._pull_remote(oid)
+                if stored is not None:
+                    return stored
+                continue                 # stale location dropped; retry
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                return None
+            ev = threading.Event()
+            self.waiters.add_get(oid, lambda w, to: ev.set(), remaining)
+            ev.wait(None if remaining is None else remaining + 1)
+            if deadline is not None and time.monotonic() > deadline:
+                # one last probe: the seal may have raced the deadline
+                stored = self.store.get_stored(oid, timeout=0)
+                if stored is not None:
+                    return stored
+                if not self.controller.has_location(oid):
+                    return None
+
+    def _pull_remote(self, oid: str) -> Optional[StoredObject]:
+        """Pull one object from any alive agent holding it; caches the
+        bytes in the head store (LRU/spill governs them from there).
+        Returns None after dropping every stale location."""
+        from ray_tpu._private.object_transfer import pull_object
+        for nid in self.controller.locations(oid):
+            rec = self.cluster.get_node(nid)
+            if rec is None or not rec.alive:
+                self.controller.remove_location(oid, nid)
+                continue
+            conn = getattr(rec.scheduler, "conn", None)
+            if conn is None:       # local in-process node: nothing to pull
+                self.controller.remove_location(oid, nid)
+                continue
+            try:
+                stored = pull_object(conn, oid)
+            except (protocol.ConnectionClosed, TimeoutError):
+                stored = None
+            if stored is not None:
+                self.store.put_stored(stored)
+                return stored
+            self.controller.remove_location(oid, nid)
+        return None
+
+    def _delete_everywhere(self, oid: str) -> None:
+        """Deletion fan-out: local store + every agent holding a copy."""
+        self.store.delete(oid)
+        locs = self.controller.locations(oid)
+        for nid in locs:
+            rec = self.cluster.get_node(nid)
+            conn = getattr(rec.scheduler, "conn", None) if rec else None
+            if conn is not None:
+                try:
+                    conn.send({"type": protocol.NODE_DELETE_OBJECT,
+                               "object_id": oid})
+                except protocol.ConnectionClosed:
+                    pass
+        if locs:
+            self.controller.remove_location(oid)
+        self.controller.drop_lineage(oid)
+
+    def on_node_objects_lost(self, node_id: str) -> None:
+        """Lineage reconstruction (reference task_manager.h:269
+        ResubmitTask + object_recovery_manager.h:41): objects whose ONLY
+        copy died with `node_id` and are still referenced get their
+        producing task resubmitted. Single-level: if the resubmitted
+        task's own args were also lost, their gets re-enter this path
+        when their holders' deaths are processed."""
+        from ray_tpu._private.config import CONFIG as _C
+        orphaned = self.controller.purge_node_locations(node_id)
+        resubmitted: set[str] = set()
+        for oid in orphaned:
+            if self.controller.unreferenced(oid):
+                self.controller.drop_lineage(oid)
+                continue
+            spec = self.controller.lineage_for(oid)
+            if spec is None or spec.task_id in resubmitted:
+                continue
+            n = getattr(spec, "lineage_resubmits", 0)
+            if n >= _C.lineage_max_resubmits:
+                continue
+            spec.lineage_resubmits = n + 1
+            resubmitted.add(spec.task_id)
+            self.controller.record_task_event(
+                spec.task_id, spec.name, "RESUBMITTED",
+                error=f"lost output {oid} on {node_id}")
+            for pid in spec.pinned_refs:
+                self.controller.pin(pid)
+            self.cluster.submit(spec)
 
     def _on_wait(self, conn: protocol.Connection, msg: dict) -> None:
         ids, num_returns = msg["object_ids"], msg["num_returns"]
@@ -478,7 +829,7 @@ class Runtime(_context.BaseContext):
         for oid in object_ids:
             remaining = None if deadline is None else max(
                 0.0, deadline - time.time())
-            stored = self.store.get_stored(oid, timeout=remaining)
+            stored = self._get_stored_anywhere(oid, remaining)
             if stored is None:
                 raise GetTimeoutError(
                     f"get() timed out waiting for {oid}")
@@ -489,7 +840,7 @@ class Runtime(_context.BaseContext):
                 # get_stored and the map (rare: touch-grace usually
                 # prevents it). The data lives in the spill file —
                 # re-fetch; the restore comes back with inline buffers.
-                stored = self.store.get_stored(oid, timeout=remaining)
+                stored = self._get_stored_anywhere(oid, remaining)
                 if stored is None:
                     raise GetTimeoutError(
                         f"get() timed out waiting for {oid}")
@@ -501,11 +852,18 @@ class Runtime(_context.BaseContext):
 
     def wait(self, object_ids: list[str], num_returns: int,
              timeout: Optional[float]) -> tuple[list[str], list[str]]:
-        ready = self.store.wait_any(object_ids, num_returns, timeout)
-        # Contract: at most num_returns in the ready list (reference
-        # ray.wait semantics), in input order.
-        ready_set = set(ready)
-        ready_list = [o for o in object_ids if o in ready_set][:num_returns]
+        """Registry-based wait spanning local residency AND remote
+        locations. Contract: at most num_returns ready, input order."""
+        result: list[list[str]] = []
+        ev = threading.Event()
+
+        def reply(w, ready: list[str]) -> None:
+            result.append(ready)
+            ev.set()
+
+        self.waiters.add_wait(object_ids, num_returns, reply, timeout)
+        ev.wait(None if timeout is None else timeout + 5)
+        ready_list = (result[0] if result else [])[:num_returns]
         taken = set(ready_list)
         not_ready = [o for o in object_ids if o not in taken]
         return ready_list, not_ready
@@ -517,11 +875,12 @@ class Runtime(_context.BaseContext):
         if self._shutdown:
             return
         if self.controller.decref(object_id):
-            self.store.delete(object_id)
+            self._delete_everywhere(object_id)
 
     def submit_spec(self, spec: TaskSpec) -> list[str]:
         for oid in spec.pinned_refs:
             self.controller.pin(oid)
+        self.controller.record_lineage(spec)
         self.controller.record_task_event(spec.task_id, spec.name, "PENDING")
         self.cluster.submit(spec)
         return spec.return_ids
@@ -735,7 +1094,14 @@ class Runtime(_context.BaseContext):
 def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
          resources: Optional[dict] = None, max_workers: Optional[int] = None,
          namespace: str = "default",
-         ignore_reinit_error: bool = False) -> Runtime:
+         ignore_reinit_error: bool = False,
+         bind_host: Optional[str] = None,
+         port: Optional[int] = None) -> Runtime:
+    """Start the head runtime. With bind_host="0.0.0.0" (or env
+    RAY_TPU_BIND_HOST) the listener accepts remote node agents:
+    `python -m ray_tpu._private.node_agent --head <host>:<port>` joins
+    this cluster over TCP; rt.address carries the (host, port) to hand
+    to agents."""
     existing = _context.maybe_ctx()
     if existing is not None:
         if ignore_reinit_error:
@@ -745,7 +1111,8 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
                                "ignore_reinit_error=True to allow this.")
         return existing  # inside a worker: init is a no-op, like ray.init
     rt = Runtime(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
-                 max_workers=max_workers, namespace=namespace)
+                 max_workers=max_workers, namespace=namespace,
+                 bind_host=bind_host, port=port)
     _context.set_ctx(rt)
     return rt
 

@@ -76,19 +76,23 @@ class WaiterRegistry:
                       deadline=(None if timeout is None
                                 else time.monotonic() + timeout),
                       on_done=on_done, seq=next(self._seq))
+        fire = None        # resolved immediately: reply OUTSIDE the lock
         with self._cv:
             if not self._running:
-                return self._finish(w, lambda: reply(w, True))
-            # register-then-check closes the probe/seal race: a seal
-            # between our presence check and registration would be lost
-            # the other way around.
-            self._by_oid.setdefault(oid, set()).add(w)
-            if self._present(oid):
-                self._unlink_locked(w)
-                return self._finish(w, lambda: reply(w, False))
-            if w.deadline is not None:
-                heapq.heappush(self._heap, (w.deadline, w.seq, w))
-                self._cv.notify()
+                fire = lambda: reply(w, True)  # noqa: E731
+            else:
+                # register-then-check closes the probe/seal race: a seal
+                # between our presence check and registration would be
+                # lost the other way around.
+                self._by_oid.setdefault(oid, set()).add(w)
+                if self._present(oid):
+                    self._unlink_locked(w)
+                    fire = lambda: reply(w, False)  # noqa: E731
+                elif w.deadline is not None:
+                    heapq.heappush(self._heap, (w.deadline, w.seq, w))
+                    self._cv.notify()
+        if fire is not None:
+            self._finish(w, fire)
 
     def add_wait(self, ids: list[str], num_returns: int, reply,
                  timeout: Optional[float], on_done=None) -> None:
@@ -96,18 +100,22 @@ class WaiterRegistry:
                        deadline=(None if timeout is None
                                  else time.monotonic() + timeout),
                        on_done=on_done, seq=next(self._seq))
+        fire = None
         with self._cv:
             if not self._running:
-                return self._finish(w, lambda: reply(w, []))
-            for oid in w.ids:
-                self._by_oid.setdefault(oid, set()).add(w)
-            ready = [o for o in w.ids if self._present(o)]
-            if len(ready) >= num_returns or num_returns <= 0:
-                self._unlink_locked(w)
-                return self._finish(w, lambda: reply(w, ready))
-            if w.deadline is not None:
-                heapq.heappush(self._heap, (w.deadline, w.seq, w))
-                self._cv.notify()
+                fire = lambda: reply(w, [])  # noqa: E731
+            else:
+                for oid in w.ids:
+                    self._by_oid.setdefault(oid, set()).add(w)
+                ready = [o for o in w.ids if self._present(o)]
+                if len(ready) >= num_returns or num_returns <= 0:
+                    self._unlink_locked(w)
+                    fire = lambda: reply(w, ready)  # noqa: E731
+                elif w.deadline is not None:
+                    heapq.heappush(self._heap, (w.deadline, w.seq, w))
+                    self._cv.notify()
+        if fire is not None:
+            self._finish(w, fire)
 
     # --------------------------------------------------------- notify
     def notify(self, oid: str) -> None:

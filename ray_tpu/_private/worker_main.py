@@ -315,7 +315,15 @@ class WorkerExecutor:
         else:
             values = list(result)
         for oid, value in zip(return_ids, values):
-            stored = serialize(value, object_id=oid)
+            try:
+                stored = serialize(value, object_id=oid)
+            except BaseException as e:  # noqa: BLE001
+                # Unserializable result (or shm failure): the task must
+                # still complete with an error, never vanish silently
+                # with its resources held.
+                error = True
+                stored = serialize(
+                    TaskError(e, format_exception(e)), object_id=oid)
             stored.is_error = error
             stored_list.append(stored)
         self.ctx.conn.send({"type": protocol.TASK_DONE,

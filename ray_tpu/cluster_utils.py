@@ -67,3 +67,61 @@ class Cluster:
                 return True
             time.sleep(0.05)
         return False
+
+
+class NodeAgentProcess:
+    """A REAL node-agent subprocess joined to the active head over TCP —
+    the honest multi-host topology (vs Cluster's in-process nodes).
+    Reference analogue: `ray start --address=<head>` spawning a raylet
+    that registers with the remote GCS (gcs_node_manager.h:62)."""
+
+    def __init__(self, head_address: Optional[tuple] = None,
+                 num_cpus: float = 2.0, num_tpus: float = 0.0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 max_workers: Optional[int] = None):
+        import json
+        import os
+        import subprocess
+        import sys
+        import uuid
+        if head_address is None:
+            head_address = _context.get_ctx().address
+        self.node_id = "node_" + uuid.uuid4().hex[:8]
+        args = [sys.executable, "-m", "ray_tpu._private.node_agent",
+                "--head", f"{head_address[0]}:{head_address[1]}",
+                "--num-cpus", str(num_cpus), "--num-tpus", str(num_tpus),
+                "--bind", "127.0.0.1", "--advertise", "127.0.0.1",
+                "--node-id", self.node_id]
+        if resources:
+            args += ["--resources", json.dumps(resources)]
+        if labels:
+            args += ["--labels", json.dumps(labels)]
+        if max_workers is not None:
+            args += ["--max-workers", str(max_workers)]
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        self.proc = subprocess.Popen(args, env=env)
+
+    def kill(self) -> None:
+        """Abrupt agent death (SIGKILL): the head's failure detection
+        must notice via connection loss / heartbeat staleness."""
+        try:
+            self.proc.kill()
+        except Exception:
+            pass
+
+    def terminate(self) -> None:
+        try:
+            self.proc.terminate()
+        except Exception:
+            pass
+
+    def wait(self, timeout: Optional[float] = 10.0) -> None:
+        try:
+            self.proc.wait(timeout=timeout)
+        except Exception:
+            self.kill()

@@ -117,6 +117,8 @@ def test_parked_gets_add_no_driver_threads(rt):
 @pytest.fixture
 def rt():
     import ray_tpu
+    if ray_tpu.is_initialized():       # one runtime per process
+        ray_tpu.shutdown()
     ray_tpu.init(num_cpus=4)
     yield
     ray_tpu.shutdown()

@@ -46,6 +46,10 @@ from ray_tpu._private.object_transfer import (PullServer, materialize,
 from ray_tpu._private.scheduler import Scheduler
 from ray_tpu._private.specs import ActorSpec
 
+import logging
+
+log = logging.getLogger(__name__)
+
 HEARTBEAT_PERIOD_S = 0.5
 
 
@@ -158,15 +162,15 @@ class NodeAgent:
                 self.head.send({
                     "type": protocol.NODE_HEARTBEAT,
                     "node_id": self.node_id,
-                    "avail": self.scheduler.avail,
-                    "total": self.scheduler.total,
-                    "pending_demand": dict(
-                        self.scheduler._pending_demand),
-                    "pending_shapes": self.scheduler.pending_shapes(),
-                    "is_idle": self.scheduler.is_idle(),
+                    **self.scheduler.heartbeat_snapshot(),
                 })
             except protocol.ConnectionClosed:
                 return
+            except Exception:
+                # never let a transient snapshot/serialize error kill the
+                # heartbeat thread — a silent exit here reads as node
+                # death at the head
+                log.exception("heartbeat send failed; retrying")
             self._stop.wait(HEARTBEAT_PERIOD_S)
 
     def send_event(self, kind: str, **fields) -> None:
@@ -438,6 +442,15 @@ class NodeAgent:
         except OSError:
             return None
         with self._peer_lock:
+            # two fetch threads may have dialed concurrently: keep the
+            # winner already in the cache, close the loser
+            existing = self._peers.get(tuple(addr))
+            if existing is not None and not existing.closed:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                return existing
             self._peers[tuple(addr)] = conn
         return conn
 

@@ -75,17 +75,23 @@ class PullServer:
         self._slock = threading.Lock()
 
     def handle_pull(self, conn: protocol.Connection, msg: dict) -> None:
+        """Runs on the connection reader thread: answer only the cheap
+        not-found case inline; ALL serving (the _encode of a possibly
+        multi-GB object, and any spill restore) goes to the executor so
+        the reader thread never stalls heartbeats/control traffic."""
         oid = msg["object_id"]
         stored = self._store.get_stored(oid, timeout=0, restore=False)
-        if stored is None:
-            if self._store.contains(oid) and self._executor is not None:
-                self._executor.submit(self._pull_slow, conn, msg, oid)
-                return
+        if stored is None and not self._store.contains(oid):
             stored = self._store.get_stored(oid, timeout=0)
             if stored is None:
                 conn.reply(msg, found=False)
                 return
-        self._serve(conn, msg, stored)
+        if self._executor is not None:
+            self._executor.submit(self._pull_slow, conn, msg, oid)
+        elif stored is not None:
+            self._serve(conn, msg, stored)
+        else:
+            self._pull_slow(conn, msg, oid)
 
     def _pull_slow(self, conn: protocol.Connection, msg: dict,
                    oid: str) -> None:

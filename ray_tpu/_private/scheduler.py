@@ -248,6 +248,19 @@ class Scheduler:
             self._cv.notify_all()
             return task, actor_id
 
+    def heartbeat_snapshot(self) -> dict:
+        """Consistent copies of the ledgers a node heartbeat reports —
+        taken under the scheduler lock so a concurrent dispatch can't
+        mutate the dicts mid-serialization."""
+        with self._lock:
+            return {
+                "avail": dict(self.avail),
+                "total": dict(self.total),
+                "pending_demand": dict(self._pending_demand),
+                "pending_shapes": self.pending_shapes(),
+                "is_idle": self.is_idle(),
+            }
+
     def worker_running_task(self, task_id: str):
         """(worker_id, spec) currently executing task_id, or None."""
         with self._lock:

@@ -28,6 +28,19 @@ def _irange(n: int):
     return builtins.range(n)
 
 
+class _FusedTask:
+    """Picklable read-task body with an op chain baked in (union/zip
+    pipeline breakers)."""
+
+    def __init__(self, task: ds.ReadTask, ops: List[Op]):
+        self._task = task
+        self._ops = ops
+
+    def __call__(self):
+        from ray_tpu.data.executor import apply_ops
+        return apply_ops(self._task(), self._ops)
+
+
 class DataIterator:
     """One epoch-iterable view of a Dataset (reference
     data/iterator.py DataIterator). Created by `Dataset.iterator()` or
@@ -48,20 +61,57 @@ class DataIterator:
         return self._ds.materialize()
 
 
+class ActorPoolStrategy:
+    """compute= strategy for map_batches: run the partition pipeline on a
+    pool of long-lived actors so callable-class transforms keep state
+    (model weights, tokenizers) across partitions. Reference
+    data/_internal/compute.py ActorPoolStrategy /
+    actor_pool_map_operator.py."""
+
+    def __init__(self, size: Optional[int] = None, *,
+                 min_size: Optional[int] = None,
+                 max_size: Optional[int] = None):
+        if size is None:
+            size = max_size if max_size is not None else (
+                min_size if min_size is not None else 2)
+        if size < 1:
+            raise ValueError("pool size must be >= 1")
+        self.size = int(size)
+
+
 class Dataset:
     """Lazy pipeline: read tasks + op chain, executed streaming."""
 
     def __init__(self, read_tasks: List[ds.ReadTask],
                  ops: Optional[List[Op]] = None,
-                 max_in_flight: int = 4):
+                 max_in_flight: int = 4,
+                 compute: Optional[ActorPoolStrategy] = None):
         self._tasks = read_tasks
         self._ops: List[Op] = list(ops or [])
         self._max_in_flight = max_in_flight
+        self._compute = compute
 
     # ------------------------------------------------------ transforms
-    def map_batches(self, fn: Callable[[Block], Dict[str, Any]],
-                    *, batch_size: Optional[int] = None) -> "Dataset":
-        return self._with_op(("map_batches", fn, batch_size))
+    def map_batches(self, fn: Union[Callable[[Block], Dict[str, Any]], type],
+                    *, batch_size: Optional[int] = None,
+                    compute: Optional[ActorPoolStrategy] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None,
+                    ) -> "Dataset":
+        """Transform batches. `fn` may be a callable class (stateful —
+        constructed once per worker); pass compute=ActorPoolStrategy(n)
+        to run the pipeline on a pool of n long-lived actors."""
+        if isinstance(fn, type):
+            from ray_tpu.data.executor import ClassSpec
+            if compute is None:
+                compute = ActorPoolStrategy(2)
+            fn = ClassSpec(fn)
+        out = self._with_op(("map_batches", fn, batch_size,
+                             fn_constructor_args,
+                             fn_constructor_kwargs or {}))
+        if compute is not None:
+            out._compute = compute
+        return out
 
     def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
         return self._with_op(("map", fn))
@@ -73,7 +123,127 @@ class Dataset:
         return self._with_op(("flat_map", fn))
 
     def _with_op(self, op: Op) -> "Dataset":
-        return Dataset(self._tasks, self._ops + [op], self._max_in_flight)
+        return Dataset(self._tasks, self._ops + [op], self._max_in_flight,
+                       self._compute)
+
+    # ------------------------------------------- shuffle-backed relations
+    def groupby(self, key: Union[str, List[str]],
+                *, num_partitions: Optional[int] = None):
+        """Group rows by key column(s) via a hash exchange; aggregate or
+        map_groups on the result (reference dataset.py groupby)."""
+        from ray_tpu.data.grouped_data import GroupedData
+        return GroupedData(self, key, num_partitions)
+
+    def aggregate(self, *aggs) -> Dict[str, Any]:
+        """Whole-dataset aggregation -> one dict (reference
+        Dataset.aggregate)."""
+        from ray_tpu.data.aggregate import aggregate_global
+        return aggregate_global(self.iter_blocks(), aggs)
+
+    def sum(self, on: str):
+        from ray_tpu.data import aggregate as A
+        return self.aggregate(A.Sum(on))[f"sum({on})"]
+
+    def min(self, on: str):
+        from ray_tpu.data import aggregate as A
+        return self.aggregate(A.Min(on))[f"min({on})"]
+
+    def max(self, on: str):
+        from ray_tpu.data import aggregate as A
+        return self.aggregate(A.Max(on))[f"max({on})"]
+
+    def mean(self, on: str):
+        from ray_tpu.data import aggregate as A
+        return self.aggregate(A.Mean(on))[f"mean({on})"]
+
+    def std(self, on: str, ddof: int = 1):
+        from ray_tpu.data import aggregate as A
+        return self.aggregate(A.Std(on, ddof=ddof))[f"std({on})"]
+
+    def unique(self, on: str) -> List[Any]:
+        """Distinct values of a column (reference Dataset.unique)."""
+        rows = self.groupby(on).count().take_all()
+        return [r[on] for r in rows]
+
+    def sort(self, key: str, *, descending: bool = False,
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Global sort by one column: sample range boundaries, range-
+        exchange, sort each output partition (reference Dataset.sort /
+        _internal/planner/exchange/sort_task_spec.py)."""
+        from ray_tpu.data import shuffle as sh
+        num_out = num_partitions or max(1, min(self.num_partitions(), 8))
+        bounds = sh.sort_boundaries(self._tasks, self._ops, key, num_out)
+        if not len(bounds):
+            num_out = 1
+        tasks = sh.exchange(
+            self._tasks, self._ops,
+            sh._map_range, (key, bounds, descending, num_out),
+            sh.make_reduce_sort(key, descending), num_out)
+        return Dataset(tasks)
+
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_partitions: Optional[int] = None) -> "Dataset":
+        """Global random shuffle: rows are hash-scattered to random
+        partitions, then permuted within each (reference
+        Dataset.random_shuffle)."""
+        from ray_tpu.data import shuffle as sh
+        num_out = num_partitions or max(1, self.num_partitions())
+        tasks = sh.exchange(
+            self._tasks, self._ops,
+            sh._map_random, (seed, num_out),
+            sh.make_reduce_permute(seed), num_out)
+        return Dataset(tasks)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise join of two row-aligned datasets (reference
+        Dataset.zip); duplicate column names from `other` get a _1
+        suffix."""
+        left, right = self, other
+
+        def _zipped():
+            from ray_tpu.data.block import rebatch_blocks
+            CHUNK = 4096
+            lit = rebatch_blocks(left.iter_blocks(), CHUNK)
+            rit = rebatch_blocks(right.iter_blocks(), CHUNK)
+            lbuf: Block = {}
+            rbuf: Block = {}
+            while True:
+                if not block_num_rows(lbuf):
+                    lbuf = next(lit, {})
+                if not block_num_rows(rbuf):
+                    rbuf = next(rit, {})
+                ln, rn = block_num_rows(lbuf), block_num_rows(rbuf)
+                if not ln or not rn:
+                    if ln != rn:
+                        raise ValueError(
+                            "zip(): datasets have different row counts")
+                    return
+                n = min(ln, rn)
+                out = dict(block_slice(lbuf, 0, n))
+                for k, v in block_slice(rbuf, 0, n).items():
+                    out[k if k not in out else f"{k}_1"] = v
+                yield out
+                lbuf = block_slice(lbuf, n, ln)
+                rbuf = block_slice(rbuf, n, rn)
+
+        return Dataset([ds.ReadTask(_zipped, "zip")])
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Row-concatenate datasets (reference Dataset.union). Each
+        input's op chain is fused into its read tasks so the combined
+        dataset has a single empty chain."""
+        tasks: List[ds.ReadTask] = []
+        for d in (self, *others):
+            tasks.extend(d._fused_tasks())
+        return Dataset(tasks)
+
+    def _fused_tasks(self) -> List[ds.ReadTask]:
+        """Read tasks with this dataset's op chain baked in."""
+        if not self._ops:
+            return list(self._tasks)
+        ops = list(self._ops)
+        return [ds.ReadTask(_FusedTask(t, ops), f"fused[{t.name}]")
+                for t in self._tasks]
 
     # --------------------------------------------------------- sharding
     def split(self, n: int, *, locality_hints=None) -> List["Dataset"]:
@@ -88,7 +258,8 @@ class Dataset:
                 f"cannot split {len(self._tasks)} partitions into {n} "
                 f"shards; re-read with override_num_blocks>={n}")
         return [Dataset(self._tasks[i::n], list(self._ops),
-                        self._max_in_flight) for i in _irange(n)]
+                        self._max_in_flight, self._compute)
+                for i in _irange(n)]
 
     def repartition(self, n: int) -> "Dataset":
         """Materialize and re-block into exactly n row-range partitions
@@ -112,6 +283,12 @@ class Dataset:
 
     # ------------------------------------------------------ consumption
     def iter_blocks(self) -> Iterator[Block]:
+        if self._compute is not None:
+            from ray_tpu.data.executor import stream_blocks_actor_pool
+            return stream_blocks_actor_pool(
+                self._tasks, self._ops, pool_size=self._compute.size,
+                max_in_flight=max(self._max_in_flight,
+                                  self._compute.size))
         return stream_blocks(self._tasks, self._ops,
                              max_in_flight=self._max_in_flight)
 

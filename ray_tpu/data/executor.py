@@ -25,11 +25,16 @@ from ray_tpu.data.datasource import ReadTask
 Op = Tuple[Any, ...]
 
 
-def apply_ops(blocks: Iterator[Block], ops: List[Op]) -> Iterator[Block]:
-    for op in ops:
+def apply_ops(blocks: Iterator[Block], ops: List[Op],
+              instances: Optional[dict] = None) -> Iterator[Block]:
+    """`instances` caches constructed callable-class transforms keyed by
+    op position — pass a persistent dict (actor-pool workers do) so
+    stateful transforms survive across partitions."""
+    for i, op in enumerate(ops):
         kind = op[0]
         if kind == "map_batches":
-            blocks = _apply_map_batches(blocks, op[1], op[2])
+            fn = _resolve_fn(op, i, instances)
+            blocks = _apply_map_batches(blocks, fn, op[2])
         elif kind == "map":
             blocks = _apply_map(blocks, op[1])
         elif kind == "filter":
@@ -39,6 +44,59 @@ def apply_ops(blocks: Iterator[Block], ops: List[Op]) -> Iterator[Block]:
         else:  # pragma: no cover - guarded at Dataset level
             raise ValueError(f"unknown op {kind}")
     return blocks
+
+
+class ClassSpec:
+    """Callable-class transform captured BY VALUE (cloudpickle) at
+    map_batches() time, so classes defined in driver-only modules (test
+    files, notebooks) construct fine inside workers that cannot import
+    those modules."""
+
+    def __init__(self, cls: type):
+        import os
+        import sys
+
+        import cloudpickle
+        mod = sys.modules.get(cls.__module__)
+        f = getattr(mod, "__file__", None) if mod else None
+        library = f and (f.startswith(sys.prefix)
+                         or "site-packages" in f
+                         or "/ray_tpu/" in f.replace(os.sep, "/"))
+        if mod is None or cls.__module__ == "__main__" or library:
+            self.data = cloudpickle.dumps(cls)
+        else:
+            # driver-local module (script/test file): capture by value so
+            # workers need not import it
+            cloudpickle.register_pickle_by_value(mod)
+            try:
+                self.data = cloudpickle.dumps(cls)
+            finally:
+                cloudpickle.unregister_pickle_by_value(mod)
+        self.qualname = cls.__qualname__
+
+    def load(self) -> type:
+        import cloudpickle
+        return cloudpickle.loads(self.data)
+
+
+def _resolve_fn(op: Op, idx: int, instances: Optional[dict]):
+    """map_batches fn may be a (by-value captured) callable class:
+    construct once per worker when an instance cache is provided."""
+    fn = op[1]
+    if not isinstance(fn, ClassSpec):
+        return fn
+    ctor_args = op[3] if len(op) > 3 else ()
+    ctor_kwargs = op[4] if len(op) > 4 else {}
+
+    def construct():
+        return fn.load()(*ctor_args, **ctor_kwargs)
+
+    if instances is None:
+        return construct()
+    key = (idx, fn.qualname)
+    if key not in instances:
+        instances[key] = construct()
+    return instances[key]
 
 
 def _apply_map_batches(blocks, fn, batch_size) -> Iterator[Block]:
@@ -116,6 +174,63 @@ def stream_blocks(tasks: List[ReadTask], ops: List[Op],
             yield b
 
 
+class _PoolWorker:
+    """Long-lived actor that runs partition pipelines, keeping callable-
+    class transform instances alive across partitions (reference
+    data/_internal/execution/operators/actor_pool_map_operator.py)."""
+
+    def __init__(self):
+        self._instances: dict = {}
+
+    def run_partition(self, task: ReadTask, ops: List[Op]) -> List[Block]:
+        return [b for b in apply_ops(task(), ops, self._instances)
+                if block_num_rows(b)]
+
+
+def stream_blocks_actor_pool(tasks: List[ReadTask], ops: List[Op],
+                             pool_size: int,
+                             max_in_flight: int = 4) -> Iterator[Block]:
+    """Yield blocks in partition order, dispatching partitions to a pool
+    of stateful actors (least-loaded first). Falls back to one local
+    instance cache when the runtime is not initialized."""
+    if not tasks:
+        return
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        instances: dict = {}
+        for t in tasks:
+            for b in apply_ops(t(), ops, instances):
+                if block_num_rows(b):
+                    yield b
+        return
+
+    Actor = ray_tpu.remote(num_cpus=1)(_PoolWorker)
+    actors = [Actor.remote() for _ in range(pool_size)]
+    load = [0] * pool_size
+    try:
+        window: List[Any] = []       # (ref, actor_idx) in partition order
+        next_submit = 0
+        while next_submit < len(tasks) or window:
+            while next_submit < len(tasks) and len(window) < max_in_flight:
+                idx = min(range(pool_size), key=load.__getitem__)
+                ref = actors[idx].run_partition.remote(
+                    tasks[next_submit], ops)
+                window.append((ref, idx))
+                load[idx] += 1
+                next_submit += 1
+            ref, idx = window.pop(0)
+            blocks = ray_tpu.get(ref)
+            load[idx] -= 1
+            for b in blocks:
+                yield b
+    finally:
+        for a in actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
 def _stream_local(tasks: List[ReadTask], ops: List[Op]) -> Iterator[Block]:
     """Single background thread reads ahead one partition. The producer
     polls a closed flag on every put so an abandoned consumer (generator
@@ -140,7 +255,8 @@ def _stream_local(tasks: List[ReadTask], ops: List[Op]) -> Iterator[Block]:
         except BaseException as e:  # surface in consumer
             _put(e)
 
-    th = threading.Thread(target=producer, daemon=True)
+    th = threading.Thread(target=producer, daemon=True,
+                          name="data-producer")
     th.start()
     try:
         while True:

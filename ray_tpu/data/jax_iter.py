@@ -38,7 +38,8 @@ class _Prefetcher:
             except BaseException as e:
                 self._put(e)
 
-        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="data-prefetch")
         self._thread.start()
 
     def _put(self, item) -> bool:

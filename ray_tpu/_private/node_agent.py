@@ -234,6 +234,13 @@ class NodeAgent:
         if wid is None or self._stop.is_set():
             return
         task, actor_id = self.scheduler.on_worker_lost(wid)
+        if task is not None:
+            # the dead worker may have sealed result shm on THIS host
+            # without delivering TASK_DONE — reap locally (the head's
+            # reap only covers its own /dev/shm)
+            from ray_tpu._private.object_store import reap_object_segments
+            for oid in task.return_ids:
+                reap_object_segments(oid)
         self.send_event("worker_lost", worker_id=wid, task=task,
                         actor_id=actor_id)
 

@@ -99,6 +99,23 @@ def _map_segment(name: str, size: int) -> memoryview:
     return memoryview(mm)[:size]
 
 
+def reap_object_segments(object_id: str, max_buffers: int = 64) -> int:
+    """Unlink shm segments a dead producer may have created for
+    `object_id` before its TASK_DONE reached us (worker killed between
+    serialize and send). Buffer names are sequential; stop at the first
+    gap. Returns the number reaped."""
+    reaped = 0
+    for i in range(max_buffers):
+        try:
+            _posixshmem.shm_unlink(f"/rtpu_{object_id}_{i}")
+            reaped += 1
+        except FileNotFoundError:
+            break
+        except OSError:
+            break
+    return reaped
+
+
 def unlink_segment(name: str) -> None:
     try:
         _posixshmem.shm_unlink("/" + name)

@@ -229,7 +229,12 @@ class Runtime(_context.BaseContext):
                     task_name=t.name))
 
     def _store_error(self, return_ids: list[str], err: BaseException) -> None:
+        from ray_tpu._private.object_store import reap_object_segments
         for oid in return_ids:
+            # a killed worker may have sealed result buffers for these
+            # ids without delivering TASK_DONE; reap them or they leak
+            # until host reboot (shm persists past process death)
+            reap_object_segments(oid)
             self.store.put(err, object_id=oid)
 
     def on_unplaceable(self, spec, reason: str) -> None:

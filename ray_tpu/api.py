@@ -101,6 +101,13 @@ class RemoteFunction:
     def __init__(self, fn, options: Optional[dict] = None):
         if not callable(fn):
             raise TypeError("@remote must wrap a callable")
+        # update_wrapper FIRST: it copies fn.__dict__ into self, and a
+        # callable-instance target would otherwise clobber our _fn/_opts
+        # with its own same-named attributes
+        try:
+            functools.update_wrapper(self, fn, updated=())
+        except AttributeError:
+            pass
         self._fn = fn
         self._opts = dict(options or {})
         bad = set(self._opts) - _VALID_TASK_OPTIONS
@@ -110,7 +117,6 @@ class RemoteFunction:
         self._pickled: Optional[bytes] = None
         self._func_id: Optional[str] = None
         self._registered_in: set[int] = set()
-        functools.update_wrapper(self, fn)
 
     def _ensure_pickled(self):
         if self._pickled is None:

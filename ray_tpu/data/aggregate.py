@@ -141,27 +141,36 @@ class Std(AggregateFn):
         self.ddof = ddof
 
     def reduce_segments(self, block, starts):
+        # shifted two-pass: subtract each segment's mean before squaring
+        # (naive sum-of-squares loses all precision when |mean| >> std)
         vals = self._col(block)
         ends = np.append(starts[1:], len(vals))
         n = (ends - starts).astype(np.float64)
-        s = np.add.reduceat(vals, starts)
-        sq = np.add.reduceat(vals * vals, starts)
-        var = (sq - s * s / n) / np.maximum(n - self.ddof, 1e-12)
+        mean = np.add.reduceat(vals, starts) / n
+        dev = vals - np.repeat(mean, (ends - starts))
+        m2 = np.add.reduceat(dev * dev, starts)
+        var = m2 / np.maximum(n - self.ddof, 1e-12)
         var = np.where(n > self.ddof, np.maximum(var, 0.0), np.nan)
         return np.sqrt(var)
 
     def partial(self, block):
         v = self._col(block)
-        return (float(v.sum()), float((v * v).sum()), len(v))
+        m = float(v.mean())
+        return (len(v), m, float(((v - m) ** 2).sum()))
 
     def merge(self, a, b):
-        return (a[0] + b[0], a[1] + b[1], a[2] + b[2])
+        # Chan et al. parallel variance merge of (n, mean, M2) partials
+        na, ma, m2a = a
+        nb, mb, m2b = b
+        n = na + nb
+        d = mb - ma
+        return (n, ma + d * nb / n, m2a + m2b + d * d * na * nb / n)
 
     def finalize(self, acc):
-        s, sq, n = acc
+        n, _, m2 = acc
         if n <= self.ddof:
             return float("nan")
-        return float(np.sqrt(max((sq - s * s / n) / (n - self.ddof), 0.0)))
+        return float(np.sqrt(max(m2 / (n - self.ddof), 0.0)))
 
 
 class AbsMax(AggregateFn):

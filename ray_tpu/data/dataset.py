@@ -286,9 +286,7 @@ class Dataset:
         if self._compute is not None:
             from ray_tpu.data.executor import stream_blocks_actor_pool
             return stream_blocks_actor_pool(
-                self._tasks, self._ops, pool_size=self._compute.size,
-                max_in_flight=max(self._max_in_flight,
-                                  self._compute.size))
+                self._tasks, self._ops, pool_size=self._compute.size)
         return stream_blocks(self._tasks, self._ops,
                              max_in_flight=self._max_in_flight)
 

@@ -188,11 +188,11 @@ class _PoolWorker:
 
 
 def stream_blocks_actor_pool(tasks: List[ReadTask], ops: List[Op],
-                             pool_size: int,
-                             max_in_flight: int = 4) -> Iterator[Block]:
+                             pool_size: int) -> Iterator[Block]:
     """Yield blocks in partition order, dispatching partitions to a pool
-    of stateful actors (least-loaded first). Falls back to one local
-    instance cache when the runtime is not initialized."""
+    of stateful actors (util.actor_pool handles ordered results +
+    pool-width parallelism). Falls back to one local instance cache when
+    the runtime is not initialized."""
     if not tasks:
         return
     import ray_tpu
@@ -204,23 +204,13 @@ def stream_blocks_actor_pool(tasks: List[ReadTask], ops: List[Op],
                     yield b
         return
 
+    from ray_tpu.util.actor_pool import ActorPool
     Actor = ray_tpu.remote(num_cpus=1)(_PoolWorker)
     actors = [Actor.remote() for _ in range(pool_size)]
-    load = [0] * pool_size
     try:
-        window: List[Any] = []       # (ref, actor_idx) in partition order
-        next_submit = 0
-        while next_submit < len(tasks) or window:
-            while next_submit < len(tasks) and len(window) < max_in_flight:
-                idx = min(range(pool_size), key=load.__getitem__)
-                ref = actors[idx].run_partition.remote(
-                    tasks[next_submit], ops)
-                window.append((ref, idx))
-                load[idx] += 1
-                next_submit += 1
-            ref, idx = window.pop(0)
-            blocks = ray_tpu.get(ref)
-            load[idx] -= 1
+        pool = ActorPool(actors)
+        for blocks in pool.map(
+                lambda a, t: a.run_partition.remote(t, ops), tasks):
             for b in blocks:
                 yield b
     finally:

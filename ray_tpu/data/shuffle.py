@@ -35,7 +35,8 @@ def _hash_column(arr: np.ndarray, num_out: int) -> np.ndarray:
         v ^= v >> np.uint64(29)
         return (v % np.uint64(num_out)).astype(np.int64)
     if arr.dtype.kind == "f":
-        v = arr.astype(np.float64, copy=False).view(np.uint64)
+        # +0.0 normalizes -0.0 so the equal keys share a bit pattern
+        v = (arr.astype(np.float64) + 0.0).view(np.uint64)
         v = (v * np.uint64(0x9E3779B97F4A7C15))
         v ^= v >> np.uint64(29)
         return (v % np.uint64(num_out)).astype(np.int64)
@@ -65,8 +66,8 @@ def split_by_bucket(blocks: List[Block], bucket_of: np.ndarray,
 
 
 # ------------------------------------------------------------- exchange
-def _map_hash(task: ReadTask, ops: list, keys: Sequence[str],
-              num_out: int) -> List[Block]:
+def _map_hash(task: ReadTask, ops: list, idx: int,
+              keys: Sequence[str], num_out: int) -> List[Block]:
     from ray_tpu.data.executor import apply_ops
     blocks = [b for b in apply_ops(task(), ops) if block_num_rows(b)]
     if not blocks:
@@ -76,7 +77,7 @@ def _map_hash(task: ReadTask, ops: list, keys: Sequence[str],
                            num_out)
 
 
-def _map_range(task: ReadTask, ops: list, key: str,
+def _map_range(task: ReadTask, ops: list, idx: int, key: str,
                boundaries: np.ndarray, descending: bool,
                num_out: int) -> List[Block]:
     from ray_tpu.data.executor import apply_ops
@@ -91,28 +92,28 @@ def _map_range(task: ReadTask, ops: list, key: str,
     return split_by_bucket([merged], idx.astype(np.int64), num_out)
 
 
-def _map_random(task: ReadTask, ops: list, seed: Optional[int],
-                num_out: int) -> List[Block]:
+def _map_random(task: ReadTask, ops: list, idx: int,
+                seed: Optional[int], num_out: int) -> List[Block]:
     from ray_tpu.data.executor import apply_ops
     blocks = [b for b in apply_ops(task(), ops) if block_num_rows(b)]
     if not blocks:
         return [{} for _ in range(num_out)]
     merged = block_concat(blocks)
     n = block_num_rows(merged)
-    # deterministic per-partition stream when seeded: mix in task name
-    s = None if seed is None else (seed ^ zlib.crc32(task.name.encode()))
-    rng = np.random.default_rng(s)
+    # decorrelate partitions by INDEX (task names are not unique);
+    # seeded runs stay deterministic
+    rng = np.random.default_rng(None if seed is None else [seed, idx])
     return split_by_bucket([merged], rng.integers(0, num_out, size=n),
                            num_out)
 
 
 def make_reduce_permute(seed: Optional[int]):
-    def _reduce(*shards: Block) -> List[Block]:
+    def _reduce(j: int, *shards: Block) -> List[Block]:
         merged = block_concat([s for s in shards if block_num_rows(s)])
         n = block_num_rows(merged)
         if not n:
             return []
-        rng = np.random.default_rng(seed)
+        rng = np.random.default_rng(None if seed is None else [seed, j])
         return [block_take(merged, rng.permutation(n))]
     return _reduce
 
@@ -139,25 +140,42 @@ def exchange(tasks: List[ReadTask], ops: list,
     demand)."""
     import ray_tpu
     if not ray_tpu.is_initialized():
-        shard_lists = [map_fn(t, ops, *map_args) for t in tasks]
+        shard_lists = [map_fn(t, ops, i, *map_args)
+                       for i, t in enumerate(tasks)]
         out = []
         for j in range(num_out):
             shards = [s[j] for s in shard_lists]
-            blocks = reduce_fn(*shards)
+            blocks = reduce_fn(j, *shards)
             out.append(ReadTask(lambda bs=blocks: iter(bs),
                                 f"exchange[{j}]"))
         return out
 
-    rmap = ray_tpu.remote(num_cpus=1, num_returns=num_out)(map_fn)
+    if num_out == 1:
+        # num_returns=1 would store the whole List[Block] as the one
+        # return object; unwrap to the single shard instead
+        rmap = ray_tpu.remote(num_cpus=1)(_MapSingle(map_fn))
+    else:
+        rmap = ray_tpu.remote(num_cpus=1, num_returns=num_out)(map_fn)
     rreduce = ray_tpu.remote(num_cpus=1)(reduce_fn)
-    shard_refs = [rmap.remote(t, ops, *map_args) for t in tasks]
+    shard_refs = [rmap.remote(t, ops, i, *map_args)
+                  for i, t in enumerate(tasks)]
     if num_out == 1:
         shard_refs = [[r] for r in shard_refs]
     out = []
     for j in range(num_out):
-        ref = rreduce.remote(*[s[j] for s in shard_refs])
+        ref = rreduce.remote(j, *[s[j] for s in shard_refs])
         out.append(ReadTask(_RefRead(ref), f"exchange[{j}]"))
     return out
+
+
+class _MapSingle:
+    """Unwraps a map_fn's 1-element shard list for num_out == 1."""
+
+    def __init__(self, map_fn):
+        self._fn = map_fn
+
+    def __call__(self, task, ops, idx, *args):
+        return self._fn(task, ops, idx, *args)[0]
 
 
 class _RefRead:
@@ -174,7 +192,7 @@ class _RefRead:
 
 
 # ------------------------------------------------------------- reducers
-def reduce_concat(*shards: Block) -> List[Block]:
+def reduce_concat(j: int, *shards: Block) -> List[Block]:
     merged = block_concat([s for s in shards if block_num_rows(s)])
     return [merged] if block_num_rows(merged) else []
 
@@ -182,7 +200,7 @@ def reduce_concat(*shards: Block) -> List[Block]:
 def make_reduce_aggregate(keys, aggs):
     from ray_tpu.data.aggregate import aggregate_partition
 
-    def _reduce(*shards: Block) -> List[Block]:
+    def _reduce(j: int, *shards: Block) -> List[Block]:
         merged = block_concat([s for s in shards if block_num_rows(s)])
         out = aggregate_partition(merged, keys, aggs)
         return [out] if block_num_rows(out) else []
@@ -192,14 +210,14 @@ def make_reduce_aggregate(keys, aggs):
 def make_reduce_map_groups(keys, fn):
     from ray_tpu.data.aggregate import map_groups_partition
 
-    def _reduce(*shards: Block) -> List[Block]:
+    def _reduce(j: int, *shards: Block) -> List[Block]:
         merged = block_concat([s for s in shards if block_num_rows(s)])
         return map_groups_partition(merged, keys, fn)
     return _reduce
 
 
 def make_reduce_sort(key: str, descending: bool):
-    def _reduce(*shards: Block) -> List[Block]:
+    def _reduce(j: int, *shards: Block) -> List[Block]:
         merged = block_concat([s for s in shards if block_num_rows(s)])
         if not block_num_rows(merged):
             return []

@@ -199,3 +199,64 @@ def test_map_batches_actor_pool_remote(ray_cluster):
     # statefulness: some actor processed >1 partition with the SAME
     # instance (calls > 1 observed)
     assert max(int(r["call"]) for r in rows) > 1
+
+
+# --------------------------------------------- review-finding regressions
+def test_single_partition_shuffle_remote(ray_cluster):
+    """num_out == 1 exchange: sort/groupby on a 1-partition dataset must
+    not crash (num_returns=1 stores the whole list as one object)."""
+    ds = rd.from_numpy({"k": np.array([2, 1, 2]),
+                        "v": np.array([1., 2., 3.])},
+                       override_num_blocks=1)
+    got = [r["k"] for r in ds.sort("k").take_all()]
+    assert got == [1, 2, 2]
+    rows = ds.groupby("k", num_partitions=1).sum("v").take_all()
+    assert {int(r["k"]): r["sum(v)"] for r in rows} == {1: 2.0, 2: 4.0}
+
+
+def test_groupby_negative_zero_key():
+    """-0.0 and 0.0 are equal keys and must land in ONE group even when
+    scattered across partitions."""
+    ds = rd.from_numpy({"k": np.array([0.0, -0.0, 1.0, -0.0]),
+                        "v": np.array([1., 2., 3., 4.])},
+                       override_num_blocks=4)
+    rows = ds.groupby("k").sum("v").take_all()
+    got = {float(r["k"]): r["sum(v)"] for r in rows}
+    assert got == {0.0: 7.0, 1.0: 3.0}
+
+
+def test_std_large_mean_stability():
+    """Catastrophic cancellation guard: values ~1e8 with std ~1."""
+    rng = np.random.default_rng(0)
+    vals = 1e8 + rng.normal(size=400)
+    keys = np.repeat([0, 1], 200)
+    ds = rd.from_numpy({"k": keys, "v": vals}, override_num_blocks=4)
+    rows = ds.groupby("k").std("v").take_all()
+    for r in rows:
+        want = np.std(vals[keys == int(r["k"])], ddof=1)
+        np.testing.assert_allclose(r["std(v)"], want, rtol=1e-6)
+    np.testing.assert_allclose(ds.std("v"), np.std(vals, ddof=1),
+                               rtol=1e-6)
+
+
+def test_seeded_shuffle_decorrelates_equal_named_partitions():
+    """from_items names every task identically; seeded shuffles must
+    still draw DIFFERENT bucket streams per partition (review
+    regression: name-derived seeds co-located row i of every
+    partition)."""
+    ds = rd.from_items(list(range(100)), override_num_blocks=5)
+    out = ds.random_shuffle(seed=3)
+    blocks = list(out.iter_blocks())
+    # same-index rows of the 5 input partitions (0,20,40,60,80):
+    # with per-index seeds they almost surely spread across blocks
+    landing = {}
+    for bi, b in enumerate(blocks):
+        for v in b["item"]:
+            landing[int(v)] = bi
+    aligned = {landing[i] for i in (0, 20, 40, 60, 80)}
+    assert len(aligned) > 1, landing
+    # determinism under the same seed
+    again = [int(v) for b in ds.random_shuffle(seed=3).iter_blocks()
+             for v in b["item"]]
+    first = [int(v) for b in blocks for v in b["item"]]
+    assert again == first

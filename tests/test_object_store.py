@@ -7,6 +7,7 @@ LocalStore.
 import os
 
 import numpy as np
+import pytest
 
 from ray_tpu._private.object_store import (LocalStore, deserialize,
                                            serialize)
@@ -92,3 +93,21 @@ def test_unbounded_store_never_spills(tmp_path):
         store.put(_big(i))
     assert store.stats()["num_spilled"] == 0
     store.shutdown()
+
+
+def test_reap_object_segments_cleans_orphans():
+    """A worker killed between sealing result shm and delivering
+    TASK_DONE leaves orphan segments named rtpu_<return_id>_<i>; the
+    driver reaps them when it records the task's failure."""
+    import _posixshmem
+
+    from ray_tpu._private.object_store import (_create_segment,
+                                               reap_object_segments)
+    rid = "deadbeef01r0"
+    for i in range(3):
+        _create_segment(f"rtpu_{rid}_{i}", memoryview(b"x" * 128))
+    assert reap_object_segments(rid) == 3
+    # gone — and reaping again is a no-op
+    assert reap_object_segments(rid) == 0
+    with pytest.raises(FileNotFoundError):
+        _posixshmem.shm_open(f"/rtpu_{rid}_0", 0, mode=0o600)

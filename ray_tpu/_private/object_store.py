@@ -102,17 +102,24 @@ def _map_segment(name: str, size: int) -> memoryview:
 def reap_object_segments(object_id: str, max_buffers: int = 64) -> int:
     """Unlink shm segments a dead producer may have created for
     `object_id` before its TASK_DONE reached us (worker killed between
-    serialize and send). Buffer names are sequential; stop at the first
-    gap. Returns the number reaped."""
+    serialize and send). Buffer indices may have gaps (small buffers
+    store inline), so scan /dev/shm for the prefix rather than probing
+    sequentially. Returns the number reaped."""
     reaped = 0
-    for i in range(max_buffers):
+    prefix = f"rtpu_{object_id}_"
+    try:
+        names = [n for n in os.listdir("/dev/shm")
+                 if n.startswith(prefix)]
+    except OSError:
+        # no listable shm dir (non-Linux): fall back to index probing
+        # over the full range, tolerating gaps
+        names = [f"rtpu_{object_id}_{i}" for i in range(max_buffers)]
+    for name in names:
         try:
-            _posixshmem.shm_unlink(f"/rtpu_{object_id}_{i}")
+            _posixshmem.shm_unlink("/" + name)
             reaped += 1
-        except FileNotFoundError:
-            break
         except OSError:
-            break
+            pass
     return reaped
 
 

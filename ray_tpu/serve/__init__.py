@@ -26,22 +26,111 @@ _CONTROLLER_NAME = "_rtpu_serve_controller"
 
 
 # ------------------------------------------------------------ replica
+_STREAM_IDLE_TTL_S = 300.0
+_STREAM_END = ("__rtpu_stream__", "end")   # out-of-band marker
+
+
 class _Replica:
-    """Actor wrapping one instance of the user's deployment class."""
+    """Actor wrapping one instance of the user's deployment class.
+
+    Tracks its own ongoing-request count (the autoscaling signal the
+    reference's replicas report, _private/replica.py num_ongoing) and
+    holds generator state for streaming responses: a generator result is
+    parked under a stream id and pulled chunk-by-chunk via next_chunk
+    (the reference streams over gRPC/ASGI; here the ordered actor queue
+    is the transport)."""
 
     def __init__(self, cls_or_fn, init_args, init_kwargs):
         if isinstance(cls_or_fn, type):
             self._obj = cls_or_fn(*init_args, **init_kwargs)
         else:
             self._obj = cls_or_fn       # function deployment
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        self._streams: Dict[str, tuple] = {}   # sid -> (gen, last_used)
 
     def ping(self):
         return "pong"
 
+    def stats(self) -> dict:
+        with self._lock:
+            return {"ongoing": self._ongoing + len(self._streams),
+                    "total": self._total}
+
     def handle_request(self, method: str, args, kwargs):
-        if method == "__call__":
-            return self._obj(*args, **kwargs)
-        return getattr(self._obj, method)(*args, **kwargs)
+        import inspect
+        import uuid
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method == "__call__":
+                result = self._obj(*args, **kwargs)
+            else:
+                result = getattr(self._obj, method)(*args, **kwargs)
+            if inspect.isgenerator(result):
+                sid = uuid.uuid4().hex[:12]
+                with self._lock:
+                    self._sweep_streams()
+                    self._streams[sid] = (result, time.monotonic())
+                return ("__stream__", sid)
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def next_chunk(self, sid: str, n: int = 1):
+        """Pull up to n chunks from a parked stream; the sentinel tuple
+        terminates (and retires) it."""
+        with self._lock:
+            entry = self._streams.get(sid)
+        if entry is None:
+            # swept (idle TTL) or never existed: error, never a silent
+            # truncation indistinguishable from completion
+            raise RuntimeError(
+                f"stream {sid!r} expired or unknown on this replica")
+        gen, _ = entry
+        out = []
+        for _i in range(n):
+            try:
+                out.append(next(gen))
+            except StopIteration:
+                out.append(_STREAM_END)
+                with self._lock:
+                    self._streams.pop(sid, None)
+                return out
+            except BaseException:
+                with self._lock:
+                    self._streams.pop(sid, None)
+                raise
+        with self._lock:
+            if sid in self._streams:
+                self._streams[sid] = (gen, time.monotonic())
+        return out
+
+    def _sweep_streams(self) -> None:     # caller holds _lock
+        now = time.monotonic()
+        dead = [s for s, (_, t) in self._streams.items()
+                if now - t > _STREAM_IDLE_TTL_S]
+        for s in dead:
+            self._streams.pop(s, None)
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Reference serve/config.py AutoscalingConfig /
+    _private/autoscaling_state.py: desired = ceil(total_ongoing /
+    target_ongoing_requests), clamped to [min, max]; a scale decision
+    must hold continuously for its delay before it applies."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 10.0
+
+    def clamp(self, n: int) -> int:
+        return max(self.min_replicas, min(self.max_replicas, n))
 
 
 @dataclasses.dataclass
@@ -53,6 +142,7 @@ class _DeploymentInfo:
     num_replicas: int
     max_ongoing_requests: int
     ray_actor_options: dict
+    autoscaling_config: Optional[AutoscalingConfig] = None
 
 
 class ServeController:
@@ -62,6 +152,10 @@ class ServeController:
     def __init__(self):
         self._deployments: Dict[str, _DeploymentInfo] = {}
         self._replicas: Dict[str, List[Any]] = {}
+        self._targets: Dict[str, int] = {}       # autoscaled target
+        # autoscale hysteresis: name -> (direction, desired, since)
+        self._scale_intent: Dict[str, tuple] = {}
+        self._last_ongoing: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._running = True
         self._thread = threading.Thread(target=self._reconcile_loop,
@@ -75,6 +169,10 @@ class ServeController:
     def deploy(self, info: _DeploymentInfo) -> None:
         with self._lock:
             self._deployments[info.name] = info
+            ac = info.autoscaling_config
+            self._targets[info.name] = (
+                ac.clamp(info.num_replicas) if ac else info.num_replicas)
+            self._scale_intent.pop(info.name, None)
         self._reconcile_once()
 
     def delete_deployment(self, name: str) -> None:
@@ -96,7 +194,11 @@ class ServeController:
     def list_deployments(self) -> Dict[str, dict]:
         with self._lock:
             return {n: {"num_replicas": d.num_replicas,
-                        "live_replicas": len(self._replicas.get(n, []))}
+                        "target_replicas": self._targets.get(
+                            n, d.num_replicas),
+                        "live_replicas": len(self._replicas.get(n, [])),
+                        "ongoing_requests": self._last_ongoing.get(n, 0),
+                        "autoscaling": d.autoscaling_config is not None}
                     for n, d in self._deployments.items()}
 
     def shutdown(self) -> None:
@@ -118,28 +220,71 @@ class ServeController:
         with self._lock:
             items = list(self._deployments.items())
         for name, info in items:
-            live = []
+            live, ongoing = [], 0        # live: (replica, its ongoing)
             for r in self._replicas.get(name, []):
                 try:
-                    ray_tpu.get(r.ping.remote(), timeout=5.0)
-                    live.append(r)
+                    st = ray_tpu.get(r.stats.remote(), timeout=5.0)
+                    n_r = int(st.get("ongoing", 0))
+                    ongoing += n_r
+                    live.append((r, n_r))
                 except BaseException:
                     pass                  # dead replica: dropped
-            while len(live) < info.num_replicas:
+            with self._lock:
+                self._last_ongoing[name] = ongoing
+            target = self._autoscale(name, info, len(live), ongoing)
+            while len(live) < target:
                 cls = cloudpickle.loads(info.cls_bytes)
                 opts = dict(info.ray_actor_options)
                 opts["max_concurrency"] = info.max_ongoing_requests
                 actor = ray_tpu.remote(**opts)(_Replica).remote(
                     cls, info.init_args, info.init_kwargs)
-                live.append(actor)
-            while len(live) > info.num_replicas:
-                victim = live.pop()
-                try:
-                    ray_tpu.kill(victim)
-                except BaseException:
-                    pass
+                live.append((actor, 0))
+            if len(live) > target:
+                # evict the idlest replicas first so in-flight requests
+                # and parked streams survive the downscale when any
+                # idle capacity exists
+                live.sort(key=lambda rn: rn[1], reverse=True)
+                while len(live) > target:
+                    victim, _n = live.pop()
+                    try:
+                        ray_tpu.kill(victim)
+                    except BaseException:
+                        pass
             with self._lock:
-                self._replicas[name] = live
+                self._replicas[name] = [r for r, _ in live]
+
+    def _autoscale(self, name: str, info: _DeploymentInfo,
+                   current: int, ongoing: int) -> int:
+        """Desired-replica decision with up/down hysteresis (reference
+        autoscaling_state.py get_decision_num_replicas)."""
+        ac = info.autoscaling_config
+        if ac is None:
+            return info.num_replicas
+        import math
+        with self._lock:
+            target = self._targets.get(name, ac.clamp(info.num_replicas))
+            desired = ac.clamp(
+                math.ceil(ongoing / max(ac.target_ongoing_requests,
+                                        1e-9)))
+            now = time.monotonic()
+            if desired == target:
+                self._scale_intent.pop(name, None)
+                return target
+            direction = "up" if desired > target else "down"
+            intent = self._scale_intent.get(name)
+            if intent is None or intent[0] != direction:
+                self._scale_intent[name] = (direction, desired, now)
+                return target
+            _, _, since = intent
+            delay = (ac.upscale_delay_s if direction == "up"
+                     else ac.downscale_delay_s)
+            # keep the most recent desired value while waiting
+            self._scale_intent[name] = (direction, desired, since)
+            if now - since >= delay:
+                self._targets[name] = desired
+                self._scale_intent.pop(name, None)
+                return desired
+            return target
 
 
 # ------------------------------------------------------------- handle
@@ -203,6 +348,10 @@ class DeploymentHandle:
         return self.method("__call__", *args, **kwargs)
 
     def method(self, method_name: str, *args, **kwargs):
+        ref, _ = self._route(method_name, args, kwargs)
+        return ref
+
+    def _route(self, method_name: str, args, kwargs):
         self._refresh()
         if not self._replicas:
             self._refresh(force=True)
@@ -211,11 +360,32 @@ class DeploymentHandle:
                     f"deployment {self._name!r} has no live replicas")
         self._drain_done()
         idx = self._pick()
-        ref = self._replicas[idx].handle_request.remote(
-            method_name, args, kwargs)
+        replica = self._replicas[idx]
+        ref = replica.handle_request.remote(method_name, args, kwargs)
         import weakref as _wr
         self._inflight[idx].append(_wr.ref(ref))
-        return ref
+        return ref, replica
+
+    def stream(self, *args, method_name: str = "__call__",
+               chunk_batch: int = 4, **kwargs):
+        """Call a generator deployment method; yields its chunks as they
+        are produced (reference streaming DeploymentResponseGenerator).
+        All pulls pin the replica that holds the generator state."""
+        ref, replica = self._route(method_name, args, kwargs)
+        first = ray_tpu.get(ref)
+        if not (isinstance(first, tuple) and len(first) == 2
+                and first[0] == "__stream__"):
+            # non-generator result: single-chunk stream
+            yield first
+            return
+        sid = first[1]
+        while True:
+            chunks = ray_tpu.get(
+                replica.next_chunk.remote(sid, chunk_batch))
+            for c in chunks:
+                if isinstance(c, tuple) and c == _STREAM_END:
+                    return
+                yield c
 
 
 # ---------------------------------------------------------- user API
@@ -229,19 +399,26 @@ class Application:
 class Deployment:
     def __init__(self, cls_or_fn, name: Optional[str] = None,
                  num_replicas: int = 1, max_ongoing_requests: int = 8,
-                 ray_actor_options: Optional[dict] = None):
+                 ray_actor_options: Optional[dict] = None,
+                 autoscaling_config: Optional[Any] = None):
         self._cls = cls_or_fn
         self.name = name or getattr(cls_or_fn, "__name__", "deployment")
         self.num_replicas = num_replicas
         self.max_ongoing_requests = max_ongoing_requests
         self.ray_actor_options = dict(ray_actor_options or {})
+        if isinstance(autoscaling_config, dict):
+            autoscaling_config = AutoscalingConfig(**autoscaling_config)
+        self.autoscaling_config = autoscaling_config
 
     def options(self, **kw) -> "Deployment":
         d = Deployment(self._cls, self.name, self.num_replicas,
-                       self.max_ongoing_requests, self.ray_actor_options)
+                       self.max_ongoing_requests, self.ray_actor_options,
+                       self.autoscaling_config)
         for k, v in kw.items():
             if not hasattr(d, k):
                 raise ValueError(f"unknown deployment option {k!r}")
+            if k == "autoscaling_config" and isinstance(v, dict):
+                v = AutoscalingConfig(**v)
             setattr(d, k, v)
         return d
 
@@ -274,7 +451,8 @@ def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
         init_args=app.init_args, init_kwargs=app.init_kwargs,
         num_replicas=d.num_replicas,
         max_ongoing_requests=d.max_ongoing_requests,
-        ray_actor_options=d.ray_actor_options)
+        ray_actor_options=d.ray_actor_options,
+        autoscaling_config=d.autoscaling_config)
     ray_tpu.get(controller.deploy.remote(info))
     return DeploymentHandle(dep_name, controller)
 
@@ -330,13 +508,23 @@ def start_http(port: int = 8000, host: str = "127.0.0.1") -> int:
     handles: Dict[str, DeploymentHandle] = {}
 
     class Ingress(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
         def do_POST(self):
-            name = self.path.strip("/").split("/")[0]
+            from urllib.parse import parse_qs, urlsplit
+            url = urlsplit(self.path)
+            parts = url.path.strip("/").split("/")
+            name = parts[0]
+            streaming = (len(parts) > 1 and parts[1] == "stream") or \
+                parse_qs(url.query).get("stream", ["0"])[0] == "1"
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"null")
                 if name not in handles:
                     handles[name] = get_handle(name)
+                if streaming:
+                    self._stream_response(handles[name], body)
+                    return
                 result = ray_tpu.get(handles[name].remote(body),
                                      timeout=60)
                 payload = json.dumps({"result": result}).encode()
@@ -348,6 +536,27 @@ def start_http(port: int = 8000, host: str = "127.0.0.1") -> int:
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
+
+        def _stream_response(self, handle, body) -> None:
+            """Chunked transfer: one JSON line per generator chunk
+            (reference proxy streaming over ASGI)."""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/jsonlines")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_chunk(data: bytes) -> None:
+                self.wfile.write(f"{len(data):X}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+
+            try:
+                for chunk in handle.stream(body):
+                    write_chunk(json.dumps({"chunk": chunk}).encode()
+                                + b"\n")
+            except BaseException as e:  # noqa: BLE001
+                write_chunk(json.dumps({"error": repr(e)}).encode()
+                            + b"\n")
+            self.wfile.write(b"0\r\n\r\n")
 
         def log_message(self, *a):   # quiet
             pass

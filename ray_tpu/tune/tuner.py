@@ -166,17 +166,22 @@ class _GroupTrialRunner:
                             scaling.placement_strategy,
                             bundles=scaling.worker_bundles())
         group.start()
-        backend: Backend = tr._backend_config.backend_cls()()
-        backend.on_start(group, tr._backend_config)
-        fn_bytes = cloudpickle.dumps(tr._fn)
-        restore_arg = (ray_tpu.put(restore_bytes)
-                       if restore_bytes is not None else None)
-        shard_bytes = tr._dataset_shards(group.num_workers)
-        ray_tpu.get([
-            w.init_session.remote(fn_bytes, config, restore_arg,
-                                  shard_bytes[i])
-            for i, w in enumerate(group.workers)])
-        backend.on_training_start(group, tr._backend_config)
+        try:
+            backend: Backend = tr._backend_config.backend_cls()()
+            backend.on_start(group, tr._backend_config)
+            fn_bytes = cloudpickle.dumps(tr._fn)
+            restore_arg = (ray_tpu.put(restore_bytes)
+                           if restore_bytes is not None else None)
+            shard_bytes = tr._dataset_shards(group.num_workers)
+            ray_tpu.get([
+                w.init_session.remote(fn_bytes, config, restore_arg,
+                                      shard_bytes[i])
+                for i, w in enumerate(group.workers)])
+            backend.on_training_start(group, tr._backend_config)
+        except BaseException:
+            # never strand a started PG + actors on a failed launch
+            group.shutdown()
+            raise
         self._group, self._backend = group, backend
 
     def poll(self):
@@ -398,72 +403,83 @@ class Tuner:
             return all(avail.get(k, 0.0) >= v
                        for k, v in trial_resources.items())
 
-        while pending or runners:
-            while pending and len(runners) < cfg.max_concurrent_trials:
-                if runners and not capacity_for_trial():
-                    break                    # defer until a trial frees up
-                trial = pending.pop(0)
-                try:
-                    launch(trial)
-                except BaseException as e:
-                    if not runners:
-                        # nothing running to free capacity — surface it
-                        finish(trial, ERROR, error=repr(e))
+        try:
+            while pending or runners:
+                while pending and len(runners) < cfg.max_concurrent_trials:
+                    if runners and not capacity_for_trial():
+                        break                    # defer until a trial frees up
+                    trial = pending.pop(0)
+                    try:
+                        launch(trial)
+                    except BaseException as e:
+                        if not runners:
+                            # nothing running to free capacity — surface it
+                            finish(trial, ERROR, error=repr(e))
+                            continue
+                        # transient (e.g. PG race lost): retry after progress
+                        trial.status = PENDING
+                        runners.pop(trial.trial_id, None)
+                        ref_of.pop(trial.trial_id, None)
+                        pending.append(trial)
+                        break
+                if not runners:
+                    if pending:
                         continue
-                    # transient (e.g. PG race lost): retry after progress
-                    trial.status = PENDING
-                    runners.pop(trial.trial_id, None)
-                    ref_of.pop(trial.trial_id, None)
-                    pending.append(trial)
                     break
-            if not runners:
-                if pending:
+                ready, _ = ray_tpu.wait(
+                    [ref_of[t] for t in runners], num_returns=1,
+                    timeout=cfg.trial_poll_timeout)
+                if not ready:
+                    raise TimeoutError(
+                        f"no trial progressed within "
+                        f"{cfg.trial_poll_timeout}s: {sorted(runners)}")
+                ref = ready[0]
+                trial = inflight.pop(ref.object_id)
+                try:
+                    item = runners[trial.trial_id].collect(ref, timeout=30.0)
+                except BaseException as e:
+                    finish(trial, ERROR, error=repr(e))
                     continue
-                break
-            ready, _ = ray_tpu.wait(
-                [ref_of[t] for t in runners], num_returns=1,
-                timeout=cfg.trial_poll_timeout)
-            if not ready:
-                raise TimeoutError(
-                    f"no trial progressed within "
-                    f"{cfg.trial_poll_timeout}s: {sorted(runners)}")
-            ref = ready[0]
-            trial = inflight.pop(ref.object_id)
-            try:
-                item = runners[trial.trial_id].collect(ref, timeout=30.0)
-            except BaseException as e:
-                finish(trial, ERROR, error=repr(e))
-                continue
-            if item is None:
-                finish(trial, TERMINATED)
-                continue
-            metrics, ckpt_bytes = item
-            trial.num_results += 1
-            trial.last_result = metrics
-            if ckpt_bytes is not None:
-                managers[trial.trial_id].register_bytes(ckpt_bytes,
-                                                        metrics)
-            if searcher is not None:
-                searcher.on_trial_result(trial.trial_id,
-                                         trial.num_results, metrics)
-            decision = scheduler.on_result(
-                trial.trial_id, trial.num_results, metrics)
-            if decision == STOP:
-                finish(trial, STOPPED)
-            elif isinstance(decision, tuple) and decision[0] == EXPLOIT:
-                # PBT: inherit the source trial's checkpoint + mutated
-                # config, restart this trial's runner in place
-                _, src_id, new_config = decision
-                restore = latest_ckpt_bytes(src_id)
-                runners.pop(trial.trial_id).stop()
-                ref_of.pop(trial.trial_id, None)
-                trial.config = dict(new_config)
-                trial.num_perturbations += 1
-                launch(trial, restore)
-            else:
-                assert decision == CONTINUE
-                poll(trial)
-            self._save_state(exp_dir, trials)
+                if item is None:
+                    finish(trial, TERMINATED)
+                    continue
+                metrics, ckpt_bytes = item
+                trial.num_results += 1
+                trial.last_result = metrics
+                if ckpt_bytes is not None:
+                    managers[trial.trial_id].register_bytes(ckpt_bytes,
+                                                            metrics)
+                if searcher is not None:
+                    searcher.on_trial_result(trial.trial_id,
+                                             trial.num_results, metrics)
+                decision = scheduler.on_result(
+                    trial.trial_id, trial.num_results, metrics)
+                if decision == STOP:
+                    finish(trial, STOPPED)
+                elif isinstance(decision, tuple) and decision[0] == EXPLOIT:
+                    # PBT: inherit the source trial's checkpoint + mutated
+                    # config, restart this trial's runner in place
+                    _, src_id, new_config = decision
+                    restore = latest_ckpt_bytes(src_id)
+                    runners.pop(trial.trial_id).stop()
+                    ref_of.pop(trial.trial_id, None)
+                    trial.config = dict(new_config)
+                    trial.num_perturbations += 1
+                    try:
+                        launch(trial, restore)
+                    except BaseException as e:
+                        finish(trial, ERROR, error=repr(e))
+                else:
+                    assert decision == CONTINUE
+                    poll(trial)
+                self._save_state(exp_dir, trials)
+        except BaseException:
+            for _r in list(runners.values()):
+                try:
+                    _r.stop()
+                except BaseException:
+                    pass
+            raise
 
         self._save_state(exp_dir, trials)
         return ResultGrid(trials, cfg.metric, cfg.mode, exp_dir)

@@ -110,3 +110,86 @@ def test_serve_http_ingress(serve_shutdown):
         assert out["result"]["echo"] == {"x": 1}
     finally:
         serve.stop_http()
+
+
+# ----------------------------------------------------- autoscaling
+def test_serve_autoscales_up_and_down(serve_shutdown):
+    """VERDICT r3 item 4 gate: load scales 1 -> N; drain scales back to
+    min (reference _private/autoscaling_state.py decision loop)."""
+    @serve.deployment(
+        num_replicas=1, max_ongoing_requests=4,
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_ongoing_requests": 1.0,
+                            "upscale_delay_s": 0.5,
+                            "downscale_delay_s": 1.0})
+    class Slow:
+        def __call__(self, x):
+            time.sleep(2.0)
+            return x
+
+    h = serve.run(Slow.bind(), name="slow")
+    # saturate: 8 concurrent 2s requests against target=1/replica
+    refs = [h.remote(i) for i in range(8)]
+    deadline = time.time() + 30
+    peak = 1
+    while time.time() < deadline:
+        st = serve.status()["slow"]
+        peak = max(peak, st["live_replicas"])
+        if peak >= 2:
+            break
+        # keep pressure on
+        done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+        if len(done) == len(refs):
+            refs = [h.remote(i) for i in range(8)]
+        time.sleep(0.3)
+    assert peak >= 2, serve.status()
+    ray_tpu.get(refs, timeout=60)
+
+    # drain: no load -> back down to min_replicas
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if serve.status()["slow"]["live_replicas"] == 1:
+            break
+        time.sleep(0.3)
+    assert serve.status()["slow"]["live_replicas"] == 1, serve.status()
+
+
+# ------------------------------------------------------- streaming
+def test_serve_streaming_handle(serve_shutdown):
+    @serve.deployment(num_replicas=1)
+    class Tokens:
+        def __call__(self, prompt):
+            for i, tok in enumerate(prompt.split()):
+                yield f"{i}:{tok}"
+
+    h = serve.run(Tokens.bind(), name="tok")
+    out = list(h.stream("a b c d e"))
+    assert out == ["0:a", "1:b", "2:c", "3:d", "4:e"]
+    # non-generator methods stream as a single chunk
+    @serve.deployment(num_replicas=1)
+    def plain(x):
+        return x * 2
+    h2 = serve.run(plain.bind(), name="plain")
+    assert list(h2.stream(21)) == [42]
+
+
+def test_serve_streaming_http(serve_shutdown):
+    @serve.deployment(num_replicas=1)
+    class Gen:
+        def __call__(self, body):
+            for i in range(int(body["n"])):
+                yield {"i": i}
+
+    serve.run(Gen.bind(), name="gen")
+    port = serve.start_http(port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/gen/stream",
+            data=json.dumps({"n": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers.get("Transfer-Encoding") == "chunked"
+            lines = [json.loads(l) for l in resp.read().splitlines() if l]
+        assert [c["chunk"]["i"] for c in lines] == [0, 1, 2, 3]
+    finally:
+        serve.stop_http()

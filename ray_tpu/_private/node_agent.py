@@ -150,6 +150,15 @@ class NodeAgent:
             pass
         self.scheduler.shutdown()
         self.store.shutdown()
+        from ray_tpu._private.specs import SESSION_TAG_INHERITED
+        if not SESSION_TAG_INHERITED:
+            # standalone agent (own session tag -> sole owner of its
+            # segments on this host): reap orphans from killed workers.
+            # An agent co-located with a head inherits the head's tag
+            # and leaves the sweep to the head's shutdown.
+            from ray_tpu._private.object_store import (
+                sweep_session_segments)
+            sweep_session_segments()
 
     def wait_forever(self) -> None:
         while not self._stop.is_set():

@@ -45,8 +45,18 @@ import cloudpickle
 from ray_tpu._private.config import CONFIG as _CFG
 
 
+def _local_tag() -> str:
+    """Segment names carry the PRODUCING process tree's session tag
+    (not the id-issuer's): a task submitted by a remote driver but
+    executed here seals segments on THIS host, and this host's
+    tag-prefixed sweep must find them."""
+    from ray_tpu._private.specs import SESSION_TAG
+    return SESSION_TAG
+
+
 def new_object_id() -> str:
-    return uuid.uuid4().hex[:20]
+    from ray_tpu._private.specs import SESSION_TAG
+    return SESSION_TAG + uuid.uuid4().hex[:14]
 
 
 @dataclass
@@ -106,20 +116,43 @@ def reap_object_segments(object_id: str, max_buffers: int = 64) -> int:
     store inline), so scan /dev/shm for the prefix rather than probing
     sequentially. Returns the number reaped."""
     reaped = 0
-    prefix = f"rtpu_{object_id}_"
+    prefix = f"rtpu_{_local_tag()}_{object_id}_"
     try:
         names = [n for n in os.listdir("/dev/shm")
                  if n.startswith(prefix)]
     except OSError:
         # no listable shm dir (non-Linux): fall back to index probing
         # over the full range, tolerating gaps
-        names = [f"rtpu_{object_id}_{i}" for i in range(max_buffers)]
+        names = [f"rtpu_{_local_tag()}_{object_id}_{i}"
+                 for i in range(max_buffers)]
     for name in names:
         try:
             _posixshmem.shm_unlink("/" + name)
             reaped += 1
         except OSError:
             pass
+    return reaped
+
+
+def sweep_session_segments() -> int:
+    """Unlink every shm segment created under THIS process tree's
+    session tag (ids embed it, so segment names start with
+    rtpu_<tag>). Safe only once all of the session's producers and
+    consumers are stopped — called from Runtime/NodeAgent shutdown."""
+    from ray_tpu._private.specs import SESSION_TAG
+    prefix = "rtpu_" + SESSION_TAG
+    reaped = 0
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return 0
+    for name in names:
+        if name.startswith(prefix):
+            try:
+                _posixshmem.shm_unlink("/" + name)
+                reaped += 1
+            except OSError:
+                pass
     return reaped
 
 
@@ -148,7 +181,7 @@ def serialize(value: Any, object_id: Optional[str] = None,
             inline.append(mv.tobytes())
             order.append("i")
         else:
-            name = f"rtpu_{object_id}_{i}"
+            name = f"rtpu_{_local_tag()}_{object_id}_{i}"
             _create_segment(name, mv)
             shm_names.append(name)
             shm_sizes.append(len(mv))

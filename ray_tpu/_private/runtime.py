@@ -1093,6 +1093,22 @@ class Runtime(_context.BaseContext):
         except OSError:
             pass
         self.store.shutdown()
+        self._sweep_orphan_segments()
+
+    def _sweep_orphan_segments(self) -> None:
+        """Final backstop against shm leaks: every worker/agent this
+        runtime spawned is stopped by now, so any segment tagged with
+        OUR session that the store didn't reclaim is an orphan from a
+        killed producer (the per-death reap covers the common paths;
+        this catches the rest). Only the session-tag OWNER sweeps: a
+        driver started inside a job/worker of a parent session inherits
+        the tag, and sweeping there would delete the parent's live
+        segments."""
+        from ray_tpu._private.specs import SESSION_TAG_INHERITED
+        if SESSION_TAG_INHERITED:
+            return
+        from ray_tpu._private.object_store import sweep_session_segments
+        sweep_session_segments()
 
 
 # ================= module-level init/shutdown =================

@@ -16,8 +16,24 @@ from typing import Any, Optional
 import cloudpickle
 
 
+# Per-process-tree session tag, hex-only (id parsing splits on 'r').
+# Prefixing every task/object id with it names shm segments
+# rtpu_<tag>... so end-of-session orphan sweeps can't touch a
+# concurrent driver's segments. Child processes inherit it via env.
+import os as _os
+
+import re as _re
+
+_env_tag = _os.environ.get("RAY_TPU_SESSION", "")
+# only a sane hex tag counts as inherited (ids are parsed on 'r' and
+# segment names are swept by prefix — junk/empty values are ignored)
+SESSION_TAG_INHERITED = bool(_re.fullmatch(r"[0-9a-f]{4,16}", _env_tag))
+SESSION_TAG = _env_tag if SESSION_TAG_INHERITED else uuid.uuid4().hex[:6]
+_os.environ["RAY_TPU_SESSION"] = SESSION_TAG
+
+
 def new_task_id() -> str:
-    return uuid.uuid4().hex[:16]
+    return SESSION_TAG + uuid.uuid4().hex[:12]
 
 
 def new_actor_id() -> str:

@@ -4,7 +4,8 @@ from ray_tpu.rllib.algorithms.impala import (IMPALA, IMPALAConfig,
                                              IMPALALearnerConfig,
                                              vtrace_returns)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACModule
 
 __all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "IMPALALearner",
            "IMPALALearnerConfig", "vtrace_returns", "DQN", "DQNConfig",
-           "QModule"]
+           "QModule", "SAC", "SACConfig", "SACModule"]

@@ -40,7 +40,10 @@ class _Replica:
     (the reference streams over gRPC/ASGI; here the ordered actor queue
     is the transport)."""
 
-    def __init__(self, cls_or_fn, init_args, init_kwargs):
+    def __init__(self, cls_or_fn, init_args, init_kwargs,
+                 deployment: str = "", replica_id: str = "",
+                 controller_name: str = "",
+                 report_period_s: float = 0.5):
         if isinstance(cls_or_fn, type):
             self._obj = cls_or_fn(*init_args, **init_kwargs)
         else:
@@ -49,16 +52,53 @@ class _Replica:
         self._total = 0
         self._lock = threading.Lock()
         self._streams: Dict[str, tuple] = {}   # sid -> (gen, last_used)
+        # Replica-PUSHED stats (reference _private/replica.py metrics
+        # push): a probe through the actor's request queue would starve
+        # behind saturated user calls — exactly when autoscaling needs
+        # the signal most — so a side thread reports ongoing counts to
+        # the controller instead, doubling as the liveness signal.
+        self._stop_report = threading.Event()
+        if deployment and controller_name:
+            threading.Thread(
+                target=self._report_loop,
+                args=(deployment, replica_id, controller_name,
+                      report_period_s),
+                daemon=True, name="replica-report").start()
+
+    def _report_loop(self, deployment: str, rid: str,
+                     controller_name: str, period: float) -> None:
+        import ray_tpu
+        controller = None
+        while not self._stop_report.wait(period):
+            try:
+                if controller is None:
+                    controller = ray_tpu.get_actor(controller_name)
+                with self._lock:
+                    self._sweep_streams()
+                    ongoing = self._ongoing + len(self._streams)
+                controller.report_stats.remote(deployment, rid, ongoing)
+            except BaseException:
+                controller = None
 
     def ping(self):
         return "pong"
 
     def stats(self) -> dict:
         with self._lock:
+            self._sweep_streams()
             return {"ongoing": self._ongoing + len(self._streams),
                     "total": self._total}
 
-    def handle_request(self, method: str, args, kwargs):
+    def close_stream(self, sid: str) -> None:
+        """Early-exit consumers retire their parked generator so it
+        stops counting as ongoing (autoscaling signal) immediately."""
+        with self._lock:
+            entry = self._streams.pop(sid, None)
+        if entry is not None:
+            entry[0].close()
+
+    def handle_request(self, method: str, args, kwargs,
+                       wants_stream: bool = False):
         import inspect
         import uuid
         with self._lock:
@@ -70,6 +110,10 @@ class _Replica:
             else:
                 result = getattr(self._obj, method)(*args, **kwargs)
             if inspect.isgenerator(result):
+                if not wants_stream:
+                    # plain .remote() on a generator method: drain it
+                    # (never leak the internal stream handshake)
+                    return list(result)
                 sid = uuid.uuid4().hex[:12]
                 with self._lock:
                     self._sweep_streams()
@@ -149,14 +193,26 @@ class ServeController:
     """Owns deployment -> replica-set state; reconciles continuously
     (reference deployment_state DeploymentStateManager.update loop)."""
 
+    # Presumed-dead threshold: generous enough that a replica whose
+    # report thread is starved by a long GIL-holding call (first-request
+    # jit compile) isn't misdeclared dead.
+    _REPORT_TTL_S = 10.0
+    _STARTUP_GRACE_S = 30.0  # time for a new replica's first report
+
     def __init__(self):
         self._deployments: Dict[str, _DeploymentInfo] = {}
+        # name -> [(replica_id, handle, created_monotonic), ...]
         self._replicas: Dict[str, List[Any]] = {}
+        # (name, replica_id) -> (ongoing, reported_monotonic)
+        self._reports: Dict[tuple, tuple] = {}
         self._targets: Dict[str, int] = {}       # autoscaled target
         # autoscale hysteresis: name -> (direction, desired, since)
         self._scale_intent: Dict[str, tuple] = {}
         self._last_ongoing: Dict[str, int] = {}
         self._lock = threading.Lock()
+        # serializes whole reconcile passes (deploy() RPCs race the
+        # 1 Hz loop thread under the actor's max_concurrency)
+        self._reconcile_lock = threading.Lock()
         self._running = True
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True)
@@ -175,11 +231,20 @@ class ServeController:
             self._scale_intent.pop(info.name, None)
         self._reconcile_once()
 
+    def report_stats(self, name: str, replica_id: str,
+                     ongoing: int) -> None:
+        """Replica-pushed ongoing count; doubles as liveness."""
+        with self._lock:
+            self._reports[(name, replica_id)] = (int(ongoing),
+                                                 time.monotonic())
+
     def delete_deployment(self, name: str) -> None:
         with self._lock:
             self._deployments.pop(name, None)
             replicas = self._replicas.pop(name, [])
-        for r in replicas:
+            for key in [k for k in self._reports if k[0] == name]:
+                self._reports.pop(key, None)
+        for _rid, r, _t in replicas:
             try:
                 ray_tpu.kill(r)
             except BaseException:
@@ -189,7 +254,7 @@ class ServeController:
         with self._lock:
             if name not in self._deployments:
                 raise ValueError(f"no deployment named {name!r}")
-            return list(self._replicas.get(name, []))
+            return [r for _rid, r, _t in self._replicas.get(name, [])]
 
     def list_deployments(self) -> Dict[str, dict]:
         with self._lock:
@@ -219,16 +284,38 @@ class ServeController:
         import cloudpickle
         with self._lock:
             items = list(self._deployments.items())
+        with self._reconcile_lock:
+            self._reconcile_items(items)
+
+    def _reconcile_items(self, items) -> None:
+        import uuid
+
+        import cloudpickle
+        now = time.monotonic()
         for name, info in items:
-            live, ongoing = [], 0        # live: (replica, its ongoing)
-            for r in self._replicas.get(name, []):
-                try:
-                    st = ray_tpu.get(r.stats.remote(), timeout=5.0)
-                    n_r = int(st.get("ongoing", 0))
-                    ongoing += n_r
-                    live.append((r, n_r))
-                except BaseException:
-                    pass                  # dead replica: dropped
+            live, ongoing = [], 0   # live: (rid, handle, created, ongoing)
+            with self._lock:
+                current = list(self._replicas.get(name, []))
+                reports = {rid: self._reports.get((name, rid))
+                           for rid, _r, _t in current}
+            for rid, r, created in current:
+                rep = reports.get(rid)
+                if rep is not None and now - rep[1] < self._REPORT_TTL_S:
+                    live.append((rid, r, created, rep[0]))
+                    ongoing += rep[0]
+                elif now - created < self._STARTUP_GRACE_S and rep is None:
+                    live.append((rid, r, created, 0))   # still starting
+                else:
+                    # silent past TTL: presumed dead. KILL before
+                    # dropping — if the presumption was wrong (replica
+                    # wedged, not dead) an untracked live actor would
+                    # leak its resources forever.
+                    try:
+                        ray_tpu.kill(r)
+                    except BaseException:
+                        pass
+                    with self._lock:
+                        self._reports.pop((name, rid), None)
             with self._lock:
                 self._last_ongoing[name] = ongoing
             target = self._autoscale(name, info, len(live), ongoing)
@@ -236,22 +323,28 @@ class ServeController:
                 cls = cloudpickle.loads(info.cls_bytes)
                 opts = dict(info.ray_actor_options)
                 opts["max_concurrency"] = info.max_ongoing_requests
+                rid = uuid.uuid4().hex[:8]
                 actor = ray_tpu.remote(**opts)(_Replica).remote(
-                    cls, info.init_args, info.init_kwargs)
-                live.append((actor, 0))
+                    cls, info.init_args, info.init_kwargs,
+                    deployment=name, replica_id=rid,
+                    controller_name=_CONTROLLER_NAME)
+                live.append((rid, actor, time.monotonic(), 0))
             if len(live) > target:
                 # evict the idlest replicas first so in-flight requests
                 # and parked streams survive the downscale when any
                 # idle capacity exists
-                live.sort(key=lambda rn: rn[1], reverse=True)
+                live.sort(key=lambda rn: rn[3], reverse=True)
                 while len(live) > target:
-                    victim, _n = live.pop()
+                    rid, victim, _c, _n = live.pop()
                     try:
                         ray_tpu.kill(victim)
                     except BaseException:
                         pass
+                    with self._lock:
+                        self._reports.pop((name, rid), None)
             with self._lock:
-                self._replicas[name] = [r for r, _ in live]
+                self._replicas[name] = [(rid, r, c)
+                                        for rid, r, c, _n in live]
 
     def _autoscale(self, name: str, info: _DeploymentInfo,
                    current: int, ongoing: int) -> int:
@@ -351,7 +444,8 @@ class DeploymentHandle:
         ref, _ = self._route(method_name, args, kwargs)
         return ref
 
-    def _route(self, method_name: str, args, kwargs):
+    def _route(self, method_name: str, args, kwargs,
+               wants_stream: bool = False):
         self._refresh()
         if not self._replicas:
             self._refresh(force=True)
@@ -361,7 +455,8 @@ class DeploymentHandle:
         self._drain_done()
         idx = self._pick()
         replica = self._replicas[idx]
-        ref = replica.handle_request.remote(method_name, args, kwargs)
+        ref = replica.handle_request.remote(method_name, args, kwargs,
+                                            wants_stream)
         import weakref as _wr
         self._inflight[idx].append(_wr.ref(ref))
         return ref, replica
@@ -371,7 +466,8 @@ class DeploymentHandle:
         """Call a generator deployment method; yields its chunks as they
         are produced (reference streaming DeploymentResponseGenerator).
         All pulls pin the replica that holds the generator state."""
-        ref, replica = self._route(method_name, args, kwargs)
+        ref, replica = self._route(method_name, args, kwargs,
+                                   wants_stream=True)
         first = ray_tpu.get(ref)
         if not (isinstance(first, tuple) and len(first) == 2
                 and first[0] == "__stream__"):
@@ -379,13 +475,23 @@ class DeploymentHandle:
             yield first
             return
         sid = first[1]
-        while True:
-            chunks = ray_tpu.get(
-                replica.next_chunk.remote(sid, chunk_batch))
-            for c in chunks:
-                if isinstance(c, tuple) and c == _STREAM_END:
-                    return
-                yield c
+        finished = False
+        try:
+            while True:
+                chunks = ray_tpu.get(
+                    replica.next_chunk.remote(sid, chunk_batch))
+                for c in chunks:
+                    if isinstance(c, tuple) and c == _STREAM_END:
+                        finished = True
+                        return
+                    yield c
+        finally:
+            if not finished:
+                # abandoned mid-stream: retire the parked generator now
+                try:
+                    replica.close_stream.remote(sid)
+                except BaseException:
+                    pass
 
 
 # ---------------------------------------------------------- user API

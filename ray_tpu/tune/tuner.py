@@ -345,11 +345,16 @@ class Tuner:
         ref_of: Dict[str, Any] = {}       # trial_id -> wait ref
         managers: Dict[str, CheckpointManager] = {}
         ckpt_cfg = self._run.checkpoint_config
+        # restore bytes for requeued relaunches (PBT exploit that lost a
+        # placement race keeps its inherited checkpoint)
+        pending_restore: Dict[str, bytes] = {}
 
         def launch(trial: Trial,
                    restore_bytes: Optional[bytes] = None) -> None:
             if searcher is not None and not trial.config:
                 trial.config = searcher.suggest(trial.trial_id)
+            if restore_bytes is None:
+                restore_bytes = pending_restore.pop(trial.trial_id, None)
             runner = make_runner()
             runner.launch(self._trial_config(trial), restore_bytes)
             trial.status = RUNNING

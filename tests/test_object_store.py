@@ -102,12 +102,14 @@ def test_reap_object_segments_cleans_orphans():
     import _posixshmem
 
     from ray_tpu._private.object_store import (_create_segment,
+                                               _local_tag,
                                                reap_object_segments)
     rid = "deadbeef01r0"
+    tag = _local_tag()
     for i in range(3):
-        _create_segment(f"rtpu_{rid}_{i}", memoryview(b"x" * 128))
+        _create_segment(f"rtpu_{tag}_{rid}_{i}", memoryview(b"x" * 128))
     assert reap_object_segments(rid) == 3
     # gone — and reaping again is a no-op
     assert reap_object_segments(rid) == 0
     with pytest.raises(FileNotFoundError):
-        _posixshmem.shm_open(f"/rtpu_{rid}_0", 0, mode=0o600)
+        _posixshmem.shm_open(f"/rtpu_{tag}_{rid}_0", 0, mode=0o600)

@@ -512,3 +512,153 @@ def test_dqn_cartpole_learning_gate(fresh_cluster):
         if best >= 200:
             break
     assert best >= 200, f"DQN failed to learn CartPole: best={best}"
+
+
+# --------------------------------------------------------------- SAC
+def test_sac_update_moves_critic_and_alpha():
+    """One SAC update step: critic loss finite, alpha autotunes, target
+    nets move by polyak tau toward the online critics."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+    algo = SACConfig().training(hidden=(32, 32),
+                                learning_starts=0,
+                                random_steps=10_000,
+                                num_updates_per_iteration=4,
+                                rollout_steps_per_iteration=40,
+                                train_batch_size=32).build()
+    t_before = jax.device_get(algo.target_q)
+    alpha_before = float(jnp.exp(algo.log_alpha))
+    m = algo.train()
+    assert np.isfinite(m["critic_loss"])
+    assert np.isfinite(m["actor_loss"])
+    assert m["alpha"] != alpha_before        # autotune stepped
+    t_after = jax.device_get(algo.target_q)
+    moved = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(a - b).max()), t_before, t_after)
+    assert max(jax.tree_util.tree_leaves(moved)) > 0.0
+    algo.stop()
+
+
+@pytest.mark.slow
+def test_sac_pendulum_learning_gate():
+    """Parity with reference rllib/tuned_examples/sac/pendulum_sac.py:
+    SAC must clearly solve the hang-up phase (mean return > -600 from a
+    ~-1400 random-policy start)."""
+    from ray_tpu.rllib.algorithms.sac import SACConfig
+    algo = SACConfig().environment("Pendulum-v1").training(
+        hidden=(128, 128), seed=0).build()
+    best = -float("inf")
+    for i in range(70):
+        m = algo.train()
+        r = m.get("episode_return_mean", float("nan"))
+        if r == r:
+            best = max(best, r)
+        if best > -600:
+            break
+    algo.stop()
+    assert best > -600, f"SAC failed to learn Pendulum: best={best}"
+
+
+# -------------------------------------------------------- multi-agent
+class _TwoCartPoles:
+    """Two independent CartPole instances as one 2-agent env (the
+    reference's co-existing-agents pattern, multi_agent_env.py)."""
+
+    agents = ("a0", "a1")
+
+    def __init__(self):
+        import gymnasium as gym
+        self._envs = {a: gym.make("CartPole-v1") for a in self.agents}
+        self._done = {a: False for a in self.agents}
+
+    def reset(self, *, seed=None):
+        obs = {}
+        for i, a in enumerate(self.agents):
+            o, _ = self._envs[a].reset(
+                seed=None if seed is None else seed + i)
+            obs[a] = o
+            self._done[a] = False
+        return obs, {}
+
+    def step(self, actions):
+        obs, rew, term, trunc = {}, {}, {}, {}
+        for a in self.agents:
+            if self._done[a]:
+                obs[a] = np.zeros(4, np.float32)
+                rew[a], term[a], trunc[a] = 0.0, True, False
+                continue
+            o, r, te, tr, _ = self._envs[a].step(int(actions[a]))
+            obs[a], rew[a] = o, float(r)
+            term[a], trunc[a] = bool(te), bool(tr)
+            if te or tr:
+                self._done[a] = True
+        term["__all__"] = all(self._done.values())
+        trunc["__all__"] = False
+        return obs, rew, term, trunc, {}
+
+    def close(self):
+        for e in self._envs.values():
+            e.close()
+
+
+def test_multi_agent_runner_policy_mapping_and_batches():
+    """Two agents -> two policies: per-policy batches have one column
+    per (env, agent); a shared-policy mapping merges the columns."""
+    from ray_tpu.rllib.env.multi_agent import (MultiAgentEnvRunner,
+                                               MultiAgentEnvRunnerConfig,
+                                               PolicySpec)
+    cfg = MultiAgentEnvRunnerConfig(
+        env_fn=_TwoCartPoles,
+        policies={"p0": PolicySpec(4, 2), "p1": PolicySpec(4, 2)},
+        policy_mapping_fn=lambda a: "p0" if a == "a0" else "p1",
+        num_envs=3, rollout_length=8, seed=0)
+    runner = MultiAgentEnvRunner(cfg)
+    batches = runner.sample()
+    assert set(batches) == {"p0", "p1"}
+    for pid in ("p0", "p1"):
+        b = batches[pid]
+        assert b["obs"].shape == (9, 3, 4)      # T+1, one col per env
+        assert b["actions"].shape == (8, 3)
+        assert set(b["mask"].ravel()) <= {0.0, 1.0}
+    runner.stop()
+
+    shared = MultiAgentEnvRunner(MultiAgentEnvRunnerConfig(
+        env_fn=_TwoCartPoles,
+        policies={"shared": PolicySpec(4, 2)},
+        policy_mapping_fn=lambda a: "shared",
+        num_envs=3, rollout_length=8, seed=0))
+    b = shared.sample()["shared"]
+    assert b["obs"].shape == (9, 6, 4)          # 3 envs x 2 agents
+    shared.stop()
+
+    with pytest.raises(ValueError, match="unknown"):
+        MultiAgentEnvRunner(MultiAgentEnvRunnerConfig(
+            env_fn=_TwoCartPoles, policies={"p0": PolicySpec(4, 2)},
+            policy_mapping_fn=lambda a: "nope",
+            num_envs=1, rollout_length=4, seed=0))
+
+
+@pytest.mark.slow
+def test_multi_agent_ppo_two_policies_learn():
+    """VERDICT r3 item 6 gate: MultiAgentEnvRunner + per-policy module
+    mapping — BOTH policies improve their own CartPole."""
+    from ray_tpu.rllib.env.multi_agent import (MultiAgentPPOConfig,
+                                               PolicySpec)
+    algo = MultiAgentPPOConfig(
+        env_fn=_TwoCartPoles,
+        policies={"p0": PolicySpec(4, 2), "p1": PolicySpec(4, 2)},
+        policy_mapping_fn=lambda a: "p0" if a == "a0" else "p1",
+        num_envs_per_env_runner=16, rollout_length=64, seed=0).build()
+    best = {"p0": 0.0, "p1": 0.0}
+    for i in range(80):
+        m = algo.train()
+        for pid in best:
+            r = m.get(f"episode_return_mean/policy/{pid}")
+            if r is not None and r == r:
+                best[pid] = max(best[pid], r)
+        if min(best.values()) > 120:
+            break
+    algo.stop()
+    assert min(best.values()) > 120, best

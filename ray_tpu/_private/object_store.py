@@ -140,7 +140,10 @@ def sweep_session_segments() -> int:
     rtpu_<tag>). Safe only once all of the session's producers and
     consumers are stopped — called from Runtime/NodeAgent shutdown."""
     from ray_tpu._private.specs import SESSION_TAG
-    prefix = "rtpu_" + SESSION_TAG
+    # the trailing separator matters: tag "abcd" must never match a
+    # concurrent session's "abcd12..." segments (every segment name is
+    # rtpu_<producer-tag>_<rest>)
+    prefix = f"rtpu_{SESSION_TAG}_"
     reaped = 0
     try:
         names = os.listdir("/dev/shm")

@@ -14,11 +14,14 @@ the reference even though control-plane hops are function calls.
 from __future__ import annotations
 
 import glob
+import logging
 import os
 import socket
 import threading
 import time
 from typing import Any, Optional
+
+log = logging.getLogger(__name__)
 
 from ray_tpu._private import context as _context
 from ray_tpu._private import protocol
@@ -1085,15 +1088,16 @@ class Runtime(_context.BaseContext):
         if self._shutdown:
             return
         self._shutdown = True
-        self.cluster.shutdown()
-        self.waiters.shutdown()
-        self._restore_pool.shutdown(wait=False)
-        try:
-            self._listener.close()
-        except OSError:
-            pass
-        self.store.shutdown()
-        self._sweep_orphan_segments()
+        # each step is independent: a wedged component must not block
+        # the ones after it (especially the final shm sweep)
+        for step in (self.cluster.shutdown, self.waiters.shutdown,
+                     lambda: self._restore_pool.shutdown(wait=False),
+                     self._listener.close, self.store.shutdown,
+                     self._sweep_orphan_segments):
+            try:
+                step()
+            except Exception:
+                log.exception("shutdown step failed")
 
     def _sweep_orphan_segments(self) -> None:
         """Final backstop against shm leaks: every worker/agent this

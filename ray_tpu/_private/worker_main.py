@@ -405,8 +405,15 @@ class WorkerExecutor:
                             "actor_id": spec.actor_id})
 
     def _invoke_actor_method(self, spec: ActorTaskSpec):
-        method = getattr(self._actor, spec.method_name)
         args, kwargs = self._resolve_args(spec.args, spec.kwargs)
+        if spec.method_name == "__rtpu_apply__":
+            # escape hatch (reference actor.__ray_call__): run an
+            # arbitrary function against the actor instance — compiled
+            # DAGs use it to install their channel exec loops on user
+            # actors without requiring cooperation from the class
+            fn = cloudpickle.loads(args[0])
+            return fn(self._actor, *args[1:], **kwargs)
+        method = getattr(self._actor, spec.method_name)
         return method(*args, **kwargs)
 
     def _run_actor_task(self, spec: ActorTaskSpec) -> None:

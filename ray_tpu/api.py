@@ -208,6 +208,10 @@ def _flatten_refs(object_refs) -> tuple[list[str], bool]:
 
 def get(object_refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None):
+    # channel-mode compiled DAG results carry their own transport;
+    # timeout=None blocks indefinitely, same as every other get path
+    if hasattr(object_refs, "_dag") and hasattr(object_refs, "get"):
+        return object_refs.get(timeout=timeout)
     ctx = _context.get_ctx()
     ids, single = _flatten_refs(object_refs)
     values = ctx.get_objects(ids, timeout)

@@ -1,0 +1,248 @@
+"""Channel-backed compiled DAG execution (the aDAG fast path).
+
+Parity: reference python/ray/dag/compiled_dag_node.py (CompiledDAG with
+persistent per-actor exec loops :135-224, execute :2118 returning
+CompiledDAGRef) over shared_memory_channel transport — re-designed for
+this stack: compilation allocates one mutable shm channel per producer
+node (single writer, one reader slot per consumer, plus the driver for
+outputs), then installs a long-running exec loop on every actor via the
+``__rtpu_apply__`` escape hatch. `execute()` writes the input into the
+input channel and returns a CompiledDAGRef whose `get()` reads the
+output channel — no task submission, object store traffic, or driver
+hop between stages.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.experimental.channel import (Channel, ChannelClosed,
+                                          ChannelReader, ChannelWriter)
+
+
+class _Err:
+    """Error envelope forwarded through downstream channels so one
+    failing node poisons the execution, not the pipeline."""
+
+    def __init__(self, repr_: str):
+        self.repr = repr_
+
+
+def _exec_loop(instance, method_name: str, in_channels: List[Channel],
+               in_reader_idx: List[int], arg_spec: List[Tuple],
+               kw_spec: Dict[str, Tuple], out_channel: Channel) -> int:
+    """Runs INSIDE the actor (one long-lived call): read inputs, run the
+    method, write the result; repeats until the upstream closes."""
+    readers = [ChannelReader(ch, i)
+               for ch, i in zip(in_channels, in_reader_idx)]
+    writer = ChannelWriter(out_channel)
+    executed = 0
+    while True:
+        vals = []
+        err: Any = None
+        try:
+            for r in readers:
+                vals.append(r.read())
+        except ChannelClosed:
+            writer.close()
+            return executed
+        for v in vals:
+            if isinstance(v, _Err):
+                err = v
+                break
+        if err is None:
+            def resolve(spec):
+                kind, payload = spec
+                return vals[payload] if kind == "n" else payload
+            try:
+                args = [resolve(s) for s in arg_spec]
+                kwargs = {k: resolve(s) for k, s in kw_spec.items()}
+                result = getattr(instance, method_name)(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                import traceback
+                result = _Err("".join(traceback.format_exception(e)))
+        else:
+            result = err
+        writer.write(result)
+        executed += 1
+
+
+class CompiledDAGRef:
+    """Result handle for one execute() (reference CompiledDAGRef):
+    `get()` reads the output channel(s) in order. ray_tpu.get() accepts
+    it directly."""
+
+    def __init__(self, dag: "ChannelCompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._consumed = False
+
+    def get(self, timeout: Optional[float] = 30.0):
+        if self._consumed:
+            raise ValueError("CompiledDAGRef can only be read once")
+        self._consumed = True
+        value = self._dag._fetch(self._seq, timeout)
+        if isinstance(value, _Err):
+            raise RuntimeError(f"compiled DAG node failed:\n{value.repr}")
+        if isinstance(value, list):
+            for v in value:
+                if isinstance(v, _Err):
+                    raise RuntimeError(
+                        f"compiled DAG node failed:\n{v.repr}")
+        return value
+
+
+class ChannelCompiledDAG:
+    """Channel-transport compiled DAG (single InputNode, every actor
+    hosts at most one node)."""
+
+    def __init__(self, output, buffer_size_bytes: int = 1 << 20):
+        from ray_tpu.dag import (ClassMethodNode, CompiledDAG, InputNode,
+                                 MultiOutputNode)
+        self._buffer = buffer_size_bytes
+        base = CompiledDAG(output)          # reuse toposort + validation
+        self._order = base._order
+        self._input = base._input
+        if self._input is None:
+            raise ValueError("channel-mode DAG needs an InputNode")
+        self._output = output
+        nodes = [n for n in self._order
+                 if isinstance(n, ClassMethodNode)]
+        if not nodes:
+            raise ValueError("channel-mode DAG needs actor nodes")
+        actors = [n.actor for n in nodes]
+        if len({a._actor_id for a in actors}) != len(actors):
+            raise ValueError(
+                "channel mode requires each actor to host exactly one "
+                "DAG node (an actor's exec loop owns it exclusively)")
+        out_nodes = (list(output.outputs)
+                     if isinstance(output, MultiOutputNode) else [output])
+        for o in out_nodes:
+            if not isinstance(o, ClassMethodNode):
+                raise ValueError("DAG outputs must be actor nodes")
+        self._out_nodes = out_nodes
+
+        # --- consumers per producer (input node included)
+        consumers: Dict[int, List] = {id(self._input): []}
+        for n in nodes:
+            consumers[id(n)] = []
+        for n in nodes:
+            seen_up = set()
+            for up in n.upstream:
+                # dedup: a node passing the same upstream twice still
+                # reads it through ONE reader slot
+                if id(up) in seen_up:
+                    continue
+                seen_up.add(id(up))
+                if isinstance(up, (ClassMethodNode, InputNode)):
+                    consumers[id(up)].append(n)
+        # the driver reads every output node's channel
+        n_extra = {id(n): 0 for n in nodes}
+        for o in out_nodes:
+            n_extra[id(o)] += 1
+
+        # --- allocate channels
+        self._channels: Dict[int, Channel] = {}
+        for key, cons in consumers.items():
+            extra = n_extra.get(key, 0)
+            n_readers = len(cons) + extra
+            if n_readers == 0:
+                continue
+            self._channels[key] = Channel.create(
+                capacity=buffer_size_bytes, n_readers=n_readers)
+        # reader slot assignment: consumers take slots in order; the
+        # driver takes the last slot(s)
+        slot: Dict[Tuple[int, int], int] = {}
+        for key, cons in consumers.items():
+            for i, c in enumerate(cons):
+                slot[(key, id(c))] = i
+
+        # --- install exec loops
+        self._loop_refs = []
+        from ray_tpu.actor import ActorMethod
+        for n in nodes:
+            in_chs, in_idx, arg_spec, kw_spec = [], [], [], {}
+            seen_inputs: Dict[int, int] = {}
+
+            def input_index(up) -> int:
+                if id(up) not in seen_inputs:
+                    seen_inputs[id(up)] = len(in_chs)
+                    in_chs.append(self._channels[id(up)])
+                    in_idx.append(slot[(id(up), id(n))])
+                return seen_inputs[id(up)]
+
+            for a in n.args:
+                if isinstance(a, (ClassMethodNode, InputNode)):
+                    arg_spec.append(("n", input_index(a)))
+                else:
+                    arg_spec.append(("c", a))
+            for k, v in n.kwargs.items():
+                if isinstance(v, (ClassMethodNode, InputNode)):
+                    kw_spec[k] = ("n", input_index(v))
+                else:
+                    kw_spec[k] = ("c", v)
+            method = ActorMethod(n.actor, "__rtpu_apply__", {})
+            self._loop_refs.append(method.remote(
+                cloudpickle.dumps(_exec_loop), n.method_name, in_chs,
+                in_idx, arg_spec, kw_spec, self._channels[id(n)]))
+
+        # --- driver endpoints
+        self._in_writer = ChannelWriter(self._channels[id(self._input)])
+        self._out_readers = []
+        taken: Dict[int, int] = {}
+        for o in out_nodes:
+            ch = self._channels[id(o)]
+            base_slot = len(consumers[id(o)]) + taken.get(id(o), 0)
+            taken[id(o)] = taken.get(id(o), 0) + 1
+            self._out_readers.append(ChannelReader(ch, base_slot))
+        self._multi = isinstance(output, MultiOutputNode)
+        self._lock = threading.Lock()
+        self._next_seq = 0
+        self._fetched: Dict[int, Any] = {}
+        self._read_seq = 0
+        self.num_executions = 0
+        self._torn_down = False
+
+    # ------------------------------------------------------------- api
+    def execute(self, *args) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("DAG was torn down")
+        if len(args) != 1:
+            raise TypeError(f"DAG takes exactly 1 input, got {len(args)}")
+        with self._lock:
+            self._in_writer.write(args[0])
+            seq = self._next_seq
+            self._next_seq += 1
+            self.num_executions += 1
+        return CompiledDAGRef(self, seq)
+
+    def _fetch(self, seq: int, timeout: Optional[float]):
+        with self._lock:
+            while self._read_seq <= seq:
+                outs = [r.read(timeout) for r in self._out_readers]
+                self._fetched[self._read_seq] = (
+                    outs if self._multi else outs[0])
+                self._read_seq += 1
+            return self._fetched.pop(seq)
+
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        try:
+            self._in_writer.close()
+            # exec loops propagate the close downstream and return
+            ray_tpu.get(self._loop_refs, timeout=10.0)
+        except BaseException:
+            pass
+        for ch in self._channels.values():
+            ch.destroy()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except BaseException:
+            pass

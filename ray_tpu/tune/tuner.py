@@ -416,7 +416,7 @@ class Tuner:
                     trial = pending.pop(0)
                     try:
                         launch(trial)
-                    except BaseException as e:
+                    except Exception as e:
                         if not runners:
                             # nothing running to free capacity — surface it
                             finish(trial, ERROR, error=repr(e))
@@ -472,8 +472,17 @@ class Tuner:
                     trial.num_perturbations += 1
                     try:
                         launch(trial, restore)
-                    except BaseException as e:
-                        finish(trial, ERROR, error=repr(e))
+                    except Exception:
+                        # transient (e.g. lost the PG race to the
+                        # stopping group's teardown): requeue like the
+                        # launch loop does instead of erroring a healthy
+                        # trial; the inherited checkpoint rides along
+                        trial.status = PENDING
+                        runners.pop(trial.trial_id, None)
+                        ref_of.pop(trial.trial_id, None)
+                        if restore is not None:
+                            pending_restore[trial.trial_id] = restore
+                        pending.append(trial)
                 else:
                     assert decision == CONTINUE
                     poll(trial)

@@ -1039,6 +1039,13 @@ class Runtime(_context.BaseContext):
                                        rec.spec.max_task_retries)
 
     # ---- state / introspection ----
+    def kv_op(self, op: str, key: str, value: Any = None,
+              namespace: str = "default", **kw) -> Any:
+        """Driver-side KV access (workers reach the same store over the
+        KV_OP wire message)."""
+        return self._kv_dispatch({"op": op, "key": key, "value": value,
+                                  "namespace": namespace, **kw})
+
     def state_op(self, op: str, **kwargs) -> Any:
         if op == "list_actors":
             return self.controller.list_actors()

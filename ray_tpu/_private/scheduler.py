@@ -7,7 +7,9 @@ Parity map (reference src/ray/raylet/):
   loop because the v0 cluster is one logical node owned by the driver.
 - ``WorkerPool`` -> raylet WorkerPool (worker_pool.h:366 PopWorker): spawns
   `python -m ray_tpu._private.worker_main` subprocesses on demand up to a
-  cap, reuses idle ones keyed by nothing (no runtime-env keying yet).
+  cap, reusing idle ones keyed by runtime-env hash (dispatch prefers a
+  worker whose applied env already matches, and workers keep their env
+  applied between same-env tasks).
 - blocked-worker resource release mirrors the reference's behavior where a
   worker blocked in `ray.get` releases its CPU so the node can oversubscribe
   (avoids the classic nested-task deadlock).
@@ -52,6 +54,10 @@ class WorkerRec:
     pg_key: Optional[tuple] = None
     blocked_depth: int = 0
     started_at: float = field(default_factory=time.time)
+    # hash of the runtime env last applied in this worker — dispatch
+    # prefers matching workers so pooled workers skip env churn
+    # (reference worker_pool.cc runtime-env-keyed reuse)
+    env_hash: str = ""
 
 
 def fits(avail: dict[str, float], need: dict[str, float]) -> bool:
@@ -347,11 +353,21 @@ class Scheduler:
             self._cv.notify_all()
 
     # ---- dispatch loop ----
-    def _pick_worker(self) -> Optional[WorkerRec]:
+    def _pick_worker(self, spec=None) -> Optional[WorkerRec]:
+        """Idle worker, preferring one whose last applied runtime env
+        matches the spec's (runtime-env-keyed reuse)."""
+        want = ""
+        if spec is not None:
+            from ray_tpu._private.runtime_env import env_hash
+            want = env_hash(getattr(spec, "runtime_env", None)) or ""
+        fallback = None
         for rec in self._workers.values():
             if rec.state == IDLE and rec.conn is not None:
-                return rec
-        return None
+                if rec.env_hash == want:
+                    return rec
+                if fallback is None:
+                    fallback = rec
+        return fallback
 
     def _alive_count(self) -> int:
         return sum(1 for r in self._workers.values() if r.state != DEAD)
@@ -531,7 +547,7 @@ class Scheduler:
                     else self.avail)
             if not fits(pool, need):
                 continue
-            worker = self._pick_worker()
+            worker = self._pick_worker(spec)
             if worker is None:
                 blocked = sum(1 for r in self._workers.values()
                               if r.blocked_depth > 0
@@ -562,6 +578,9 @@ class Scheduler:
             acquire(pool, need)
             worker.acquired = need
             worker.pg_key = pg_key
+            from ray_tpu._private.runtime_env import env_hash as _eh
+            worker.env_hash = _eh(getattr(spec, "runtime_env",
+                                          None)) or ""
             if isinstance(spec, ActorSpec):
                 worker.state = ACTOR
                 worker.actor_id = spec.actor_id

@@ -154,16 +154,17 @@ class WorkerContext(_context.BaseContext):
         return self.state_op("cluster_resources")
 
 
-def _apply_runtime_env(renv: Optional[dict]) -> dict:
+def _apply_runtime_env(renv: Optional[dict], kv_get=None) -> dict:
     """Apply a runtime_env in this process; returns undo info.
 
-    Parity: reference _private/runtime_env/ plugins, reduced to the two
-    locally-meaningful ones (env_vars fanout + working_dir); the key set
-    is validated at SUBMISSION time (api.validate_runtime_env). Atomic:
-    a failure mid-apply (working_dir vanished since validation) reverts
-    whatever was already applied before re-raising — a pooled worker
-    must never leak a half-applied env onto later tasks."""
-    undo: dict = {"env": {}, "cwd": None, "path": None}
+    Parity: reference _private/runtime_env/ plugins: env_vars fanout,
+    working_dir (chdir + sys.path), pip (per-host cached venv,
+    runtime_env/pip.py) and py_modules (KV-shipped packages,
+    runtime_env/py_modules.py); the key set is validated at SUBMISSION
+    time (api.validate_runtime_env). Atomic: a failure mid-apply
+    reverts whatever was already applied before re-raising — a pooled
+    worker must never leak a half-applied env onto later tasks."""
+    undo: dict = {"env": {}, "cwd": None, "paths": []}
     if not renv:
         return undo
     try:
@@ -175,7 +176,17 @@ def _apply_runtime_env(renv: Optional[dict]) -> dict:
             undo["cwd"] = os.getcwd()
             os.chdir(wd)
             sys.path.insert(0, wd)
-            undo["path"] = wd
+            undo["paths"].append(wd)
+        if renv.get("pip"):
+            from ray_tpu._private.runtime_env import ensure_pip_env
+            site = ensure_pip_env(renv["pip"])
+            sys.path.insert(0, site)
+            undo["paths"].append(site)
+        if renv.get("py_modules"):
+            from ray_tpu._private.runtime_env import ensure_py_modules
+            for path in ensure_py_modules(renv["py_modules"], kv_get):
+                sys.path.insert(0, path)
+                undo["paths"].append(path)
     except BaseException:
         _revert_runtime_env(undo)
         raise
@@ -190,9 +201,9 @@ def _revert_runtime_env(undo: dict) -> None:
             os.environ[k] = old
     if undo["cwd"] is not None:
         os.chdir(undo["cwd"])
-    if undo["path"] is not None:
+    for path in undo.get("paths", []):
         try:
-            sys.path.remove(undo["path"])
+            sys.path.remove(path)
         except ValueError:
             pass
 
@@ -202,7 +213,11 @@ class WorkerExecutor:
         self.ctx = ctx
         self._fn_cache: dict[str, Any] = {}
         self._running_tasks: dict[str, threading.Thread] = {}
-        self._task_undo: dict[str, dict] = {}
+        # runtime env stays APPLIED between tasks with the same hash
+        # (runtime-env-keyed worker reuse, reference worker_pool.cc);
+        # a task with a different env reverts + re-applies
+        self._cur_env_hash = None
+        self._cur_env_undo: dict = {"env": {}, "cwd": None, "paths": []}
         self._pending_cancels: set[str] = set()
         self._cancel_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=1,
@@ -341,9 +356,19 @@ class WorkerExecutor:
             self._pending_cancels.discard(spec.task_id)
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
                 ctypes.c_long(threading.get_ident()), None)
-        undo = self._task_undo.pop(spec.task_id, None)
-        if undo is not None:
-            _revert_runtime_env(undo)
+
+
+    def _switch_runtime_env(self, renv: Optional[dict]) -> None:
+        from ray_tpu._private.runtime_env import env_hash
+        h = env_hash(renv)
+        if h == self._cur_env_hash:
+            return
+        _revert_runtime_env(self._cur_env_undo)
+        self._cur_env_undo = {"env": {}, "cwd": None, "paths": []}
+        self._cur_env_hash = None
+        self._cur_env_undo = _apply_runtime_env(
+            renv, kv_get=lambda k: self.ctx.kv_op("get", k))
+        self._cur_env_hash = h
 
     def _run_task(self, spec: TaskSpec) -> None:
         from ray_tpu.exceptions import TaskCancelledError
@@ -357,8 +382,8 @@ class WorkerExecutor:
                         threading.current_thread()
                 # env first: the function/args may only UNPICKLE under
                 # the declared working_dir/env (the actor path does the
-                # same). Scoped: the pooled worker is reused after.
-                self._task_undo[spec.task_id] = _apply_runtime_env(
+                # same). Kept applied for reuse by same-env tasks.
+                self._switch_runtime_env(
                     getattr(spec, "runtime_env", None))
                 fn = self._load_function(spec.func_id)
                 args, kwargs = self._resolve_args(spec.args, spec.kwargs)
@@ -385,7 +410,8 @@ class WorkerExecutor:
     def _create_actor(self, spec: ActorSpec) -> None:
         try:
             # permanent: this worker is dedicated to the actor for life
-            _apply_runtime_env(getattr(spec, "runtime_env", None))
+            _apply_runtime_env(getattr(spec, "runtime_env", None),
+                               kv_get=lambda k: self.ctx.kv_op("get", k))
             cls = self._load_function(spec.class_id)
             args, kwargs = self._resolve_args(spec.init_args,
                                               spec.init_kwargs)

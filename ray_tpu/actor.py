@@ -19,7 +19,7 @@ from ray_tpu._private.specs import (ActorSpec, ActorTaskSpec,
                                     extract_ref_args, function_id,
                                     new_actor_id, new_task_id)
 from ray_tpu.api import (_apply_scheduling, build_resources,
-                         validate_runtime_env)
+                         prepare_runtime_env, validate_runtime_env)
 
 _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "name", "namespace",
@@ -50,6 +50,15 @@ class ActorClass:
         validate_runtime_env(self._opts.get("runtime_env"))
         self._pickled: Optional[bytes] = None
         self._class_id: Optional[str] = None
+        self._prepared_renv: Optional[dict] = None
+
+    def _runtime_env(self) -> Optional[dict]:
+        """Prepared once per ActorClass (see RemoteFunction._runtime_env)."""
+        if self._prepared_renv is None:
+            self._prepared_renv = prepare_runtime_env(
+                validate_runtime_env(self._opts.get("runtime_env"))) \
+                or {}
+        return self._prepared_renv or None
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -93,7 +102,7 @@ class ActorClass:
             name=opts.get("name"),
             namespace=opts.get("namespace", "default"),
             lifetime=opts.get("lifetime"),
-            runtime_env=validate_runtime_env(opts.get("runtime_env")),
+            runtime_env=self._runtime_env(),
         )
         _apply_scheduling(spec, opts)
         if ctx.is_driver:

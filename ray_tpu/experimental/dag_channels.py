@@ -83,8 +83,8 @@ class CompiledDAGRef:
     def get(self, timeout: Optional[float] = 30.0):
         if self._consumed:
             raise ValueError("CompiledDAGRef can only be read once")
-        self._consumed = True
         value = self._dag._fetch(self._seq, timeout)
+        self._consumed = True          # only after a successful fetch
         if isinstance(value, _Err):
             raise RuntimeError(f"compiled DAG node failed:\n{value.repr}")
         if isinstance(value, list):
@@ -98,6 +98,11 @@ class CompiledDAGRef:
 class ChannelCompiledDAG:
     """Channel-transport compiled DAG (single InputNode, every actor
     hosts at most one node)."""
+
+    # executes in flight beyond this are drained into the fetched-
+    # results buffer first — each channel slot holds ONE message, so
+    # unbounded in-flight writes would deadlock the input writer
+    MAX_IN_FLIGHT = 2
 
     def __init__(self, output, buffer_size_bytes: int = 1 << 20):
         from ray_tpu.dag import (ClassMethodNode, CompiledDAG, InputNode,
@@ -213,7 +218,15 @@ class ChannelCompiledDAG:
         if len(args) != 1:
             raise TypeError(f"DAG takes exactly 1 input, got {len(args)}")
         with self._lock:
-            self._in_writer.write(args[0])
+            # self-drain: pull finished results into _fetched so the
+            # pipeline's single-slot channels never back up into an
+            # unbounded blocking input write
+            while self._next_seq - self._read_seq >= self.MAX_IN_FLIGHT:
+                outs = [r.read(60.0) for r in self._out_readers]
+                self._fetched[self._read_seq] = (
+                    outs if self._multi else outs[0])
+                self._read_seq += 1
+            self._in_writer.write(args[0], timeout=60.0)
             seq = self._next_seq
             self._next_seq += 1
             self.num_executions += 1

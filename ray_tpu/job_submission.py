@@ -63,6 +63,27 @@ class JobSubmissionClient:
         env.update(renv.get("env_vars") or {})
         env["RAY_TPU_JOB_ID"] = job_id
         cwd = renv.get("working_dir") or None
+        # pip / py_modules for a job (a subprocess on THIS host) become
+        # PYTHONPATH entries: the venv's site-packages materializes via
+        # the per-host cache; py_modules local paths ride directly
+        # (never silently ignore a validated option)
+        extra_paths = []
+        if renv.get("pip"):
+            from ray_tpu._private.runtime_env import ensure_pip_env
+            extra_paths.append(ensure_pip_env(renv["pip"]))
+        for m in renv.get("py_modules") or []:
+            if isinstance(m, str):
+                extra_paths.append(os.path.dirname(os.path.abspath(m))
+                                   if os.path.isfile(m)
+                                   else os.path.dirname(
+                                       os.path.abspath(m.rstrip("/"))))
+            else:
+                raise ValueError(
+                    "job py_modules entries must be local paths")
+        if extra_paths:
+            env["PYTHONPATH"] = os.pathsep.join(
+                extra_paths + [env.get("PYTHONPATH", "")]).rstrip(
+                    os.pathsep)
         info = JobInfo(job_id=job_id, entrypoint=entrypoint,
                        log_path=log_path, metadata=dict(metadata or {}))
         log_f = open(log_path, "wb")

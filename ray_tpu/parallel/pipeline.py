@@ -115,3 +115,129 @@ def pipeline_apply(mesh: Mesh,
     # no-op when the caller already traces.
     out = jax.jit(run)(stacked, micro, tuple(consts))
     return out.reshape(b, *x.shape[1:])
+
+
+def pipeline_grads_1f1b(mesh: Mesh,
+                        stage_fn: Callable[..., jax.Array],
+                        loss_fn: Callable[[jax.Array, jax.Array],
+                                          jax.Array],
+                        layer_params: Any,
+                        x: jax.Array,
+                        targets: jax.Array,
+                        num_microbatches: int,
+                        consts: tuple = ()):
+    """One-forward-one-backward pipeline schedule (the reference's
+    dag_node_operation.py builds exactly this ordering for its NCCL
+    actor pipelines; Narayanan et al. PipeDream-Flush / Megatron-LM).
+
+    Unlike GPipe-then-autodiff — which must keep ALL M microbatch
+    activations live until the loss — the backward of microbatch m
+    starts as soon as its forward leaves the last stage, so each stage
+    stores at most 2(S-1)+1 stage-input activations (a static ring XLA
+    allocates ONCE) independent of M; stage backwards recompute their
+    forward from the saved input (remat), the standard trade.
+
+    Per global tick t (clock-driven SPMD emulation, T = M + 2(S-1)
+    ticks), stage s runs the forward of microbatch t-s and the backward
+    of microbatch t-2(S-1)+s when those indices are in range; the last
+    stage computes the per-microbatch loss + output cotangent in the
+    same tick its forward completes, activations ppermute up the pp
+    ring while cotangents ppermute down.
+
+    Returns (mean loss over all microbatches, grads in the layer-major
+    (L, ...) layout of `layer_params`). stage_fn/loss_fn as in
+    pipeline_apply, with loss_fn(y_microbatch, target_microbatch) ->
+    scalar summed loss for that microbatch.
+    """
+    n_stages = mesh.shape["pp"]
+    if n_stages <= 1:
+        raise ValueError("pipeline_grads_1f1b needs a pp axis > 1")
+    S = n_stages
+    M = num_microbatches
+    b = x.shape[0]
+    if b % M:
+        raise ValueError(f"batch {b} not divisible into {M} microbatches")
+    micro = x.reshape(M, b // M, *x.shape[1:])
+    tmicro = targets.reshape(M, b // M, *targets.shape[1:])
+    stacked = split_stages(layer_params, n_stages)
+    A = min(M, 2 * (S - 1) + 1)       # activation ring slots per stage
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, axis_names={"pp"},
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked),
+                  P(), P(),
+                  jax.tree_util.tree_map(lambda _: P(), tuple(consts))),
+        out_specs=(P(),
+                   jax.tree_util.tree_map(lambda _: P("pp"), stacked)),
+        check_vma=False)
+    def run(stacked_local, micro_local, tmicro_local, consts_local):
+        params_local = jax.tree_util.tree_map(lambda p: p[0],
+                                              stacked_local)
+        stage = lax.axis_index("pp")
+        last = S - 1
+        up = [(i, (i + 1) % S) for i in range(S)]
+        down = [(i, (i - 1) % S) for i in range(S)]
+
+        def fwd_only(p, xx):
+            return stage_fn(p, xx, *consts_local)
+
+        zero_act = jnp.zeros_like(micro_local[0])
+        ring0 = jnp.zeros((A,) + zero_act.shape, zero_act.dtype)
+        grads0 = jax.tree_util.tree_map(jnp.zeros_like, params_local)
+        T = M + 2 * (S - 1)
+
+        def tick(t, carry):
+            fwd_carry, bwd_carry, ring, grads, loss_acc = carry
+            # ---------- forward half-tick
+            m_f = t - stage
+            do_fwd = jnp.logical_and(m_f >= 0, m_f < M)
+            m_f_c = jnp.clip(m_f, 0, M - 1)
+            inject = lax.dynamic_index_in_dim(micro_local, m_f_c, 0,
+                                              keepdims=False)
+            x_in = jnp.where(stage == 0, inject, fwd_carry)
+            y = fwd_only(params_local, x_in)
+            ring = lax.dynamic_update_index_in_dim(
+                ring, jnp.where(do_fwd, x_in, ring[m_f_c % A]),
+                m_f_c % A, 0)
+            # last stage: per-microbatch loss + output cotangent NOW
+            tgt = lax.dynamic_index_in_dim(tmicro_local, m_f_c, 0,
+                                           keepdims=False)
+            loss_m, dLdy = jax.value_and_grad(loss_fn)(y, tgt)
+            take_loss = jnp.logical_and(stage == last, do_fwd)
+            loss_acc = loss_acc + jnp.where(take_loss, loss_m, 0.0)
+            # ---------- backward half-tick
+            m_b = t - 2 * (S - 1) + stage
+            do_bwd = jnp.logical_and(m_b >= 0, m_b < M)
+            m_b_c = jnp.clip(m_b, 0, M - 1)
+            x_saved = lax.dynamic_index_in_dim(ring, m_b_c % A, 0,
+                                               keepdims=False)
+            # last stage consumes its own fresh cotangent (its bwd of m
+            # shares the tick with its fwd of m); others take the grad
+            # arriving from the next stage
+            cot = jnp.where(stage == last, dLdy, bwd_carry)
+            _, vjp = jax.vjp(fwd_only, params_local, x_saved)
+            dparams, dx = vjp(cot)
+            grads = jax.tree_util.tree_map(
+                lambda g, d: g + jnp.where(do_bwd, d, 0.0), grads,
+                dparams)
+            # ---------- communication
+            fwd_carry = lax.ppermute(y, "pp", up)
+            bwd_carry = lax.ppermute(jnp.where(do_bwd, dx,
+                                               jnp.zeros_like(dx)),
+                                     "pp", down)
+            return fwd_carry, bwd_carry, ring, grads, loss_acc
+
+        _, _, _, grads, loss_acc = lax.fori_loop(
+            0, T, tick, (zero_act, zero_act, ring0, grads0,
+                         jnp.zeros((), x.dtype)))
+        # total loss lives on the last stage only; returned loss is the
+        # microbatch mean, so grads scale by 1/M to match d(loss)/dp
+        loss = lax.psum(jnp.where(stage == last, loss_acc, 0.0), "pp")
+        grads = jax.tree_util.tree_map(lambda g: g[None] / M, grads)
+        return loss / M, grads
+
+    loss, stacked_grads = jax.jit(run)(stacked, micro, tmicro,
+                                       tuple(consts))
+    grads = jax.tree_util.tree_map(
+        lambda g, p: g.reshape(p.shape), stacked_grads, layer_params)
+    return loss, grads

@@ -323,7 +323,7 @@ def test_runtime_env_actor_env_vars(ray_cluster):
 
 def test_runtime_env_unsupported_keys_raise(ray_cluster):
     with pytest.raises(ValueError, match="unsupported runtime_env"):
-        ray_tpu.remote(runtime_env={"pip": ["requests"]})(lambda: 1)
+        ray_tpu.remote(runtime_env={"conda": "env.yml"})(lambda: 1)
 
     with pytest.raises(TypeError, match="env_vars"):
         ray_tpu.remote(runtime_env={"env_vars": {"A": 1}})(lambda: 1)

@@ -226,3 +226,42 @@ def test_pipeline_validation_errors():
     with pytest.raises(ValueError, match="microbatches"):
         pipeline_apply(mesh, lambda p, x: x, {"w": jnp.zeros((2, 4))},
                        jnp.zeros((5, 4)), 3)
+
+
+def test_pipeline_1f1b_parity_with_direct_autodiff():
+    """VERDICT r3 item 10 gate: the 1F1B schedule's loss AND grads
+    match plain value_and_grad of the unpipelined stack, across stage
+    counts and microbatch counts (incl. M close to S)."""
+    from ray_tpu.parallel.pipeline import pipeline_grads_1f1b
+    L, D, B = 8, 12, 24
+    kw, kx, kt = jax.random.split(jax.random.PRNGKey(0), 3)
+    params = {"w": jax.random.normal(kw, (L, D, D)) * 0.2,
+              "b": jnp.zeros((L, D))}
+    x = jax.random.normal(kx, (B, D))
+    targets = jax.random.normal(kt, (B, D))
+
+    def stage_fn(p, h):
+        def layer(h, wb):
+            w, b = wb
+            return jnp.tanh(h @ w + b), None
+        h, _ = jax.lax.scan(layer, h, (p["w"], p["b"]))
+        return h
+
+    def loss_fn(y, t):
+        return jnp.sum((y - t) ** 2)
+
+    for S, M in ((2, 8), (4, 8), (4, 4), (8, 4)):
+        def full_loss(p, M=M):
+            y = stage_fn(p, x)
+            return jnp.sum((y - targets) ** 2) / M
+        gt_loss, gt_grads = jax.value_and_grad(full_loss)(params)
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()[:S]).reshape(S), ("pp",))
+        loss, grads = pipeline_grads_1f1b(
+            mesh, stage_fn, loss_fn, params, x, targets, M)
+        np.testing.assert_allclose(float(loss), float(gt_loss),
+                                   rtol=1e-5)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads[k]), np.asarray(gt_grads[k]),
+                rtol=1e-4, atol=1e-6), (S, M, k)

@@ -1128,12 +1128,15 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
          namespace: str = "default",
          ignore_reinit_error: bool = False,
          bind_host: Optional[str] = None,
-         port: Optional[int] = None) -> Runtime:
+         port: Optional[int] = None,
+         address: Optional[str] = None) -> Any:
     """Start the head runtime. With bind_host="0.0.0.0" (or env
     RAY_TPU_BIND_HOST) the listener accepts remote node agents:
     `python -m ray_tpu._private.node_agent --head <host>:<port>` joins
     this cluster over TCP; rt.address carries the (host, port) to hand
-    to agents."""
+    to agents. With address="host:port" this process instead CONNECTS
+    to an existing head as a remote driver (the Ray Client analogue,
+    ray_tpu.util.client)."""
     existing = _context.maybe_ctx()
     if existing is not None:
         if ignore_reinit_error:
@@ -1142,6 +1145,20 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
             raise RuntimeError("ray_tpu.init() called twice; pass "
                                "ignore_reinit_error=True to allow this.")
         return existing  # inside a worker: init is a no-op, like ray.init
+    if address is not None:
+        incompatible = {k: v for k, v in {
+            "num_cpus": num_cpus, "num_tpus": num_tpus,
+            "resources": resources, "max_workers": max_workers,
+            "bind_host": bind_host, "port": port}.items()
+            if v is not None}
+        if namespace != "default":
+            incompatible["namespace"] = namespace
+        if incompatible:
+            raise ValueError(
+                f"init(address=...) connects to an EXISTING head; "
+                f"{sorted(incompatible)} only apply when starting one")
+        from ray_tpu.util.client import connect
+        return connect(address)
     rt = Runtime(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
                  max_workers=max_workers, namespace=namespace,
                  bind_host=bind_host, port=port)
@@ -1151,6 +1168,12 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
 
 def shutdown() -> None:
     ctx = _context.maybe_ctx()
-    if ctx is not None and isinstance(ctx, Runtime):
+    if ctx is None:
+        return
+    if isinstance(ctx, Runtime):
         ctx.shutdown()
         _context.set_ctx(None)
+        return
+    # remote-driver client: disconnect (the head keeps running)
+    if hasattr(ctx, "disconnect"):
+        ctx.disconnect()

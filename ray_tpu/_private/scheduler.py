@@ -353,13 +353,24 @@ class Scheduler:
             self._cv.notify_all()
 
     # ---- dispatch loop ----
+    @staticmethod
+    def _spec_env_hash(spec) -> str:
+        """Cached on the spec: the dispatch loop rescans queued specs
+        every pass and must not re-serialize envs each time."""
+        h = getattr(spec, "_env_hash_cache", None)
+        if h is None:
+            from ray_tpu._private.runtime_env import env_hash
+            h = env_hash(getattr(spec, "runtime_env", None)) or ""
+            try:
+                spec._env_hash_cache = h
+            except AttributeError:
+                pass
+        return h
+
     def _pick_worker(self, spec=None) -> Optional[WorkerRec]:
         """Idle worker, preferring one whose last applied runtime env
         matches the spec's (runtime-env-keyed reuse)."""
-        want = ""
-        if spec is not None:
-            from ray_tpu._private.runtime_env import env_hash
-            want = env_hash(getattr(spec, "runtime_env", None)) or ""
+        want = "" if spec is None else self._spec_env_hash(spec)
         fallback = None
         for rec in self._workers.values():
             if rec.state == IDLE and rec.conn is not None:
@@ -578,9 +589,7 @@ class Scheduler:
             acquire(pool, need)
             worker.acquired = need
             worker.pg_key = pg_key
-            from ray_tpu._private.runtime_env import env_hash as _eh
-            worker.env_hash = _eh(getattr(spec, "runtime_env",
-                                          None)) or ""
+            worker.env_hash = self._spec_env_hash(spec)
             if isinstance(spec, ActorSpec):
                 worker.state = ACTOR
                 worker.actor_id = spec.actor_id

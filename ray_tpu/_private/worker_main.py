@@ -364,6 +364,15 @@ class WorkerExecutor:
         if h == self._cur_env_hash:
             return
         _revert_runtime_env(self._cur_env_undo)
+        # two envs may ship DIFFERENT versions of the same package:
+        # purge modules imported from the reverted paths or the next
+        # env would silently serve stale code
+        for path in self._cur_env_undo.get("paths", []):
+            prefix = os.path.abspath(path) + os.sep
+            for name, mod in list(sys.modules.items()):
+                f = getattr(mod, "__file__", None)
+                if f and os.path.abspath(f).startswith(prefix):
+                    del sys.modules[name]
         self._cur_env_undo = {"env": {}, "cwd": None, "paths": []}
         self._cur_env_hash = None
         self._cur_env_undo = _apply_runtime_env(
@@ -410,8 +419,7 @@ class WorkerExecutor:
     def _create_actor(self, spec: ActorSpec) -> None:
         try:
             # permanent: this worker is dedicated to the actor for life
-            _apply_runtime_env(getattr(spec, "runtime_env", None),
-                               kv_get=lambda k: self.ctx.kv_op("get", k))
+            self._switch_runtime_env(getattr(spec, "runtime_env", None))
             cls = self._load_function(spec.class_id)
             args, kwargs = self._resolve_args(spec.init_args,
                                               spec.init_kwargs)

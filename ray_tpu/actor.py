@@ -50,15 +50,18 @@ class ActorClass:
         validate_runtime_env(self._opts.get("runtime_env"))
         self._pickled: Optional[bytes] = None
         self._class_id: Optional[str] = None
-        self._prepared_renv: Optional[dict] = None
+        self._prepared_renv: Optional[tuple] = None   # (ctx_id, env)
 
     def _runtime_env(self) -> Optional[dict]:
-        """Prepared once per ActorClass (see RemoteFunction._runtime_env)."""
-        if self._prepared_renv is None:
-            self._prepared_renv = prepare_runtime_env(
-                validate_runtime_env(self._opts.get("runtime_env"))) \
-                or {}
-        return self._prepared_renv or None
+        """Prepared once per ActorClass per runtime (see
+        RemoteFunction._runtime_env)."""
+        ctx_id = id(_context.get_ctx())
+        if self._prepared_renv is None or \
+                self._prepared_renv[0] != ctx_id:
+            self._prepared_renv = (ctx_id, prepare_runtime_env(
+                validate_runtime_env(self._opts.get("runtime_env")))
+                or {})
+        return self._prepared_renv[1] or None
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

@@ -135,18 +135,21 @@ class RemoteFunction:
         self._pickled: Optional[bytes] = None
         self._func_id: Optional[str] = None
         self._registered_in: set[int] = set()
-        self._prepared_renv: Optional[dict] = None
+        self._prepared_renv: Optional[tuple] = None   # (ctx_id, env)
 
     def _runtime_env(self) -> Optional[dict]:
-        """Validated + uploaded runtime env, prepared ONCE per handle —
-        re-zipping py_modules on every .remote() call would collapse
-        submission throughput (directory content is snapshotted at
-        first call)."""
-        if self._prepared_renv is None:
-            self._prepared_renv = prepare_runtime_env(
-                validate_runtime_env(self._opts.get("runtime_env"))) \
-                or {}
-        return self._prepared_renv or None
+        """Validated + uploaded runtime env, prepared ONCE per handle
+        PER RUNTIME — re-zipping py_modules on every .remote() call
+        would collapse submission throughput, but the KV upload only
+        lives as long as one cluster (same per-runtime keying as
+        function registration)."""
+        ctx_id = id(_context.get_ctx())
+        if self._prepared_renv is None or \
+                self._prepared_renv[0] != ctx_id:
+            self._prepared_renv = (ctx_id, prepare_runtime_env(
+                validate_runtime_env(self._opts.get("runtime_env")))
+                or {})
+        return self._prepared_renv[1] or None
 
     def _ensure_pickled(self):
         if self._pickled is None:

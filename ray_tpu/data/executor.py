@@ -53,25 +53,8 @@ class ClassSpec:
     those modules."""
 
     def __init__(self, cls: type):
-        import os
-        import sys
-
-        import cloudpickle
-        mod = sys.modules.get(cls.__module__)
-        f = getattr(mod, "__file__", None) if mod else None
-        library = f and (f.startswith(sys.prefix)
-                         or "site-packages" in f
-                         or "/ray_tpu/" in f.replace(os.sep, "/"))
-        if mod is None or cls.__module__ == "__main__" or library:
-            self.data = cloudpickle.dumps(cls)
-        else:
-            # driver-local module (script/test file): capture by value so
-            # workers need not import it
-            cloudpickle.register_pickle_by_value(mod)
-            try:
-                self.data = cloudpickle.dumps(cls)
-            finally:
-                cloudpickle.unregister_pickle_by_value(mod)
+        from ray_tpu._private.pickle_utils import dumps_by_value
+        self.data = dumps_by_value(cls)
         self.qualname = cls.__qualname__
 
     def load(self) -> type:

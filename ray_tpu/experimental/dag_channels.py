@@ -207,6 +207,7 @@ class ChannelCompiledDAG:
         self._lock = threading.Lock()
         self._next_seq = 0
         self._fetched: Dict[int, Any] = {}
+        self._partial_row: List[Any] = []
         self._read_seq = 0
         self.num_executions = 0
         self._torn_down = False
@@ -222,7 +223,10 @@ class ChannelCompiledDAG:
             # pipeline's single-slot channels never back up into an
             # unbounded blocking input write
             while self._next_seq - self._read_seq >= self.MAX_IN_FLIGHT:
-                outs = [r.read(60.0) for r in self._out_readers]
+                while len(self._partial_row) < len(self._out_readers):
+                    r = self._out_readers[len(self._partial_row)]
+                    self._partial_row.append(r.read(60.0))
+                outs, self._partial_row = self._partial_row, []
                 self._fetched[self._read_seq] = (
                     outs if self._multi else outs[0])
                 self._read_seq += 1
@@ -235,7 +239,13 @@ class ChannelCompiledDAG:
     def _fetch(self, seq: int, timeout: Optional[float]):
         with self._lock:
             while self._read_seq <= seq:
-                outs = [r.read(timeout) for r in self._out_readers]
+                # _partial_row survives a timeout mid-row: each reader's
+                # read consumes its single slot, so a retry must RESUME
+                # at the first unread output, never re-read consumed ones
+                while len(self._partial_row) < len(self._out_readers):
+                    r = self._out_readers[len(self._partial_row)]
+                    self._partial_row.append(r.read(timeout))
+                outs, self._partial_row = self._partial_row, []
                 self._fetched[self._read_seq] = (
                     outs if self._multi else outs[0])
                 self._read_seq += 1

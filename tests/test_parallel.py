@@ -264,4 +264,4 @@ def test_pipeline_1f1b_parity_with_direct_autodiff():
         for k in ("w", "b"):
             np.testing.assert_allclose(
                 np.asarray(grads[k]), np.asarray(gt_grads[k]),
-                rtol=1e-4, atol=1e-6), (S, M, k)
+                rtol=1e-4, atol=1e-6, err_msg=f"S={S} M={M} leaf={k}")

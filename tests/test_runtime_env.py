@@ -130,3 +130,50 @@ def test_worker_reuse_keyed_by_env_hash(ray_cluster, tmp_path):
     # and a no-env task on that worker must NOT see either env var
     plain = ray_tpu.get(probe.remote(), timeout=60)
     assert plain[1] is None
+
+
+def test_env_switch_purges_stale_modules(ray_cluster, tmp_path):
+    """Two envs shipping DIFFERENT versions of the same package: a
+    reused worker must never serve the old version (review regression:
+    sys.modules survived the env switch)."""
+    for v in (1, 2):
+        d = tmp_path / f"v{v}" / "dupmod"
+        d.mkdir(parents=True)
+        (d / "__init__.py").write_text(f"VERSION = {v}\n")
+
+    def read_version():
+        import dupmod
+        return dupmod.VERSION
+
+    f1 = ray_tpu.remote(runtime_env={
+        "py_modules": [str(tmp_path / "v1" / "dupmod")]})(read_version)
+    f2 = ray_tpu.remote(runtime_env={
+        "py_modules": [str(tmp_path / "v2" / "dupmod")]})(read_version)
+    # interleave so worker reuse across envs is likely
+    for _ in range(3):
+        assert ray_tpu.get(f1.remote(), timeout=60) == 1
+        assert ray_tpu.get(f2.remote(), timeout=60) == 2
+
+
+def test_actor_does_not_inherit_previous_task_env(ray_cluster):
+    """Review regression: a pooled worker's still-applied task env must
+    not leak into an actor created on it."""
+    @ray_tpu.remote
+    def set_env_task():
+        return os.environ.get("LEAKY_VAR")
+
+    tagged = ray_tpu.remote(
+        runtime_env={"env_vars": {"LEAKY_VAR": "leaked"}})(
+            set_env_task._fn)
+    assert ray_tpu.get(tagged.remote(), timeout=60) == "leaked"
+
+    @ray_tpu.remote
+    class Plain:
+        def leak(self):
+            return os.environ.get("LEAKY_VAR")
+
+    # several attempts so one lands on the tainted pooled worker
+    for _ in range(3):
+        a = Plain.remote()
+        assert ray_tpu.get(a.leak.remote(), timeout=60) is None
+        ray_tpu.kill(a)

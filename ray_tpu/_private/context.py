@@ -55,8 +55,17 @@ class BaseContext:
         raise NotImplementedError
 
 
+_ctx_epoch = 0
+
+
 def set_ctx(ctx: Optional[BaseContext]) -> None:
-    global _ctx
+    global _ctx, _ctx_epoch
+    if ctx is not None and not hasattr(ctx, "ctx_epoch"):
+        # monotonic context identity: id() of a new Runtime can collide
+        # with a freed one's address, so per-runtime caches (prepared
+        # runtime envs, function registration) key on this instead
+        _ctx_epoch += 1
+        ctx.ctx_epoch = _ctx_epoch
     _ctx = ctx
 
 

@@ -55,7 +55,8 @@ class ActorClass:
     def _runtime_env(self) -> Optional[dict]:
         """Prepared once per ActorClass per runtime (see
         RemoteFunction._runtime_env)."""
-        ctx_id = id(_context.get_ctx())
+        ctx = _context.get_ctx()
+        ctx_id = getattr(ctx, "ctx_epoch", id(ctx))
         if self._prepared_renv is None or \
                 self._prepared_renv[0] != ctx_id:
             self._prepared_renv = (ctx_id, prepare_runtime_env(

@@ -143,7 +143,8 @@ class RemoteFunction:
         would collapse submission throughput, but the KV upload only
         lives as long as one cluster (same per-runtime keying as
         function registration)."""
-        ctx_id = id(_context.get_ctx())
+        ctx = _context.get_ctx()
+        ctx_id = getattr(ctx, "ctx_epoch", id(ctx))
         if self._prepared_renv is None or \
                 self._prepared_renv[0] != ctx_id:
             self._prepared_renv = (ctx_id, prepare_runtime_env(

@@ -6,7 +6,10 @@ the state API + metrics registry. Endpoints:
 
   GET /api/nodes /api/actors /api/tasks /api/placement_groups
   GET /api/cluster      (total/available resources + object store)
-  GET /api/task_summary
+  GET /api/task_summary /api/actor_summary
+  GET /api/jobs         (submitted jobs, reference modules/job)
+  GET /api/logs         (available job log files)
+  GET /api/logs/<job>   (tail of one job's log; ?lines=N)
   GET /metrics          (Prometheus exposition of util.metrics)
   GET /                 (HTML tables auto-refreshing off the JSON API)
 """
@@ -59,6 +62,26 @@ def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> int:
     from ray_tpu.util.metrics import DEFAULT_REGISTRY
 
     def api(path: str):
+        from urllib.parse import parse_qs, urlsplit
+        url = urlsplit(path)
+        path, query = url.path, parse_qs(url.query)
+        if path.startswith("logs"):
+            from ray_tpu.job_submission import default_client
+            client = default_client()
+            parts = path.split("/", 1)
+            if len(parts) == 1 or not parts[1]:
+                return client.list_log_files()
+            lines = int(query.get("lines", ["200"])[0])
+            return {"job_id": parts[1],
+                    "lines": client.tail_logs(parts[1], lines)}
+        if path == "jobs":
+            import dataclasses as _dc
+
+            from ray_tpu.job_submission import default_client
+            return [_dc.asdict(j) for j in
+                    default_client().list_jobs()]
+        if path == "actor_summary":
+            return state_api.summarize_actors()
         if path == "nodes":
             return state_api.list_nodes()
         if path == "actors":

@@ -15,7 +15,7 @@ import tempfile
 import threading
 import time
 import uuid
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
@@ -127,6 +127,23 @@ class JobSubmissionClient:
         with self._lock:
             return list(self._jobs.values())
 
+    def list_log_files(self) -> List[Dict[str, Any]]:
+        """Log files in this client's log dir (dashboard /api/logs)."""
+        out = []
+        for info in self.list_jobs():
+            try:
+                size = os.path.getsize(info.log_path)
+            except OSError:
+                size = 0
+            out.append({"job_id": info.job_id, "path": info.log_path,
+                        "size_bytes": size, "status": info.status})
+        return out
+
+    def tail_logs(self, job_id: str, lines: int = 200) -> List[str]:
+        """Last N lines of a job's log (dashboard /api/logs/<job>)."""
+        text = self.get_job_logs(job_id)
+        return text.splitlines()[-max(1, lines):]
+
     def stop_job(self, job_id: str) -> bool:
         info = self._info(job_id)
         proc = self._procs.get(job_id)
@@ -160,3 +177,15 @@ class JobSubmissionClient:
         if info is None:
             raise ValueError(f"no job {job_id!r}")
         return info
+
+
+_DEFAULT_CLIENT = None
+
+
+def default_client() -> "JobSubmissionClient":
+    """Process-wide client (the dashboard's job/log endpoints use it, so
+    jobs submitted through it are the ones observability surfaces)."""
+    global _DEFAULT_CLIENT
+    if _DEFAULT_CLIENT is None:
+        _DEFAULT_CLIENT = JobSubmissionClient()
+    return _DEFAULT_CLIENT

@@ -26,39 +26,62 @@ from ray_tpu.rllib.utils.schedules import LinearSchedule
 # ------------------------------------------------------------ q module
 @dataclasses.dataclass(frozen=True)
 class QModule:
-    """MLP Q-network: obs -> Q(s, ·)."""
+    """MLP Q-network: obs -> Q(s, ·). With dueling=True the torso feeds
+    separate value/advantage heads combined as V + A - mean(A)
+    (reference dqn rainbow dueling architecture)."""
 
     obs_dim: int
     num_actions: int
     hidden: Sequence[int] = (64, 64)
+    dueling: bool = False
+
+    def _dense(self, key, din, dout, scale):
+        w = jax.random.orthogonal(key, max(din, dout))[:din, :dout]
+        return {"w": (w * scale).astype(jnp.float32),
+                "b": jnp.zeros((dout,), jnp.float32)}
 
     def init(self, key: jax.Array) -> dict:
-        keys = jax.random.split(key, len(self.hidden) + 1)
+        keys = jax.random.split(key, len(self.hidden) + 3)
         ki = iter(keys)
         layers = []
         din = self.obs_dim
         for h in self.hidden:
-            w = jax.random.orthogonal(next(ki), max(din, h))[:din, :h]
-            layers.append({"w": (w * jnp.sqrt(2.0)).astype(jnp.float32),
-                           "b": jnp.zeros((h,), jnp.float32)})
+            layers.append(self._dense(next(ki), din, h, jnp.sqrt(2.0)))
             din = h
-        w = jax.random.orthogonal(next(ki),
-                                  max(din, self.num_actions))[
-            :din, :self.num_actions]
-        layers.append({"w": (w * 0.01).astype(jnp.float32),
-                       "b": jnp.zeros((self.num_actions,), jnp.float32)})
+        if self.dueling:
+            return {"q": layers,
+                    "adv": [self._dense(next(ki), din,
+                                        self.num_actions, 0.01)],
+                    "val": [self._dense(next(ki), din, 1, 1.0)]}
+        layers.append(self._dense(next(ki), din, self.num_actions, 0.01))
         return {"q": layers}
 
     @staticmethod
-    def forward(params: dict, obs) -> jax.Array:
+    def _torso_np(layers, x, lib):
+        for layer in layers:
+            x = lib.tanh(x @ layer["w"] + layer["b"])
+        return x
+
+    def forward(self, params: dict, obs) -> jax.Array:
+        if self.dueling:
+            h = self._torso_np(params["q"], obs, jnp)
+            a = h @ params["adv"][0]["w"] + params["adv"][0]["b"]
+            v = h @ params["val"][0]["w"] + params["val"][0]["b"]
+            return v + a - jnp.mean(a, axis=-1, keepdims=True)
         x = obs
         for layer in params["q"][:-1]:
             x = jnp.tanh(x @ layer["w"] + layer["b"])
         last = params["q"][-1]
         return x @ last["w"] + last["b"]
 
-    @staticmethod
-    def forward_np(params_np: dict, obs) -> np.ndarray:
+    def forward_np(self, params_np: dict, obs) -> np.ndarray:
+        if self.dueling:
+            class _np_lib:
+                tanh = staticmethod(np.tanh)
+            h = self._torso_np(params_np["q"], obs, _np_lib)
+            a = h @ params_np["adv"][0]["w"] + params_np["adv"][0]["b"]
+            v = h @ params_np["val"][0]["w"] + params_np["val"][0]["b"]
+            return v + a - a.mean(axis=-1, keepdims=True)
         x = obs
         for layer in params_np["q"][:-1]:
             x = np.tanh(x @ layer["w"] + layer["b"])
@@ -85,7 +108,13 @@ class QEnvRunner:
             raise ValueError("DQN needs a discrete action space")
         self.module = QModule(
             int(np.prod(self._envs.single_observation_space.shape)),
-            int(space.n), tuple(config.hidden))
+            int(space.n), tuple(config.hidden),
+            dueling=config.dueling)
+        # n-step returns: per-env pending transition windows (reference
+        # rainbow n_step; horizon shortens at episode end)
+        self._nstep = max(1, int(config.n_step))
+        self._pending = [[] for _ in
+                         range(config.num_envs_per_env_runner)]
         self.params = jax.tree_util.tree_map(
             np.asarray, self.module.init(jax.random.PRNGKey(seed)))
         self._rng = np.random.default_rng(seed + 1)
@@ -104,9 +133,30 @@ class QEnvRunner:
     def set_weights(self, weights) -> None:
         self.params = jax.tree_util.tree_map(np.asarray, weights)
 
+    def _emit_nstep(self, rows, env_i: int, flush: bool) -> None:
+        """Pop matured windows: (s0, a0, sum gamma^k r_k, s_h, term_h,
+        horizon h). On flush (episode boundary) every remaining entry
+        emits with its shortened horizon."""
+        g = self.config.gamma
+        buf = self._pending[env_i]
+        while buf and (flush or len(buf) >= self._nstep):
+            horizon = min(len(buf), self._nstep)
+            R = 0.0
+            for k in range(horizon):
+                R += (g ** k) * buf[k][2]
+            o0, a0 = buf[0][0], buf[0][1]
+            nobs_h, term_h = buf[horizon - 1][3], buf[horizon - 1][4]
+            rows["obs"].append(o0)
+            rows["actions"].append(a0)
+            rows["rewards"].append(np.float32(R))
+            rows["new_obs"].append(nobs_h)
+            rows["terminateds"].append(np.float32(term_h))
+            rows["nsteps"].append(np.float32(horizon))
+            buf.pop(0)
+
     def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
         rows = {k: [] for k in ("obs", "actions", "rewards", "new_obs",
-                                "terminateds")}
+                                "terminateds", "nsteps")}
         N = self.config.num_envs_per_env_runner
         for _ in range(num_steps):
             q = self.module.forward_np(self.params,
@@ -119,11 +169,12 @@ class QEnvRunner:
             nobs, reward, term, trunc, _ = self._envs.step(action)
             done = term | trunc
             valid = ~self._prev_done     # autoreset filler: drop
-            rows["obs"].append(self._obs[valid].astype(np.float32))
-            rows["actions"].append(action[valid])
-            rows["rewards"].append(reward[valid].astype(np.float32))
-            rows["new_obs"].append(nobs[valid].astype(np.float32))
-            rows["terminateds"].append(term[valid].astype(np.float32))
+            for i in np.nonzero(valid)[0]:
+                self._pending[i].append(
+                    (self._obs[i].astype(np.float32),
+                     np.int32(action[i]), float(reward[i]),
+                     nobs[i].astype(np.float32), bool(term[i])))
+                self._emit_nstep(rows, i, flush=bool(done[i]))
             self._ep_ret[valid] += reward[valid]
             for i in np.nonzero(done & valid)[0]:
                 self._recent.append(float(self._ep_ret[i]))
@@ -132,7 +183,15 @@ class QEnvRunner:
             self._prev_done = done
             self._obs = nobs
             self._steps += N
-        return {k: np.concatenate(v) for k, v in rows.items()}
+        if not rows["rewards"]:
+            obs_shape = self._obs.shape[1:]
+            return {"obs": np.empty((0,) + obs_shape, np.float32),
+                    "actions": np.empty((0,), np.int32),
+                    "rewards": np.empty((0,), np.float32),
+                    "new_obs": np.empty((0,) + obs_shape, np.float32),
+                    "terminateds": np.empty((0,), np.float32),
+                    "nsteps": np.empty((0,), np.float32)}
+        return {k: np.stack(v) for k, v in rows.items()}
 
     def get_metrics(self) -> Dict[str, Any]:
         return {"episode_return_mean": (float(np.mean(self._recent))
@@ -160,6 +219,8 @@ class DQNConfig:
     num_updates_per_iteration: int = 16
     learning_starts: int = 500            # env steps before updates
     target_network_update_freq: int = 100  # in updates
+    dueling: bool = False                  # V + A - mean(A) heads
+    n_step: int = 1                        # multi-step TD returns
     initial_epsilon: float = 1.0
     final_epsilon: float = 0.02
     epsilon_timesteps: int = 10_000
@@ -200,8 +261,8 @@ class DQN:
                              for i in range(c.num_env_runners)]
             self._remote = True
         self.module = (self._runners[0].module if not self._remote
-                       else QModule(*self._probe_dims(),
-                                    tuple(c.hidden)))
+                       else QModule(*self._probe_dims(), tuple(c.hidden),
+                                    dueling=c.dueling))
         self.params = self.module.init(jax.random.PRNGKey(c.seed))
         self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
         self._tx = optax.adam(c.lr)
@@ -241,8 +302,12 @@ class DQN:
                     q_next_target, a_star[:, None], axis=-1)[:, 0]
             else:
                 q_next = jnp.max(q_next_target, axis=-1)
+            # n-step bootstrap: reward already sums gamma^k r_k over
+            # the window; discount the tail by gamma^horizon
+            g_eff = c.gamma ** batch.get(
+                "nsteps", jnp.ones_like(batch["rewards"]))
             target = (batch["rewards"]
-                      + c.gamma * (1.0 - batch["terminateds"])
+                      + g_eff * (1.0 - batch["terminateds"])
                       * jax.lax.stop_gradient(q_next))
             td = q_sa - target
             w = batch.get("weights", jnp.ones_like(td))
@@ -278,8 +343,9 @@ class DQN:
             batches = [self._runners[0].sample(
                 c.rollout_steps_per_iteration)]
         for b in batches:
-            self.buffer.add(b)
-            self._total_steps += len(b["rewards"])
+            if len(b["rewards"]):
+                self.buffer.add(b)
+                self._total_steps += len(b["rewards"])
 
         loss = float("nan")
         if self._total_steps >= c.learning_starts:

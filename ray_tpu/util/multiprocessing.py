@@ -199,8 +199,18 @@ class Pool:
         self._actors = []
 
     def join(self) -> None:
+        """Wait for in-flight work (close+join returns results like the
+        stdlib contract), then release the actors."""
         if not self._closed:
             raise ValueError("Pool is still running")
+        for a in self._actors:
+            try:
+                # ordered actor queues: a no-op completes only after
+                # every previously submitted chunk
+                ray_tpu.get(a.run_one.remote(
+                    cloudpickle.dumps(lambda: None), (), {}))
+            except BaseException:
+                pass
         self.terminate()
 
     def __enter__(self) -> "Pool":

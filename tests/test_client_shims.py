@@ -125,3 +125,20 @@ def test_joblib_backend(ray_cluster):
         out = joblib.Parallel()(
             joblib.delayed(_sq)(i) for i in range(8))
     assert out == [i * i for i in range(8)]
+
+
+def _slowsq(x):
+    import time as _t
+    _t.sleep(0.3)
+    return x * x
+
+
+def test_pool_close_join_returns_inflight_results(ray_cluster):
+    """stdlib contract: close() + join() lets pending work finish, so a
+    prior map_async still yields its results."""
+    from ray_tpu.util.multiprocessing import Pool
+    p = Pool(processes=2)
+    ar = p.map_async(_slowsq, range(6))
+    p.close()
+    p.join()
+    assert ar.get(timeout=60) == [x * x for x in range(6)]

@@ -144,3 +144,50 @@ def test_tracing_profile_and_annotate(tmp_path):
         found += [f for f in files if "trace" in f or f.endswith(".pb")
                   or f.endswith(".json.gz")]
     assert found, f"no trace files under {logdir}"
+
+
+def test_marwil_beats_noisy_dataset(tmp_path):
+    """MARWIL's advantage weighting upweights the expert's actions in a
+    MIXED dataset (50% random actions) where plain BC would clone the
+    noise too (reference marwil learning tests)."""
+    from ray_tpu.rllib.offline import MARWILConfig, record_transitions
+    rng = np.random.default_rng(0)
+
+    def noisy_expert(obs):
+        a = _heuristic_cartpole_policy(obs)
+        flip = rng.random(len(a)) < 0.5
+        return np.where(flip, rng.integers(0, 2, len(a)), a).astype(
+            np.int32)
+
+    path = record_transitions("CartPole-v1", noisy_expert,
+                              str(tmp_path / "mixed"),
+                              num_steps=6000, seed=2)
+    algo = (MARWILConfig().environment("CartPole-v1")
+            .offline_data(path)
+            .training(beta=2.0, num_batches_per_iteration=60,
+                      seed=0).build())
+    for _ in range(10):
+        m = algo.train()
+    assert np.isfinite(m["marwil_loss"])
+    ev = algo.evaluate(num_episodes=5)
+    # random policy gets ~20; cloning 50%-noise data ~50-80; the
+    # advantage weight must recover clearly better behavior
+    assert ev["episode_return_mean"] >= 100, ev
+
+
+def test_cql_learns_from_offline_data(tmp_path):
+    """Discrete CQL: TD + conservative penalty trains a usable greedy
+    policy from recorded data (reference cql learning tests)."""
+    from ray_tpu.rllib.offline import CQLConfig, record_transitions
+    path = record_transitions("CartPole-v1",
+                              _heuristic_cartpole_policy,
+                              str(tmp_path / "expert_cql"),
+                              num_steps=6000, seed=3)
+    algo = (CQLConfig().environment("CartPole-v1")
+            .offline_data(path)
+            .training(num_batches_per_iteration=60, seed=0).build())
+    for _ in range(10):
+        m = algo.train()
+    assert np.isfinite(m["td_loss"]) and np.isfinite(m["cql_loss"])
+    ev = algo.evaluate(num_episodes=5)
+    assert ev["episode_return_mean"] >= 100, ev

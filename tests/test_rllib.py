@@ -662,3 +662,38 @@ def test_multi_agent_ppo_two_policies_learn():
             break
     algo.stop()
     assert min(best.values()) > 120, best
+
+
+def test_dqn_dueling_and_nstep_shapes():
+    """Dueling head: Q = V + A - mean(A) (mean-zero advantage); n-step
+    runner rows carry shortened horizons at episode ends."""
+    import jax
+
+    from ray_tpu.rllib.algorithms.dqn import DQNConfig, QEnvRunner, QModule
+    m = QModule(4, 2, (16,), dueling=True)
+    p = m.init(jax.random.PRNGKey(0))
+    obs = np.ones((3, 4), np.float32)
+    q = np.asarray(m.forward(p, obs))
+    np.testing.assert_allclose(q, m.forward_np(
+        jax.tree_util.tree_map(np.asarray, p), obs), rtol=1e-5)
+    # V + A - mean(A): recenter check — subtracting the action-mean of
+    # Q recovers the advantage's mean-zero structure
+    a_centered = q - q.mean(-1, keepdims=True)
+    assert np.allclose(a_centered.mean(-1), 0.0, atol=1e-6)
+
+    cfg = DQNConfig().training(n_step=3, num_envs_per_env_runner=4,
+                               seed=0)
+    runner = QEnvRunner(cfg)
+    batch = runner.sample(40)
+    assert set(batch) >= {"obs", "actions", "rewards", "new_obs",
+                          "terminateds", "nsteps"}
+    ns = batch["nsteps"]
+    assert ns.max() == 3
+    assert ((ns == 1) | (ns == 2) | (ns == 3)).all()
+    # shortened horizons exist only at episode boundaries: every such
+    # row's window reaches the episode's final transition, which (in
+    # short CartPole episodes, no truncation) is a termination
+    short = ns < 3
+    assert short.any()
+    assert (batch["terminateds"][short] == 1.0).all()
+    runner.stop()

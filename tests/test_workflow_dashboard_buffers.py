@@ -158,3 +158,56 @@ def test_schedules():
     pw = PiecewiseSchedule([(0, 1.0), (10, 0.5), (20, 0.0)])
     assert pw(-5) == 1.0 and pw(5) == 0.75 and pw(15) == 0.25
     assert pw(99) == 0.0
+
+
+def test_state_api_filters_and_getters(ray_cluster):
+    from ray_tpu.util import state as st
+
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "ok"
+
+    a = Pinger.options(name="filter_target").remote()
+    ray_tpu.get(a.ping.remote())
+    alive = st.list_actors(filters=[("state", "=", "ALIVE")])
+    assert any(x.get("name") == "filter_target" for x in alive)
+    assert st.list_actors(filters=[("state", "=", "NOPE")]) == []
+    # contains + getter round-trip
+    hit = st.list_actors(filters=[("name", "contains", "filter_t")])
+    assert len(hit) == 1
+    got = st.get_actor(hit[0]["actor_id"])
+    assert got and got["name"] == "filter_target"
+    with pytest.raises(ValueError, match="unknown filter op"):
+        st.list_actors(filters=[("state", "~", "x")])
+    summary = st.summarize_actors()
+    assert summary.get("ALIVE", 0) >= 1
+    ray_tpu.kill(a)
+
+
+def test_dashboard_jobs_and_logs_endpoints(ray_cluster):
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+    from ray_tpu.job_submission import default_client
+
+    client = default_client()
+    jid = client.submit_job(
+        entrypoint="python -c \"print('hello-from-job')\"")
+    client.wait_until_finished(jid, timeout=60)
+    port = start_dashboard(port=0)
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+                return _json.loads(r.read())
+        jobs = get("/api/jobs")
+        assert any(j["job_id"] == jid for j in jobs)
+        logs = get("/api/logs")
+        assert any(l["job_id"] == jid for l in logs)
+        tail = get(f"/api/logs/{jid}?lines=10")
+        assert "hello-from-job" in "\n".join(tail["lines"])
+        assert isinstance(get("/api/actor_summary"), dict)
+    finally:
+        stop_dashboard()

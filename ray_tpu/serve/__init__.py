@@ -611,6 +611,9 @@ def start_http(port: int = 8000, host: str = "127.0.0.1") -> int:
     import json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+    if _HTTP_SERVER is not None:
+        stop_http()          # never orphan a running ingress
+
     handles: Dict[str, DeploymentHandle] = {}
 
     class Ingress(BaseHTTPRequestHandler):
@@ -678,3 +681,92 @@ def stop_http() -> None:
     if _HTTP_SERVER is not None:
         _HTTP_SERVER.shutdown()
         _HTTP_SERVER = None
+
+
+# -------------------------------------------------------- grpc ingress
+_GRPC_SERVER = None
+
+
+def start_grpc(port: int = 9000, host: str = "127.0.0.1",
+               max_workers: int = 8) -> int:
+    """gRPC ingress (reference _private/grpc_util / proxy gRPC mode),
+    codegen-free: a generic handler registers two JSON-over-bytes
+    methods —
+
+      /ray_tpu.serve/Call    unary-unary   {"deployment", "method",
+                                            "args", "kwargs"} -> result
+      /ray_tpu.serve/Stream  unary-stream  same request; one JSON chunk
+                                            per generator yield
+
+    Clients call via grpc.insecure_channel with json (de)serializers;
+    no .proto compilation needed on either side."""
+    global _GRPC_SERVER
+    import json
+    from concurrent import futures
+
+    import grpc
+
+    handles: Dict[str, DeploymentHandle] = {}
+
+    def _handle(name: str) -> DeploymentHandle:
+        if name not in handles:
+            handles[name] = get_handle(name)
+        return handles[name]
+
+    def call(request: bytes, context) -> bytes:
+        req = json.loads(request or b"{}")
+        try:
+            h = _handle(req["deployment"])
+            result = ray_tpu.get(
+                h.method(req.get("method", "__call__"),
+                         *req.get("args", []), **req.get("kwargs", {})),
+                timeout=req.get("timeout_s", 60))
+            return json.dumps({"result": result}).encode()
+        except BaseException as e:  # noqa: BLE001
+            context.set_code(grpc.StatusCode.INTERNAL)
+            context.set_details(repr(e))
+            return json.dumps({"error": repr(e)}).encode()
+
+    def stream(request: bytes, context):
+        req = json.loads(request or b"{}")
+        try:
+            h = _handle(req["deployment"])
+            for chunk in h.stream(*req.get("args", []),
+                                  method_name=req.get("method",
+                                                      "__call__"),
+                                  **req.get("kwargs", {})):
+                yield json.dumps({"chunk": chunk}).encode()
+        except (GeneratorExit, KeyboardInterrupt, SystemExit):
+            raise          # client cancelled / teardown: close cleanly
+        except BaseException as e:  # noqa: BLE001
+            # one consistent error channel: the trailing status (no
+            # in-band error chunk a client would misparse)
+            context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+    ident = lambda b: b
+    handler = grpc.method_handlers_generic_handler(
+        "ray_tpu.serve",
+        {"Call": grpc.unary_unary_rpc_method_handler(
+            call, request_deserializer=ident, response_serializer=ident),
+         "Stream": grpc.unary_stream_rpc_method_handler(
+            stream, request_deserializer=ident,
+            response_serializer=ident)})
+    if _GRPC_SERVER is not None:
+        stop_grpc()          # never orphan a running ingress
+    server = grpc.server(futures.ThreadPoolExecutor(
+        max_workers=max_workers))
+    server.add_generic_rpc_handlers((handler,))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        server.stop(None)
+        raise OSError(f"could not bind gRPC ingress to {host}:{port}")
+    server.start()
+    _GRPC_SERVER = server
+    return bound
+
+
+def stop_grpc() -> None:
+    global _GRPC_SERVER
+    if _GRPC_SERVER is not None:
+        _GRPC_SERVER.stop(grace=2)
+        _GRPC_SERVER = None

@@ -193,3 +193,45 @@ def test_serve_streaming_http(serve_shutdown):
         assert [c["chunk"]["i"] for c in lines] == [0, 1, 2, 3]
     finally:
         serve.stop_http()
+
+
+def test_serve_grpc_ingress(serve_shutdown):
+    """gRPC ingress: unary call + server-streaming over the generic
+    JSON-over-bytes methods (reference gRPC proxy mode)."""
+    grpc = pytest.importorskip("grpc")
+
+    @serve.deployment(num_replicas=1)
+    class Summer:
+        def __call__(self, a, b):
+            return a + b
+
+        def toks(self, text):
+            for w in str(text).split():
+                yield w.upper()
+
+    serve.run(Summer.bind(), name="summer")
+    port = serve.start_grpc(port=0)
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        call = ch.unary_unary(
+            "/ray_tpu.serve/Call",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: json.loads(b))
+        out = call(json.dumps({"deployment": "summer",
+                               "args": [19, 23]}).encode(), timeout=60)
+        assert out["result"] == 42
+        stream = ch.unary_stream(
+            "/ray_tpu.serve/Stream",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: json.loads(b))
+        chunks = [c["chunk"] for c in stream(
+            json.dumps({"deployment": "summer", "method": "toks",
+                        "args": ["one two three"]}).encode(),
+            timeout=60)]
+        assert chunks == ["ONE", "TWO", "THREE"]
+        # errors surface as gRPC status
+        with pytest.raises(grpc.RpcError):
+            call(json.dumps({"deployment": "nope"}).encode(), timeout=30)
+        ch.close()
+    finally:
+        serve.stop_grpc()

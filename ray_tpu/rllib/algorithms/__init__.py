@@ -1,3 +1,5 @@
+from ray_tpu.rllib.algorithms.appo import (APPO, APPOConfig,
+                                            APPOLearner)
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, QModule
 from ray_tpu.rllib.algorithms.impala import (IMPALA, IMPALAConfig,
                                              IMPALALearner,
@@ -6,6 +8,7 @@ from ray_tpu.rllib.algorithms.impala import (IMPALA, IMPALAConfig,
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACModule
 
-__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "IMPALALearner",
+__all__ = ["APPO", "APPOConfig", "APPOLearner",
+           "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "IMPALALearner",
            "IMPALALearnerConfig", "vtrace_returns", "DQN", "DQNConfig",
            "QModule", "SAC", "SACConfig", "SACModule"]

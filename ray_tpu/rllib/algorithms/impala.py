@@ -125,6 +125,10 @@ class IMPALALearnerConfig:
 class IMPALALearner:
     """Single-jit V-trace update; optional dp-mesh batch sharding."""
 
+    # leading replicated args of the update signature before the batch
+    # (APPO adds target_params and sets 3)
+    N_REPLICATED_ARGS = 2
+
     def __init__(self, config: IMPALALearnerConfig):
         from ray_tpu._private.jaxenv import pin_platform_from_env
         pin_platform_from_env()
@@ -138,30 +142,34 @@ class IMPALALearner:
         self.opt_state = self._tx.init(self.params)
         self.version = 0
         self._timer = {"updates": 0, "update_time": 0.0, "transitions": 0}
-        update = self._build_update()
-        if config.num_devices > 1:
-            from jax.sharding import (Mesh, NamedSharding,
-                                      PartitionSpec as P)
-            devs = jax.devices()
-            if len(devs) < config.num_devices:
-                raise ValueError(
-                    f"num_devices={config.num_devices} > {len(devs)}")
-            mesh = Mesh(np.array(devs[:config.num_devices]), ("dp",))
-            repl = NamedSharding(mesh, P())
+        self._update_fn = self._jit(self._build_update())
 
-            def shard_for(name):
-                return NamedSharding(
-                    mesh, P(*((None, "dp", None) if name == "obs"
-                              else (None, "dp"))))
-            self._update_fn = jax.jit(
-                update,
-                in_shardings=(repl, repl,
-                              {k: shard_for(k) for k in
-                               ("obs", "actions", "logp", "rewards",
-                                "terminateds", "dones", "mask")},),
-                out_shardings=(repl, repl, repl))
-        else:
-            self._update_fn = jax.jit(update)
+    def _jit(self, update):
+        """jit with dp-mesh batch sharding when num_devices > 1; the
+        update signature is N_REPLICATED_ARGS replicated pytrees
+        followed by the time-major batch."""
+        config = self.config
+        if config.num_devices <= 1:
+            return jax.jit(update)
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devs = jax.devices()
+        if len(devs) < config.num_devices:
+            raise ValueError(
+                f"num_devices={config.num_devices} > {len(devs)}")
+        mesh = Mesh(np.array(devs[:config.num_devices]), ("dp",))
+        repl = NamedSharding(mesh, P())
+
+        def shard_for(name):
+            return NamedSharding(
+                mesh, P(*((None, "dp", None) if name == "obs"
+                          else (None, "dp"))))
+        return jax.jit(
+            update,
+            in_shardings=(repl,) * self.N_REPLICATED_ARGS + (
+                {k: shard_for(k) for k in
+                 ("obs", "actions", "logp", "rewards",
+                  "terminateds", "dones", "mask")},),
+            out_shardings=(repl, repl, repl))
 
     def _build_update(self):
         c = self.config
@@ -237,15 +245,7 @@ class IMPALA:
                 hidden=tuple(config.hidden),
                 seed=config.seed),
             num_env_runners=config.num_env_runners)
-        self.learner = IMPALALearner(IMPALALearnerConfig(
-            obs_dim=self._obs_dim, num_actions=self._num_actions,
-            hidden=tuple(config.hidden), lr=config.lr,
-            gamma=config.gamma,
-            vtrace_rho_clip=config.vtrace_rho_clip,
-            vtrace_c_clip=config.vtrace_c_clip,
-            vf_coef=config.vf_coef, ent_coef=config.ent_coef,
-            max_grad_norm=config.max_grad_norm,
-            num_devices=config.num_devices, seed=config.seed))
+        self.learner = self._make_learner()
         self._queue: deque = deque(maxlen=config.sample_queue_size)
         self._mgr = self.env_runner_group.manager
         self._runner_version: Dict[int, int] = {}
@@ -261,6 +261,20 @@ class IMPALA:
             self._runner_version[aid] = 0
             self._resubmits[aid] = 0
         self._mgr.foreach_actor_async("sample", tag="s")
+
+    LEARNER_CLS = IMPALALearner
+    LEARNER_CONFIG_CLS = IMPALALearnerConfig
+
+    def _make_learner(self) -> "IMPALALearner":
+        """Factory hook: learner-config fields mirror algorithm-config
+        fields by name (APPO only swaps the two classes)."""
+        kw = {f.name: getattr(self.config, f.name)
+              for f in dataclasses.fields(self.LEARNER_CONFIG_CLS)
+              if hasattr(self.config, f.name)}
+        kw.update(obs_dim=self._obs_dim,
+                  num_actions=self._num_actions,
+                  hidden=tuple(self.config.hidden))
+        return self.LEARNER_CLS(self.LEARNER_CONFIG_CLS(**kw))
 
     def _probe_env(self) -> None:
         import gymnasium as gym

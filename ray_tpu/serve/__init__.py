@@ -722,10 +722,12 @@ def start_grpc(port: int = 9000, host: str = "127.0.0.1",
                          *req.get("args", []), **req.get("kwargs", {})),
                 timeout=req.get("timeout_s", 60))
             return json.dumps({"result": result}).encode()
+        except (GeneratorExit, KeyboardInterrupt, SystemExit):
+            raise
         except BaseException as e:  # noqa: BLE001
-            context.set_code(grpc.StatusCode.INTERNAL)
-            context.set_details(repr(e))
-            return json.dumps({"error": repr(e)}).encode()
+            # error travels on the status alone (clients drop response
+            # bodies on non-OK)
+            context.abort(grpc.StatusCode.INTERNAL, repr(e))
 
     def stream(request: bytes, context):
         req = json.loads(request or b"{}")

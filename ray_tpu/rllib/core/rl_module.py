@@ -179,3 +179,79 @@ class ActorCriticModule:
         logp = np.take_along_axis(
             logp_all, action[..., None], axis=-1)[..., 0]
         return action.astype(np.int32), logp.astype(np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvActorCriticModule:
+    """CNN torso for pixel observations (reference model catalog's
+    default conv_filters for image spaces, rllib/models/catalog.py) —
+    NHWC conv stack -> flatten -> dense -> policy/value heads. Pixel
+    inputs are normalized to [0, 1] inside forward (uint8 frames ride
+    the object store un-normalized)."""
+
+    obs_shape: Tuple[int, int, int]           # (H, W, C)
+    num_actions: int
+    # (out_channels, kernel, stride) per conv layer; default matches
+    # the classic 84x84 Atari stack
+    conv_filters: Sequence[Tuple[int, int, int]] = (
+        (16, 8, 4), (32, 4, 2), (32, 3, 1))
+    hidden: int = 256
+
+    def init(self, key: jax.Array) -> Params:
+        keys = jax.random.split(key, len(self.conv_filters) + 3)
+        ki = iter(keys)
+        params: Params = {"conv": []}
+        c_in = self.obs_shape[-1]
+        h, w = self.obs_shape[0], self.obs_shape[1]
+        for c_out, k, s in self.conv_filters:
+            fan_in = k * k * c_in
+            params["conv"].append({
+                "w": (jax.random.normal(next(ki), (k, k, c_in, c_out))
+                      * jnp.sqrt(2.0 / fan_in)).astype(jnp.float32),
+                "b": jnp.zeros((c_out,), jnp.float32)})
+            h = -(-(h - k + 1) // s)         # VALID conv output size
+            w = -(-(w - k + 1) // s)
+            c_in = c_out
+        flat = h * w * c_in
+        if flat <= 0:
+            raise ValueError(
+                f"conv_filters collapse {self.obs_shape} to nothing")
+
+        def dense(key, din, dout, scale):
+            wshape = (din, dout)
+            wkey = jax.random.normal(key, wshape) * scale / jnp.sqrt(din)
+            return {"w": wkey.astype(jnp.float32),
+                    "b": jnp.zeros((dout,), jnp.float32)}
+
+        params["torso"] = dense(next(ki), flat, self.hidden, 1.0)
+        params["pi"] = dense(next(ki), self.hidden, self.num_actions,
+                             0.01)
+        params["vf"] = dense(next(ki), self.hidden, 1, 1.0)
+        return params
+
+    def forward(self, params: Params, obs: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+        """obs (..., H, W, C) uint8/float -> (logits (..., A),
+        value (...))."""
+        lead = obs.shape[:-3]
+        x = obs.reshape((-1,) + tuple(self.obs_shape))
+        x = x.astype(jnp.float32)
+        x = jnp.where(jnp.max(jnp.abs(x)) > 2.0, x / 255.0, x)
+        for layer, (c_out, k, s) in zip(params["conv"],
+                                        self.conv_filters):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + layer["b"])
+        x = x.reshape(x.shape[0], -1)
+        x = jnp.tanh(x @ params["torso"]["w"] + params["torso"]["b"])
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return (logits.reshape(lead + (self.num_actions,)),
+                value.reshape(lead))
+
+    def dist_log_prob(self, params, pi_out, actions):
+        return Categorical.log_prob(pi_out, actions)
+
+    def dist_entropy(self, params, pi_out):
+        return Categorical.entropy(pi_out)

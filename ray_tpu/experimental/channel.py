@@ -149,16 +149,19 @@ class ChannelWriter:
                                            protocol=pickle.HIGHEST_PROTOCOL),
                          **kw)
 
-    def close(self) -> None:
+    def close(self, timeout: float = 5.0) -> None:
         """Publish the closed marker (readers raise ChannelClosed)."""
         ch = self.ch
         try:
             seq = self._seq
             _wait(lambda: all(
                 ch._u64(32 + 8 * i) >= seq for i in range(ch.n_readers)),
-                5.0, "readers before close")
+                timeout, "readers before close")
         except ChannelTimeout:
-            pass
+            # A reader hasn't consumed the last published message yet;
+            # stomping the len word would silently drop it. Leave the
+            # message intact — stuck readers are handled by teardown.
+            return
         ch._set_u64(24, _CLOSED_LEN)
         self._seq += 1
         ch._set_u64(16, self._seq)

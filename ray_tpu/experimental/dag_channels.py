@@ -13,14 +13,59 @@ hop between stages.
 """
 from __future__ import annotations
 
+import struct
 import threading
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
 import ray_tpu
 from ray_tpu.experimental.channel import (Channel, ChannelClosed,
-                                          ChannelReader, ChannelWriter)
+                                          ChannelReader, ChannelTimeout,
+                                          ChannelWriter)
+
+
+class AbortFlag:
+    """One shared u64 in shm that exec loops poll between bounded channel
+    reads, so a dead upstream actor can never wedge a loop forever: the
+    driver raises the flag at teardown and every surviving loop exits at
+    its next poll (reference CompiledDAG cancels exec loops instead)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._mv = None
+
+    @classmethod
+    def create(cls) -> "AbortFlag":
+        from ray_tpu._private.object_store import _create_segment
+        from ray_tpu._private.specs import SESSION_TAG
+        name = f"rtpu_{SESSION_TAG}_abort_{uuid.uuid4().hex[:12]}"
+        _create_segment(name, memoryview(bytes(8)))
+        return cls(name)
+
+    def _map(self):
+        if self._mv is None:
+            from ray_tpu._private.object_store import _map_segment
+            self._mv = _map_segment(self.name, 8)
+        return self._mv
+
+    def set(self) -> None:
+        struct.pack_into("<Q", self._map(), 0, 1)
+
+    def is_set(self) -> bool:
+        try:
+            return struct.unpack_from("<Q", self._map(), 0)[0] != 0
+        except BaseException:
+            return True                # segment gone == abort
+
+    def destroy(self) -> None:
+        from ray_tpu._private.object_store import unlink_segment
+        self._mv = None
+        unlink_segment(self.name)
+
+    def __reduce__(self):
+        return (AbortFlag, (self.name,))
 
 
 class _Err:
@@ -33,21 +78,36 @@ class _Err:
 
 def _exec_loop(instance, method_name: str, in_channels: List[Channel],
                in_reader_idx: List[int], arg_spec: List[Tuple],
-               kw_spec: Dict[str, Tuple], out_channel: Channel) -> int:
+               kw_spec: Dict[str, Tuple], out_channel: Channel,
+               abort: AbortFlag) -> int:
     """Runs INSIDE the actor (one long-lived call): read inputs, run the
-    method, write the result; repeats until the upstream closes."""
+    method, write the result; repeats until the upstream closes or the
+    driver raises the abort flag (bounded reads — a dead peer can't
+    wedge this loop forever)."""
     readers = [ChannelReader(ch, i)
                for ch, i in zip(in_channels, in_reader_idx)]
     writer = ChannelWriter(out_channel)
+
+    def bounded(fn, *a, **kw):
+        while True:
+            try:
+                return fn(*a, timeout=1.0, **kw)
+            except ChannelTimeout:
+                if abort.is_set():
+                    raise ChannelClosed("aborted") from None
+
     executed = 0
     while True:
         vals = []
         err: Any = None
         try:
             for r in readers:
-                vals.append(r.read())
+                vals.append(bounded(r.read))
         except ChannelClosed:
-            writer.close()
+            # short ack wait: at teardown the driver may never ack the
+            # final output, and a 5s stall here would outlive the
+            # driver's loop-exit budget and get this actor killed
+            writer.close(timeout=0.5)
             return executed
         for v in vals:
             if isinstance(v, _Err):
@@ -66,7 +126,10 @@ def _exec_loop(instance, method_name: str, in_channels: List[Channel],
                 result = _Err("".join(traceback.format_exception(e)))
         else:
             result = err
-        writer.write(result)
+        try:
+            bounded(writer.write, result)
+        except ChannelClosed:
+            return executed
         executed += 1
 
 
@@ -166,7 +229,9 @@ class ChannelCompiledDAG:
                 slot[(key, id(c))] = i
 
         # --- install exec loops
+        self._abort = AbortFlag.create()
         self._loop_refs = []
+        self._loop_actors = []
         from ray_tpu.actor import ActorMethod
         for n in nodes:
             in_chs, in_idx, arg_spec, kw_spec = [], [], [], {}
@@ -192,7 +257,9 @@ class ChannelCompiledDAG:
             method = ActorMethod(n.actor, "__rtpu_apply__", {})
             self._loop_refs.append(method.remote(
                 cloudpickle.dumps(_exec_loop), n.method_name, in_chs,
-                in_idx, arg_spec, kw_spec, self._channels[id(n)]))
+                in_idx, arg_spec, kw_spec, self._channels[id(n)],
+                self._abort))
+            self._loop_actors.append(n.actor)
 
         # --- driver endpoints
         self._in_writer = ChannelWriter(self._channels[id(self._input)])
@@ -257,12 +324,35 @@ class ChannelCompiledDAG:
         self._torn_down = True
         try:
             self._in_writer.close()
-            # exec loops propagate the close downstream and return
-            ray_tpu.get(self._loop_refs, timeout=10.0)
         except BaseException:
             pass
+        # abort flag unwedges loops blocked on a dead peer's channel
+        try:
+            self._abort.set()
+        except BaseException:
+            pass
+        remaining = list(zip(self._loop_refs, self._loop_actors))
+        try:
+            ray_tpu.get(self._loop_refs, timeout=5.0)
+            remaining = []
+        except BaseException:
+            pass
+        # kill loops that still haven't exited — destroying segments
+        # under a live reader would leave its thread stuck for the
+        # actor's lifetime
+        for ref, actor in remaining:
+            try:
+                done, _ = ray_tpu.wait([ref], timeout=0.1)
+                if not done:
+                    ray_tpu.kill(actor)
+            except BaseException:
+                pass
         for ch in self._channels.values():
             ch.destroy()
+        try:
+            self._abort.destroy()
+        except BaseException:
+            pass
 
     def __del__(self):
         try:

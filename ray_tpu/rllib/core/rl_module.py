@@ -185,9 +185,10 @@ class ActorCriticModule:
 class ConvActorCriticModule:
     """CNN torso for pixel observations (reference model catalog's
     default conv_filters for image spaces, rllib/models/catalog.py) —
-    NHWC conv stack -> flatten -> dense -> policy/value heads. Pixel
-    inputs are normalized to [0, 1] inside forward (uint8 frames ride
-    the object store un-normalized)."""
+    NHWC conv stack -> flatten -> dense -> policy/value heads. Integer
+    (uint8) inputs are normalized to [0, 1] inside forward, keyed on
+    dtype; float inputs are assumed pre-scaled (the EnvRunner scales
+    integer env observations in numpy before buffering)."""
 
     obs_shape: Tuple[int, int, int]           # (H, W, C)
     num_actions: int
@@ -235,8 +236,12 @@ class ConvActorCriticModule:
         value (...))."""
         lead = obs.shape[:-3]
         x = obs.reshape((-1,) + tuple(self.obs_shape))
+        # normalization keyed on dtype, not batch content: integer
+        # (pixel) inputs always get /255, floats are assumed pre-scaled
+        is_int = jnp.issubdtype(obs.dtype, jnp.integer)
         x = x.astype(jnp.float32)
-        x = jnp.where(jnp.max(jnp.abs(x)) > 2.0, x / 255.0, x)
+        if is_int:
+            x = x / 255.0
         for layer, (c_out, k, s) in zip(params["conv"],
                                         self.conv_filters):
             x = jax.lax.conv_general_dilated(

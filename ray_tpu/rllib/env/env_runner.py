@@ -40,6 +40,16 @@ class EnvRunnerConfig:
 class SingleAgentEnvRunner:
     """Owns a gym.vector env + policy params; `sample()` one rollout."""
 
+    @staticmethod
+    def _f32(obs: np.ndarray) -> np.ndarray:
+        """Integer (pixel) observations are scaled to [0,1] HERE, in
+        numpy, keyed on the raw env dtype — downstream buffers and
+        modules only ever see pre-scaled float32 (the module's own
+        dtype-keyed /255 covers direct uint8 callers only)."""
+        if np.issubdtype(obs.dtype, np.integer):
+            return obs.astype(np.float32) / 255.0
+        return obs.astype(np.float32)
+
     def __init__(self, config: EnvRunnerConfig, worker_index: int = 0):
         from ray_tpu._private.jaxenv import pin_platform_from_env
         pin_platform_from_env()
@@ -72,8 +82,7 @@ class SingleAgentEnvRunner:
         # stateful connectors and is reused as the first sample step):
         # the MODULE is sized from the TRANSFORMED obs, which connectors
         # may reshape (FlattenObs, frame stacking, ...)
-        self._proc_obs = self._env_to_module(
-            self._obs.astype(np.float32), self)
+        self._proc_obs = self._env_to_module(self._f32(self._obs), self)
         obs_dim = int(np.prod(self._proc_obs.shape[1:]))
         if self._continuous:
             self.module = ActorCriticModule(
@@ -122,8 +131,8 @@ class SingleAgentEnvRunner:
         # NormalizeObs must not double-count it), and buffers take the
         # TRANSFORMED shape (connectors may reshape, e.g. FlattenObs).
         if self._proc_obs is None:
-            self._proc_obs = self._env_to_module(
-                self._obs.astype(np.float32), self)
+            self._proc_obs = self._env_to_module(self._f32(self._obs),
+                                                 self)
         proc = self._proc_obs
         obs_buf = np.empty((T + 1, N) + proc.shape[1:], np.float32)
         act_buf = (np.empty((T, N, self._act_dim), np.float32)
@@ -166,7 +175,7 @@ class SingleAgentEnvRunner:
                 self._ep_len[i] = 0
             self._prev_done = done
             self._obs = nobs
-            proc = self._env_to_module(nobs.astype(np.float32), self)
+            proc = self._env_to_module(self._f32(nobs), self)
         obs_buf[T] = proc
         self._proc_obs = proc
         self._total_steps += int(mask_buf.sum())

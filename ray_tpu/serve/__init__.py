@@ -198,6 +198,11 @@ class ServeController:
     # jit compile) isn't misdeclared dead.
     _REPORT_TTL_S = 10.0
     _STARTUP_GRACE_S = 30.0  # time for a new replica's first report
+    _DRAIN_CAP_S = 30.0      # max wait for a victim to finish requests
+    # a busy replica gets extra silence allowance before the liveness
+    # kill (a long GIL-holding native call in its handler blocks the
+    # report thread while requests are genuinely in flight)
+    _BUSY_TTL_S = 60.0
 
     def __init__(self):
         self._deployments: Dict[str, _DeploymentInfo] = {}
@@ -205,6 +210,9 @@ class ServeController:
         self._replicas: Dict[str, List[Any]] = {}
         # (name, replica_id) -> (ongoing, reported_monotonic)
         self._reports: Dict[tuple, tuple] = {}
+        # downscale victims draining in-flight requests:
+        # name -> [(replica_id, handle, deadline_monotonic), ...]
+        self._draining: Dict[str, List[Any]] = {}
         self._targets: Dict[str, int] = {}       # autoscaled target
         # autoscale hysteresis: name -> (direction, desired, since)
         self._scale_intent: Dict[str, tuple] = {}
@@ -242,6 +250,8 @@ class ServeController:
         with self._lock:
             self._deployments.pop(name, None)
             replicas = self._replicas.pop(name, [])
+            replicas += [(rid, r, 0.0) for rid, r, _d
+                         in self._draining.pop(name, [])]
             for key in [k for k in self._reports if k[0] == name]:
                 self._reports.pop(key, None)
         for _rid, r, _t in replicas:
@@ -305,6 +315,13 @@ class ServeController:
                     ongoing += rep[0]
                 elif now - created < self._STARTUP_GRACE_S and rep is None:
                     live.append((rid, r, created, 0))   # still starting
+                elif (rep is not None and rep[0] > 0
+                        and now - rep[1] < self._BUSY_TTL_S):
+                    # silent but last seen busy: its report thread may
+                    # be starved by a long native call in the handler —
+                    # extend grace instead of failing in-flight work
+                    live.append((rid, r, created, rep[0]))
+                    ongoing += rep[0]
                 else:
                     # silent past TTL: presumed dead. KILL before
                     # dropping — if the presumption was wrong (replica
@@ -330,21 +347,58 @@ class ServeController:
                     controller_name=_CONTROLLER_NAME)
                 live.append((rid, actor, time.monotonic(), 0))
             if len(live) > target:
-                # evict the idlest replicas first so in-flight requests
-                # and parked streams survive the downscale when any
-                # idle capacity exists
+                # evict the idlest replicas first, and DRAIN instead of
+                # kill: a victim leaves routing immediately (dropped
+                # from _replicas below) but is only killed once its
+                # reported ongoing count reaches 0 or the drain cap
+                # expires — in-flight requests and parked streams finish
+                # (reference drains gracefully before stopping)
                 live.sort(key=lambda rn: rn[3], reverse=True)
                 while len(live) > target:
                     rid, victim, _c, _n = live.pop()
-                    try:
-                        ray_tpu.kill(victim)
-                    except BaseException:
-                        pass
                     with self._lock:
-                        self._reports.pop((name, rid), None)
+                        if name in self._deployments:
+                            self._draining.setdefault(name, []).append(
+                                (rid, victim, now + self._DRAIN_CAP_S))
+                            victim = None
+                    if victim is not None:
+                        # deployment was deleted under us: nothing will
+                        # ever sweep this drain entry — kill inline
+                        try:
+                            ray_tpu.kill(victim)
+                        except BaseException:
+                            pass
             with self._lock:
                 self._replicas[name] = [(rid, r, c)
                                         for rid, r, c, _n in live]
+            self._sweep_draining(name, now)
+
+    def _sweep_draining(self, name: str, now: float) -> None:
+        """Kill drain victims that finished their in-flight work (or hit
+        the drain cap / stopped reporting)."""
+        with self._lock:
+            draining = list(self._draining.get(name, []))
+        keep = []
+        for rid, victim, deadline in draining:
+            with self._lock:
+                rep = self._reports.get((name, rid))
+            # NO silence-based kill here: a victim mid-native-call stops
+            # reporting while genuinely busy; the drain cap bounds it
+            done = now >= deadline or rep is None or rep[0] == 0
+            if done:
+                try:
+                    ray_tpu.kill(victim)
+                except BaseException:
+                    pass
+                with self._lock:
+                    self._reports.pop((name, rid), None)
+            else:
+                keep.append((rid, victim, deadline))
+        with self._lock:
+            if keep:
+                self._draining[name] = keep
+            else:
+                self._draining.pop(name, None)
 
     def _autoscale(self, name: str, info: _DeploymentInfo,
                    current: int, ongoing: int) -> int:

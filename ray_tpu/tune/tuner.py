@@ -441,7 +441,11 @@ class Tuner:
                 ref = ready[0]
                 trial = inflight.pop(ref.object_id)
                 try:
-                    item = runners[trial.trial_id].collect(ref, timeout=30.0)
+                    # gather timeout matches the wait phase: an SPMD
+                    # trial's other ranks may lag rank 0 by a full jit
+                    # compile, which routinely exceeds 30s
+                    item = runners[trial.trial_id].collect(
+                        ref, timeout=cfg.trial_poll_timeout)
                 except BaseException as e:
                     finish(trial, ERROR, error=repr(e))
                     continue

@@ -79,6 +79,10 @@ class ClusterTaskManager:
         self._pgs: Dict[str, PGRecord] = {}
         self._pending_pgs: List[str] = []
         self._infeasible: List = []       # specs no live node can EVER fit
+        # node_id -> rejoin deadline: rehydrated agents expected to
+        # re-register after a head restart (reference: raylets reconnect
+        # to a restarted GCS; gcs_init_data.cc rehydrated node table)
+        self._rejoining: Dict[str, float] = {}
         self._running = True
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="ray-tpu-health", daemon=True)
@@ -121,6 +125,7 @@ class ClusterTaskManager:
                          labels=dict(labels or {}))
         with self._lock:
             self._nodes[node_id] = rec
+            self._rejoining.pop(node_id, None)   # made it back in time
         self._rt.controller.register_node(node_id, resources,
                                           is_head=False, labels=labels)
         self._rt.controller.publish_node_event(node_id, "ALIVE")
@@ -551,6 +556,63 @@ class ClusterTaskManager:
         with self._lock:
             return [self.pg_table_entry(pg) for pg in self._pgs.values()]
 
+    # --------------------------------------------- head-restart rejoin
+    def expect_rejoin(self, node_id: str, grace_s: float) -> None:
+        """A rehydrated node gets `grace_s` to re-register before its
+        actors/objects are recovered as dead."""
+        with self._lock:
+            self._rejoining[node_id] = time.monotonic() + grace_s
+
+    def restore_pgs(self, entries: List[dict]) -> None:
+        """Rebuild PG records from rehydrated controller views. Bundle
+        reservations live agent-side and survive the head restart; a
+        node that never rejoins triggers rescheduling via
+        _fail_rejoining_node."""
+        with self._lock:
+            for e in entries:
+                pg = PGRecord(
+                    pg_id=e["placement_group_id"],
+                    bundles=[dict(b) for b in e["bundles"]],
+                    strategy=e["strategy"], name=e.get("name", ""),
+                    state=e["state"],
+                    bundle_nodes=list(e.get("bundle_nodes",
+                                            [None] * len(e["bundles"]))))
+                self._pgs[pg.pg_id] = pg
+                if pg.state in (PG_PENDING, PG_RESCHEDULING):
+                    self._pending_pgs.append(pg.pg_id)
+
+    def _fail_rejoining_node(self, node_id: str) -> None:
+        """A rehydrated node missed its rejoin deadline: run the
+        node-death recovery that _on_node_death would have (there is no
+        NodeRecord/scheduler to drain — the head that owned it died)."""
+        with self._lock:
+            if node_id in self._nodes:
+                # the agent's registration raced the deadline sweep and
+                # won: it is alive — do not recover (duplicate) actors
+                return
+        self._rt.controller.set_node_state(
+            node_id, alive=False, cause="did not rejoin after head restart")
+        self._rt.controller.publish_node_event(
+            node_id, "DEAD", cause="did not rejoin after head restart")
+        for actor_id in self._rt.controller.actors_on_node(node_id):
+            self._rt._recover_actor(actor_id)
+        if hasattr(self._rt, "on_node_objects_lost"):
+            self._rt.on_node_objects_lost(node_id)
+        with self._lock:
+            hit = [pg for pg in self._pgs.values()
+                   if pg.state == PG_CREATED and node_id in pg.bundle_nodes]
+        for pg in hit:
+            for idx, nid in enumerate(pg.bundle_nodes):
+                if nid is not None and nid != node_id:
+                    sched = self.scheduler_for_node(nid)
+                    if sched is not None:
+                        sched.release_bundle(pg.pg_id, idx)
+            pg.bundle_nodes = [None] * len(pg.bundles)
+            pg.state = PG_RESCHEDULING
+            if not self._try_reserve(pg):
+                with self._lock:
+                    self._pending_pgs.append(pg.pg_id)
+
     # ----------------------------------------------------- node failure
     def _monitor_loop(self) -> None:
         """GcsHealthCheckManager parity: staleness-based liveness."""
@@ -558,13 +620,23 @@ class ClusterTaskManager:
             time.sleep(0.5)
             now = time.monotonic()
             dead = []
+            expired = []
             with self._lock:
                 for n in self._nodes.values():
                     if (n.alive and
                             now - n.last_heartbeat > _CFG.heartbeat_timeout_s):
                         dead.append(n.node_id)
+                for nid, deadline in list(self._rejoining.items()):
+                    if now > deadline:
+                        self._rejoining.pop(nid)
+                        expired.append(nid)
             for nid in dead:
                 self._on_node_death(nid, cause="heartbeat timeout")
+            for nid in expired:
+                try:
+                    self._fail_rejoining_node(nid)
+                except Exception:
+                    pass
 
     def _on_node_death(self, node_id: str, cause: str) -> None:
         with self._lock:

@@ -86,6 +86,22 @@ _define("lineage_max_resubmits", 3,
         "Cap on per-task lineage re-executions when a node death "
         "orphans a still-referenced object (reference task_manager "
         "ResubmitTask bookkeeping).")
+_define("head_snapshot_path", "",
+        "When set, the head periodically snapshots all controller "
+        "tables (actors, nodes, PGs, KV, lineage, object directory) to "
+        "this file and REHYDRATES from it on restart (reference GCS "
+        "persistence: gcs_init_data.cc + redis_store_client.h). Empty "
+        "disables head fault tolerance.")
+_define("head_snapshot_period_s", 1.0,
+        "Controller snapshot period when head_snapshot_path is set.")
+_define("agent_reconnect_window_s", 60.0,
+        "How long a node agent keeps redialing a lost head before "
+        "giving up and shutting down (reference raylets tolerate GCS "
+        "downtime); 0 restores exit-on-disconnect.")
+_define("node_rejoin_grace_s", 20.0,
+        "After a head restart, how long rehydrated nodes have to "
+        "re-register before they are declared dead and their actors/"
+        "objects recovered.")
 
 
 class _Config:

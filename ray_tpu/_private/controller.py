@@ -13,7 +13,10 @@ Parity map to the reference GCS (src/ray/gcs/gcs_server/gcs_server.h:221-295):
 
 All state is in-memory in the driver process; the multi-node story keeps
 this process as head node (the reference's head-node GCS is the same
-topology). Persistence hooks (snapshot/restore) land with checkpointing.
+topology). Head fault tolerance: ``snapshot_state()`` serializes every
+table and ``restore_state()`` rehydrates a restarted head from it
+(reference gcs/gcs_server/gcs_init_data.cc loading from
+gcs/store_client/redis_store_client.h storage).
 """
 from __future__ import annotations
 
@@ -243,7 +246,8 @@ class Controller:
 
     def set_actor_state(self, actor_id: str, state: str,
                         worker_id: Optional[str] = None,
-                        death_cause: str = "") -> None:
+                        death_cause: str = "",
+                        node_id: Optional[str] = None) -> None:
         with self._lock:
             rec = self._actors.get(actor_id)
             if rec is None:
@@ -251,6 +255,8 @@ class Controller:
             rec.state = state
             if worker_id is not None:
                 rec.worker_id = worker_id
+            if node_id is not None:
+                rec.node_id = node_id
             if death_cause:
                 rec.death_cause = death_cause
             if state == DEAD and rec.spec.name is not None:
@@ -311,6 +317,52 @@ class Controller:
                 "is_head": r.is_head, "resources": dict(r.resources),
                 "death_cause": r.death_cause, "labels": dict(r.labels),
             } for r in self._nodes.values()]
+
+    def actors_on_node(self, node_id: str) -> list[str]:
+        """Non-dead actors whose last known placement is `node_id`."""
+        with self._lock:
+            return [aid for aid, r in self._actors.items()
+                    if r.node_id == node_id and r.state != DEAD]
+
+    # ---- persistence (GCS storage parity) ----
+    _SNAPSHOT_TABLES = ("_kv", "_actors", "_named_actors", "_refcounts",
+                        "_pins", "_pgs", "_nodes", "_locations",
+                        "_location_nbytes", "_lineage")
+
+    def snapshot_state(self) -> bytes:
+        """Snapshot every table into one blob (reference GCS tables are
+        flushed to the storage backend). Only the shallow table copies
+        happen under the lock; the pickle — the expensive part — runs
+        outside so the periodic snapshot never stalls the control
+        plane."""
+        import pickle
+        with self._lock:
+            state = {name: dict(getattr(self, name))
+                     for name in self._SNAPSHOT_TABLES}
+            # location values are sets mutated in place — copy them, or
+            # the out-of-lock pickle races concurrent add/discard
+            state["_locations"] = {k: set(v)
+                                   for k, v in state["_locations"].items()}
+            state["_task_events"] = list(self._task_events)
+        return pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def restore_state(self, blob: bytes) -> None:
+        """Rehydrate from a snapshot (reference gcs_init_data.cc). Node
+        records for OLD head processes are dropped — the restarted head
+        registers itself fresh; agent records are kept so the cluster
+        can await their re-registration."""
+        import pickle
+        state = pickle.loads(blob)
+        with self._lock:
+            current = dict(self._nodes)          # the new head's record(s)
+            for name in self._SNAPSHOT_TABLES:
+                setattr(self, name, state[name])
+            self._pins = collections.defaultdict(
+                int, state["_pins"])             # keep defaulting behavior
+            self._nodes = {nid: r for nid, r in self._nodes.items()
+                           if not r.is_head}
+            self._nodes.update(current)
+            self._task_events.extend(state.get("_task_events", ()))
 
     # ---- task events (GcsTaskManager parity) ----
     def record_task_event(self, task_id: str, name: str, state: str,

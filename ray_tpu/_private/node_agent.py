@@ -110,8 +110,37 @@ class NodeAgent:
             max_workers, node_id=self.node_id, cluster=None)
         self.scheduler.start()
 
-        self.head = protocol.connect(head_addr, self._handle_head_msg,
-                                     self._on_head_closed, name="head")
+        # head-reconnect state (reference: raylets tolerate GCS downtime
+        # and re-register on GCS restart)
+        self._reconnect_lock = threading.Lock()
+        self._reconnecting = False
+        self._pending_relays: list = []          # (conn, msg) to replay
+        # state-bearing fire-and-forget messages (task completions,
+        # object locations, worker deaths) that failed during a head
+        # outage — replayed on rejoin so results produced while the head
+        # was down are not silently lost
+        import collections as _collections
+        self._pending_sends: _collections.deque = _collections.deque(
+            maxlen=10_000)
+        self._labels = dict(labels or {})
+        self._max_workers = max_workers
+        self._resources = dict(resources)
+
+        # initial dial retries briefly: agents are routinely started
+        # before (or concurrently with) the head (`ray start` order
+        # independence)
+        dial_deadline = time.monotonic() + max(
+            10.0, _CFG.agent_reconnect_window_s)
+        while True:
+            try:
+                self.head = protocol.connect(
+                    head_addr, self._handle_head_msg,
+                    self._on_head_closed, name="head")
+                break
+            except OSError:
+                if time.monotonic() > dial_deadline:
+                    raise
+                time.sleep(0.3)
         if advertise_host is None:
             # The address peers should dial = the local address of our
             # outbound connection to the head (gethostbyname(hostname)
@@ -135,10 +164,107 @@ class NodeAgent:
 
     # ------------------------------------------------------ lifecycles
     def _on_head_closed(self, conn) -> None:
-        # Orphaned agent: the head is the only control plane — exit.
-        sys.stderr.write("ray_tpu node_agent: head connection lost; "
-                         "shutting down\n")
-        self.shutdown()
+        if self._stop.is_set():
+            return
+        window = _CFG.agent_reconnect_window_s
+        if window <= 0:
+            # Orphaned agent: the head is the only control plane — exit.
+            sys.stderr.write("ray_tpu node_agent: head connection lost; "
+                             "shutting down\n")
+            self.shutdown()
+            return
+        with self._reconnect_lock:
+            if self._reconnecting:
+                return
+            self._reconnecting = True
+        threading.Thread(target=self._reconnect_loop, args=(window,),
+                         name="rtpu-agent-reconnect", daemon=True).start()
+
+    def _reconnect_loop(self, window: float) -> None:
+        """Redial the head with backoff until it answers or the window
+        expires. On success: re-register with the SAME node id plus a
+        rejoin report (live actors, held objects) so a restarted head's
+        rehydrated tables re-attach to this node's surviving state."""
+        sys.stderr.write(f"ray_tpu node_agent {self.node_id}: head "
+                         f"connection lost; reconnecting for up to "
+                         f"{window:.0f}s\n")
+        deadline = time.monotonic() + window
+        backoff = 0.25
+        while not self._stop.is_set():
+            if time.monotonic() > deadline:
+                sys.stderr.write("ray_tpu node_agent: head did not come "
+                                 "back; shutting down\n")
+                self.shutdown()
+                return
+            self._stop.wait(backoff)
+            backoff = min(backoff * 1.6, 2.0)
+            try:
+                conn = protocol.connect(self.head_addr,
+                                        self._handle_head_msg,
+                                        self._on_head_closed, name="head")
+            except OSError:
+                continue
+            # Swap BEFORE registering: the head may route work here the
+            # instant it processes the register, and completions must go
+            # out on the new connection, not the dead one.
+            self.head = conn
+            try:
+                rep = conn.request(
+                    {"type": protocol.NODE_REGISTER,
+                     "resources": self._resources,
+                     "labels": self._labels, "node_id": self.node_id,
+                     "advertise_addr": self.advertise_addr,
+                     "max_workers": self._max_workers,
+                     "rejoin": True,
+                     "live_actors": self.scheduler.live_actors(),
+                     "objects": self.store.held_objects()},
+                    timeout=30.0)
+                if rep.get("node_id") != self.node_id:
+                    raise RuntimeError("rejoin refused")
+            except BaseException:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                continue
+            with self._reconnect_lock:
+                self._reconnecting = False
+                sends = list(self._pending_sends)
+                self._pending_sends.clear()
+                relays, self._pending_relays = self._pending_relays, []
+            sys.stderr.write(f"ray_tpu node_agent {self.node_id}: "
+                             f"rejoined head ({len(sends)} events + "
+                             f"{len(relays)} requests replayed)\n")
+            for i, m in enumerate(sends):
+                try:
+                    conn.send(m)
+                except protocol.ConnectionClosed:
+                    # head bounced again mid-flush: keep the unsent tail
+                    # for the next rejoin instead of losing it
+                    with self._reconnect_lock:
+                        self._pending_sends.extendleft(
+                            reversed(sends[i:]))
+                    break
+            for wconn, msg in relays:
+                if not wconn.closed:
+                    self._relay_to_head(wconn, msg)
+            return
+
+    def _buffer_relay(self, conn, msg: dict) -> bool:
+        """Queue a worker request for replay after the head comes back;
+        False when reconnection is off/over (caller drops the relay).
+        If the reconnect already finished (the failure came from the OLD
+        connection's futures), retry on the new connection instead."""
+        if _CFG.agent_reconnect_window_s <= 0 or self._stop.is_set():
+            return False
+        with self._reconnect_lock:
+            if self._reconnecting:
+                if len(self._pending_relays) >= 10_000:
+                    return False
+                self._pending_relays.append((conn, msg))
+                return True
+        self._relay_to_head(conn, msg)
+        return True
 
     def shutdown(self) -> None:
         if self._stop.is_set():
@@ -174,7 +300,9 @@ class NodeAgent:
                     **self.scheduler.heartbeat_snapshot(),
                 })
             except protocol.ConnectionClosed:
-                return
+                # head outage: keep the thread alive — self.head is
+                # swapped for a fresh connection on successful rejoin
+                pass
             except Exception:
                 # never let a transient snapshot/serialize error kill the
                 # heartbeat thread — a silent exit here reads as node
@@ -182,12 +310,31 @@ class NodeAgent:
                 log.exception("heartbeat send failed; retrying")
             self._stop.wait(HEARTBEAT_PERIOD_S)
 
+    def _send_to_head(self, msg: dict) -> None:
+        """Fire-and-forget send that buffers during a head outage (the
+        reconnect flush replays it) instead of dropping state."""
+        for _attempt in range(2):
+            try:
+                self.head.send(msg)
+                return
+            except protocol.ConnectionClosed:
+                if (_CFG.agent_reconnect_window_s <= 0
+                        or self._stop.is_set()):
+                    return
+                with self._reconnect_lock:
+                    if self._reconnecting:
+                        self._pending_sends.append(msg)
+                        return
+                # reconnect finished between our read of self.head and
+                # the failed send: retry once on the fresh connection
+                # (buffering here would strand the message until a
+                # future outage that may never come)
+        with self._reconnect_lock:
+            self._pending_sends.append(msg)
+
     def send_event(self, kind: str, **fields) -> None:
-        try:
-            self.head.send({"type": protocol.NODE_EVENT, "kind": kind,
+        self._send_to_head({"type": protocol.NODE_EVENT, "kind": kind,
                             "node_id": self.node_id, **fields})
-        except protocol.ConnectionClosed:
-            pass
 
     # ----------------------------------------------- head-sent messages
     def _handle_head_msg(self, conn: protocol.Connection,
@@ -278,10 +425,7 @@ class NodeAgent:
                        protocol.KV_OP, protocol.STATE_OP):
             self._relay_to_head(conn, msg)
         elif mtype in (protocol.DECREF, protocol.ADDREF):
-            try:
-                self.head.send(msg)
-            except protocol.ConnectionClosed:
-                pass
+            self._send_to_head(dict(msg))
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)
 
@@ -301,16 +445,27 @@ class NodeAgent:
         except protocol.ConnectionClosed:
             if wid:
                 self.scheduler.worker_unblocked(wid)
+            # head outage: park the request for replay after rejoin
+            # (reference raylets queue GCS RPCs across GCS restarts)
+            self._buffer_relay(conn, msg)
             return
 
         def on_reply(fut) -> None:      # runs on head-conn reader thread
             try:
                 rep = fut.result(timeout=0)
-            except BaseException:
-                rep = {}
-            finally:
+            except protocol.ConnectionClosed:
                 if wid:
                     self.scheduler.worker_unblocked(wid)
+                if not self._buffer_relay(conn, msg):
+                    try:
+                        conn.reply({"rid": worker_rid}, timeout=True)
+                    except protocol.ConnectionClosed:
+                        pass
+                return
+            except BaseException:
+                rep = {}
+            if wid:
+                self.scheduler.worker_unblocked(wid)
             out = {k: v for k, v in rep.items()
                    if k not in ("rid", "type")}
             try:
@@ -346,13 +501,10 @@ class NodeAgent:
             self.scheduler.task_finished(worker_id)
         ctrl = {k: v for k, v in msg.items()
                 if k not in ("results", "rid", "type")}
-        try:
-            self.head.send({"type": protocol.NODE_TASK_DONE,
+        self._send_to_head({"type": protocol.NODE_TASK_DONE,
                             "node_id": self.node_id,
                             "worker_id": worker_id, "inline": inline,
                             "located": located, **ctrl})
-        except protocol.ConnectionClosed:
-            pass
 
     # ------------------------------------------------------ object gets
     def _on_get_object(self, conn: protocol.Connection, msg: dict) -> None:

@@ -423,6 +423,16 @@ class LocalStore:
         return obj
 
     # ------------------------------------------------------------- get
+    def held_objects(self) -> list[tuple[str, int]]:
+        """(object_id, nbytes) for every resident or spilled object —
+        reported to the head on rejoin so the rehydrated object
+        directory learns this node's copies."""
+        with self._lock:
+            out = [(oid, o.nbytes) for oid, o in self._objects.items()]
+            out.extend((oid, s.nbytes) for oid, s in self._spilled.items()
+                       if oid not in self._objects)
+            return out
+
     def contains(self, object_id: str) -> bool:
         with self._lock:
             return (object_id in self._objects

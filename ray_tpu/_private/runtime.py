@@ -127,6 +127,111 @@ class Runtime(_context.BaseContext):
         head = self.cluster.add_node(node_res, max_workers=max_workers,
                                      is_head=True)
         self.head_node_id = head.node_id
+        self._init_head_persistence()
+
+    # ================= head fault tolerance =================
+    def _init_head_persistence(self) -> None:
+        """Reference GCS persistence (gcs_server_main.cc:26-33 storage
+        backend + gcs_init_data.cc rehydration): when
+        RAY_TPU_HEAD_SNAPSHOT_PATH is set, restore controller tables
+        from the snapshot if one exists, then snapshot periodically."""
+        from ray_tpu._private.config import CONFIG as _CFG
+        self._snapshot_path = _CFG.head_snapshot_path or None
+        if self._snapshot_path is None:
+            return
+        if os.path.exists(self._snapshot_path):
+            try:
+                self._rehydrate(self._snapshot_path)
+            except Exception:
+                log.exception("head snapshot restore failed; "
+                              "starting with empty tables")
+        self._snapshot_thread = threading.Thread(
+            target=self._snapshot_loop, name="rtpu-head-snapshot",
+            daemon=True)
+        self._snapshot_thread.start()
+
+    def _snapshot_loop(self) -> None:
+        from ray_tpu._private.config import CONFIG as _CFG
+        period = max(0.1, _CFG.head_snapshot_period_s)
+        while not self._shutdown:
+            time.sleep(period)
+            try:
+                self.snapshot_now()
+            except Exception:
+                log.exception("head snapshot failed")
+
+    def snapshot_now(self) -> None:
+        """Atomic controller snapshot to disk (tmp + rename)."""
+        if self._snapshot_path is None or self._shutdown:
+            return
+        blob = self.controller.snapshot_state()
+        tmp = self._snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._snapshot_path)
+
+    def _rehydrate(self, path: str) -> None:
+        """Restore controller tables, then reconcile: agents recorded
+        alive get a rejoin grace window; actors whose node died with the
+        old head (head-local workers, unknown nodes) are restarted
+        through the normal recovery machinery."""
+        from ray_tpu._private.config import CONFIG as _CFG
+        with open(path, "rb") as f:
+            blob = f.read()
+        self.controller.restore_state(blob)
+        rejoining: set[str] = set()
+        for n in self.controller.list_nodes():
+            if n["is_head"] or not n["alive"]:
+                continue
+            rejoining.add(n["node_id"])
+            self.cluster.expect_rejoin(n["node_id"],
+                                       _CFG.node_rejoin_grace_s)
+        self.cluster.restore_pgs(self.controller.list_pgs())
+        for info in self.controller.list_actors():
+            rec = self.controller.get_actor(info["actor_id"])
+            if rec is None or rec.state == DEAD:
+                continue
+            if rec.node_id in rejoining:
+                continue            # its worker may still be alive there
+            # worker died with the old head: normal restart bookkeeping
+            rec.worker_id = None
+            self._recover_actor(rec.spec.actor_id)
+        log.info("head rehydrated from %s: %d actors, %d nodes pending "
+                 "rejoin", path, len(self.controller.list_actors()),
+                 len(rejoining))
+
+    def _process_rejoin(self, rec, msg: dict) -> None:
+        """An agent re-registered after a head restart (or reconnect):
+        re-attach its live actors and re-learn its object copies."""
+        proxy = rec.scheduler
+        node_id = rec.node_id
+        for oid, nbytes in msg.get("objects", ()):
+            self.controller.add_location(oid, node_id, nbytes)
+            self.waiters.notify(oid)
+        reported = dict(msg.get("live_actors", {}))
+        for actor_id, worker_id in reported.items():
+            arec = self.controller.get_actor(actor_id)
+            if arec is None or arec.state == DEAD:
+                continue
+            if arec.node_id != node_id:
+                # already recovered elsewhere while this agent was away
+                # (transient disconnect): the agent's copy is stale —
+                # kill it, or two instances of one actor run forever
+                proxy.kill_worker(worker_id)
+                continue
+            proxy.on_dispatched("actor:" + actor_id, worker_id,
+                                actor_id=actor_id)
+            proxy.track_live_actor(actor_id, arec.spec)
+            self.controller.set_actor_state(actor_id, ALIVE,
+                                            worker_id=worker_id,
+                                            node_id=node_id)
+            self._flush_actor_queue(actor_id)
+        # actors the tables place on this node but the agent did NOT
+        # report: their workers died while no head was watching —
+        # recover them or their callers hang forever
+        for actor_id in self.controller.actors_on_node(node_id):
+            if actor_id not in reported:
+                self._recover_actor(actor_id)
 
     @property
     def scheduler(self):
@@ -153,7 +258,13 @@ class Runtime(_context.BaseContext):
             return
         nid = conn.meta.get("node_id")
         if nid is not None:
-            # an agent's control connection dropped: node death
+            # an agent's control connection dropped: node death — unless
+            # the agent already re-registered on a NEW connection (the
+            # old conn's close callback can arrive after the rejoin)
+            rec = self.cluster.get_node(nid)
+            if rec is not None and getattr(rec.scheduler, "conn",
+                                           None) is not conn:
+                return
             self.cluster._on_node_death(nid, cause="agent disconnected")
             return
         wid = conn.meta.get("worker_id")
@@ -275,8 +386,10 @@ class Runtime(_context.BaseContext):
             spec.task_id, spec.name, "RUNNING", worker_id=worker_id)
 
     def on_actor_dispatched(self, spec: ActorSpec, worker_id: str) -> None:
-        self.controller.set_actor_state(spec.actor_id, PENDING,
-                                        worker_id=worker_id)
+        sched = self._scheduler_for_worker(worker_id)
+        self.controller.set_actor_state(
+            spec.actor_id, PENDING, worker_id=worker_id,
+            node_id=getattr(sched, "node_id", None))
 
     # ================= message handlers =================
     def _handle_msg(self, conn: protocol.Connection, msg: dict) -> None:
@@ -329,6 +442,8 @@ class Runtime(_context.BaseContext):
                 advertise_addr=tuple(msg["advertise_addr"]),
                 node_id=msg.get("node_id"))
             conn.meta["node_id"] = rec.node_id
+            if msg.get("rejoin"):
+                self._process_rejoin(rec, msg)
             conn.reply(msg, node_id=rec.node_id)
         elif mtype == protocol.NODE_HEARTBEAT:
             nid = msg["node_id"]
@@ -378,8 +493,9 @@ class Runtime(_context.BaseContext):
                     self._store_error(t.return_ids, TaskError(
                         ActorDiedError(actor_id, cause), task_name=t.name))
             else:
-                self.controller.set_actor_state(actor_id, ALIVE,
-                                                worker_id=worker_id)
+                self.controller.set_actor_state(
+                    actor_id, ALIVE, worker_id=worker_id,
+                    node_id=getattr(wsched, "node_id", None))
                 self._flush_actor_queue(actor_id)
             return
         task_id = msg["task_id"]
@@ -421,7 +537,8 @@ class Runtime(_context.BaseContext):
                 proxy.on_dispatched(msg["key"], msg["worker_id"],
                                     actor_id=msg["actor_id"])
             self.controller.set_actor_state(msg["actor_id"], PENDING,
-                                            worker_id=msg["worker_id"])
+                                            worker_id=msg["worker_id"],
+                                            node_id=msg["node_id"])
         elif kind == "worker_lost":
             if proxy is not None:
                 proxy.on_worker_lost(msg["worker_id"])
@@ -498,7 +615,8 @@ class Runtime(_context.BaseContext):
                         ActorDiedError(actor_id, cause), task_name=t.name))
             else:
                 self.controller.set_actor_state(actor_id, ALIVE,
-                                                worker_id=worker_id)
+                                                worker_id=worker_id,
+                                                node_id=node_id)
                 self._flush_actor_queue(actor_id)
             return
         task_id = msg["task_id"]

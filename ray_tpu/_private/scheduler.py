@@ -441,6 +441,16 @@ class Scheduler:
                 u = max(u, 1.0 - eff.get(k, 0.0) / tot)
         return u
 
+    def live_actors(self) -> dict[str, str]:
+        """actor_id -> worker_id for actors with a live worker here —
+        reported to the head when this agent rejoins after a head
+        restart, so rehydrated actor records re-attach to their
+        still-running workers instead of restarting them."""
+        with self._lock:
+            return {r.actor_id: r.worker_id
+                    for r in self._workers.values()
+                    if r.actor_id is not None and r.state != DEAD}
+
     def owns_worker(self, worker_id: str) -> bool:
         with self._lock:
             return worker_id in self._workers

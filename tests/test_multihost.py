@@ -240,3 +240,243 @@ def test_agent_death_actor_restart(head):
     assert ok, "actor did not restart after agent death"
     second = ray_tpu.get(svc.node.remote(), timeout=30)
     assert second != first
+
+
+# ---------------------------------------------------------------------------
+# Head fault tolerance: the head process is SIGKILLed mid-run and restarted;
+# agents reconnect + re-register, rehydrated tables re-attach to surviving
+# workers (reference gcs_init_data.cc rehydration + raylets tolerating GCS
+# downtime, SURVEY §5.3).
+# ---------------------------------------------------------------------------
+import signal
+import subprocess
+import sys
+import textwrap
+
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _head_env(snap_path) -> dict:
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        ray_tpu.__file__)))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["RAY_TPU_HEAD_SNAPSHOT_PATH"] = str(snap_path)
+    env["RAY_TPU_HEAD_SNAPSHOT_PERIOD_S"] = "0.2"
+    env.pop("RAY_TPU_NODE_ID", None)
+    return env
+
+
+def _wait_file(path, timeout: float) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def test_head_restart_named_actor_survives(tmp_path):
+    """Kill the head with SIGKILL; restart it on the same port with the
+    same snapshot path. The agent rejoins, and the named actor — whose
+    worker process lived on the agent through the outage — answers with
+    ITS IN-MEMORY STATE intact (counter continues, not restarts)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    port = _free_port()
+    snap = tmp_path / "head.snap"
+    ready = tmp_path / "ready.txt"
+    out = tmp_path / "out.txt"
+    env = _head_env(snap)
+
+    head_a_src = textwrap.dedent(f"""
+        import time
+        import ray_tpu
+        rt = ray_tpu.init(num_cpus=2, port={port})
+        deadline = time.monotonic() + 60
+        while (len(rt.cluster.alive_nodes()) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+
+        @ray_tpu.remote(resources={{"svc": 1.0}})
+        class Counter:
+            def __init__(self):
+                self.n = 0
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.options(name="ft_counter").remote()
+        v = ray_tpu.get(c.incr.remote(), timeout=60)
+        assert v == 1
+        time.sleep(1.5)          # several snapshot periods
+        with open({str(ready)!r}, "w") as f:
+            f.write(str(v))
+        time.sleep(600)
+    """)
+    agent = None
+    pa = pb = None
+    try:
+        pa = subprocess.Popen([sys.executable, "-c", head_a_src], env=env)
+        # the agent dials the fixed port; retries until head A listens
+        deadline = time.monotonic() + 30
+        while agent is None and time.monotonic() < deadline:
+            try:
+                agent = NodeAgentProcess(head_address=("127.0.0.1", port),
+                                         num_cpus=4,
+                                         resources={"svc": 4.0})
+            except Exception:
+                time.sleep(0.5)
+        assert agent is not None
+        assert _wait_file(ready, 120), "head A never became ready"
+
+        os.kill(pa.pid, signal.SIGKILL)
+        pa.wait(timeout=10)
+
+        head_b_src = textwrap.dedent(f"""
+            import time
+            import ray_tpu
+            rt = ray_tpu.init(num_cpus=2, port={port})
+            h = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    h = ray_tpu.get_actor("ft_counter")
+                    break
+                except ValueError:
+                    time.sleep(0.2)
+            assert h is not None, "named actor lost across head restart"
+            v = ray_tpu.get(h.incr.remote(), timeout=90)
+            with open({str(out)!r}, "w") as f:
+                f.write(str(v))
+            ray_tpu.shutdown()
+        """)
+        pb = subprocess.Popen([sys.executable, "-c", head_b_src], env=env)
+        assert pb.wait(timeout=150) == 0, "restarted head driver failed"
+        with open(out) as f:
+            # 2, not 1: the SAME worker process answered — its state
+            # survived the head restart
+            assert f.read().strip() == "2"
+    finally:
+        for p in (pa, pb):
+            if p is not None and p.poll() is None:
+                p.kill()
+        if agent is not None:
+            agent.terminate()
+
+
+def test_head_restart_trainer_resumes(tmp_path):
+    """An in-flight JaxTrainer dies with the head; the restarted head
+    resumes it from the latest checkpoint and finishes the remaining
+    steps (head-FT done-criterion)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    port = _free_port()
+    env = _head_env(tmp_path / "head.snap")
+    storage = tmp_path / "results"
+    out = tmp_path / "train_out.txt"
+
+    loop_src = textwrap.dedent("""
+        def loop(config):
+            import os, tempfile, time
+            from ray_tpu import train
+            from ray_tpu.train import Checkpoint
+            ckpt = train.get_checkpoint()
+            start = 0
+            if ckpt is not None:
+                with open(os.path.join(ckpt.as_directory(),
+                                       "step.txt")) as f:
+                    start = int(f.read()) + 1
+            for step in range(start, 10):
+                time.sleep(0.4)
+                d = tempfile.mkdtemp()
+                with open(os.path.join(d, "step.txt"), "w") as f:
+                    f.write(str(step))
+                train.report({"step": step, "start": start},
+                             checkpoint=Checkpoint.from_directory(d))
+    """)
+    driver_tpl = textwrap.dedent(f"""
+        import glob, os, time
+        import ray_tpu
+        from ray_tpu.train import (Checkpoint, JaxTrainer, RunConfig,
+                                   ScalingConfig)
+        rt = ray_tpu.init(num_cpus=2, port={port})
+        deadline = time.monotonic() + 60
+        while (len(rt.cluster.alive_nodes()) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+    """) + loop_src
+
+    head_a_src = driver_tpl + textwrap.dedent(f"""
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(
+                num_workers=2, use_tpu=False,
+                resources_per_worker={{"CPU": 1.0, "trainhost": 1.0}}),
+            run_config=RunConfig(name="ftrun",
+                                 storage_path={str(storage)!r}))
+        trainer.fit()
+    """)
+    head_b_src = driver_tpl + textwrap.dedent(f"""
+        ckpt_root = os.path.join({str(storage)!r}, "ftrun", "checkpoints")
+        cands = sorted(glob.glob(os.path.join(ckpt_root, "*")),
+                       key=os.path.getmtime)
+        assert cands, "no checkpoint survived the head crash"
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(
+                num_workers=2, use_tpu=False,
+                resources_per_worker={{"CPU": 1.0, "trainhost": 1.0}}),
+            run_config=RunConfig(name="ftrun_resume",
+                                 storage_path={str(storage)!r}),
+            resume_from_checkpoint=Checkpoint.from_directory(cands[-1]))
+        result = trainer.fit()
+        with open({str(out)!r}, "w") as f:
+            f.write(f"{{result.metrics['step']}} "
+                    f"{{result.metrics['start']}}")
+        ray_tpu.shutdown()
+    """)
+    agent = None
+    pa = pb = None
+    try:
+        pa = subprocess.Popen([sys.executable, "-c", head_a_src], env=env)
+        deadline = time.monotonic() + 30
+        while agent is None and time.monotonic() < deadline:
+            try:
+                agent = NodeAgentProcess(head_address=("127.0.0.1", port),
+                                         num_cpus=8, max_workers=10,
+                                         resources={"trainhost": 10.0})
+            except Exception:
+                time.sleep(0.5)
+        assert agent is not None
+        # kill head A once training checkpoints start landing
+        ckpt_root = storage / "ftrun" / "checkpoints"
+        deadline = time.monotonic() + 120
+        import glob as _glob
+        while time.monotonic() < deadline:
+            if len(_glob.glob(str(ckpt_root / "*"))) >= 2:
+                break
+            time.sleep(0.3)
+        assert _glob.glob(str(ckpt_root / "*")), "no checkpoints written"
+        os.kill(pa.pid, signal.SIGKILL)
+        pa.wait(timeout=10)
+
+        pb = subprocess.Popen([sys.executable, "-c", head_b_src], env=env)
+        assert pb.wait(timeout=240) == 0, "resumed trainer driver failed"
+        with open(out) as f:
+            step, start = f.read().split()
+        assert step == "9"
+        assert int(start) > 0, "trainer restarted from scratch, not ckpt"
+    finally:
+        for p in (pa, pb):
+            if p is not None and p.poll() is None:
+                p.kill()
+        if agent is not None:
+            agent.terminate()

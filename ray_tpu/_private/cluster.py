@@ -20,9 +20,12 @@ honest TPU-era model for one driver managing N pod hosts.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import uuid
+
+log = logging.getLogger(__name__)
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -598,6 +601,11 @@ class ClusterTaskManager:
             self._rt._recover_actor(actor_id)
         if hasattr(self._rt, "on_node_objects_lost"):
             self._rt.on_node_objects_lost(node_id)
+        self._reschedule_pgs_for(node_id)
+
+    def _reschedule_pgs_for(self, node_id: str) -> None:
+        """Bundles reserved on a dead node go back to pending and try to
+        re-reserve elsewhere (GcsPlacementGroupManager rescheduling)."""
         with self._lock:
             hit = [pg for pg in self._pgs.values()
                    if pg.state == PG_CREATED and node_id in pg.bundle_nodes]
@@ -636,7 +644,11 @@ class ClusterTaskManager:
                 try:
                     self._fail_rejoining_node(nid)
                 except Exception:
-                    pass
+                    # the node was already popped from _rejoining, so
+                    # this recovery will not re-run — never lose it
+                    # silently
+                    log.exception("rejoin-expiry recovery for %s failed",
+                                  nid)
 
     def _on_node_death(self, node_id: str, cause: str) -> None:
         with self._lock:
@@ -666,20 +678,7 @@ class ClusterTaskManager:
         # 4. PG bundles reserved on the dead node go back to pending and
         #    try to re-reserve elsewhere (GcsPlacementGroupManager
         #    rescheduling path).
-        with self._lock:
-            hit = [pg for pg in self._pgs.values()
-                   if pg.state == PG_CREATED and node_id in pg.bundle_nodes]
-        for pg in hit:
-            for idx, nid in enumerate(pg.bundle_nodes):
-                if nid is not None and nid != node_id:
-                    sched = self.scheduler_for_node(nid)
-                    if sched is not None:
-                        sched.release_bundle(pg.pg_id, idx)
-            pg.bundle_nodes = [None] * len(pg.bundles)
-            pg.state = PG_RESCHEDULING
-            if not self._try_reserve(pg):
-                with self._lock:
-                    self._pending_pgs.append(pg.pg_id)
+        self._reschedule_pgs_for(node_id)
 
     # -------------------------------------------------------- lifecycle
     def stats(self) -> dict:

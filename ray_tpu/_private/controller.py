@@ -81,6 +81,10 @@ class Controller:
         # (reference task_manager.h:269 ResubmitTask,
         # object_recovery_manager.h:41).
         self._lineage: dict[str, Any] = {}
+        # Nested-ref ownership (reference reference_count.cc contained
+        # refs): enclosing object id -> inner object ids it holds a
+        # count on; released when the enclosing object is deleted.
+        self._contained: dict[str, list[str]] = {}
         self._task_events: collections.deque = collections.deque(
             maxlen=task_event_capacity)
         from ray_tpu._private.pubsub import Publisher
@@ -207,6 +211,23 @@ class Controller:
                         orphaned.append(oid)
         return orphaned
 
+    # ---- nested-ref ownership ----
+    def register_contained(self, object_id: str, ids: list[str]) -> None:
+        """The sealed object `object_id` pickled refs to `ids` inside
+        it: hold a count on each until it is deleted. First registration
+        wins (a retried task reseals the same id with the same
+        contents)."""
+        with self._lock:
+            if not ids or object_id in self._contained:
+                return
+            self._contained[object_id] = list(ids)
+            for cid in ids:
+                self._refcounts[cid] = self._refcounts.get(cid, 0) + 1
+
+    def pop_contained(self, object_id: str) -> list[str]:
+        with self._lock:
+            return self._contained.pop(object_id, [])
+
     # ---- lineage (ResubmitTask parity) ----
     def record_lineage(self, spec: Any) -> None:
         with self._lock:
@@ -327,7 +348,7 @@ class Controller:
     # ---- persistence (GCS storage parity) ----
     _SNAPSHOT_TABLES = ("_kv", "_actors", "_named_actors", "_refcounts",
                         "_pins", "_pgs", "_nodes", "_locations",
-                        "_location_nbytes", "_lineage")
+                        "_location_nbytes", "_lineage", "_contained")
 
     def snapshot_state(self) -> bytes:
         """Snapshot every table into one blob (reference GCS tables are
@@ -336,6 +357,8 @@ class Controller:
         outside so the periodic snapshot never stalls the control
         plane."""
         import pickle
+
+        import cloudpickle
         with self._lock:
             state = {name: dict(getattr(self, name))
                      for name in self._SNAPSHOT_TABLES}
@@ -344,7 +367,10 @@ class Controller:
             state["_locations"] = {k: set(v)
                                    for k, v in state["_locations"].items()}
             state["_task_events"] = list(self._task_events)
-        return pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        # cloudpickle, not stdlib pickle: lineage/KV hold raw user task
+        # args (lambdas, closures) that the wire layer supports — a
+        # snapshot that crashes on them silently disables head FT
+        return cloudpickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
 
     def restore_state(self, blob: bytes) -> None:
         """Rehydrate from a snapshot (reference gcs_init_data.cc). Node
@@ -356,7 +382,7 @@ class Controller:
         with self._lock:
             current = dict(self._nodes)          # the new head's record(s)
             for name in self._SNAPSHOT_TABLES:
-                setattr(self, name, state[name])
+                setattr(self, name, state.get(name, {}))
             self._pins = collections.defaultdict(
                 int, state["_pins"])             # keep defaulting behavior
             self._nodes = {nid: r for nid, r in self._nodes.items()

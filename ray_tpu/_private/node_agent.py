@@ -122,6 +122,7 @@ class NodeAgent:
         import collections as _collections
         self._pending_sends: _collections.deque = _collections.deque(
             maxlen=10_000)
+        self._dropped_sends = 0
         self._labels = dict(labels or {})
         self._max_workers = max_workers
         self._resources = dict(resources)
@@ -227,43 +228,61 @@ class NodeAgent:
                 except Exception:
                     pass
                 continue
-            with self._reconnect_lock:
-                self._reconnecting = False
-                sends = list(self._pending_sends)
-                self._pending_sends.clear()
-                relays, self._pending_relays = self._pending_relays, []
-            sys.stderr.write(f"ray_tpu node_agent {self.node_id}: "
-                             f"rejoined head ({len(sends)} events + "
-                             f"{len(relays)} requests replayed)\n")
-            for i, m in enumerate(sends):
+            # Flush buffered state messages BEFORE opening the direct-
+            # send path (_reconnecting=False): a fresh DECREF overtaking
+            # a buffered ADDREF would let a refcount dip to zero under a
+            # live borrow.
+            flush_failed = False
+            flushed = 0
+            while True:
+                with self._reconnect_lock:
+                    if not self._pending_sends:
+                        self._reconnecting = False
+                        relays, self._pending_relays = (
+                            self._pending_relays, [])
+                        break
+                    batch = list(self._pending_sends)
+                    self._pending_sends.clear()
+                sent = 0
                 try:
-                    conn.send(m)
+                    for m in batch:
+                        conn.send(m)
+                        sent += 1
                 except protocol.ConnectionClosed:
                     # head bounced again mid-flush: keep the unsent tail
-                    # for the next rejoin instead of losing it
+                    # (order-preserving) and redial — still reconnecting
                     with self._reconnect_lock:
                         self._pending_sends.extendleft(
-                            reversed(sends[i:]))
+                            reversed(batch[sent:]))
+                    flush_failed = True
                     break
+                flushed += sent
+            if flush_failed:
+                continue
+            sys.stderr.write(f"ray_tpu node_agent {self.node_id}: "
+                             f"rejoined head ({flushed} events + "
+                             f"{len(relays)} requests replayed)\n")
             for wconn, msg in relays:
                 if not wconn.closed:
                     self._relay_to_head(wconn, msg)
             return
 
-    def _buffer_relay(self, conn, msg: dict) -> bool:
+    def _buffer_relay(self, conn, msg: dict, depth: int = 0) -> bool:
         """Queue a worker request for replay after the head comes back;
         False when reconnection is off/over (caller drops the relay).
         If the reconnect already finished (the failure came from the OLD
-        connection's futures), retry on the new connection instead."""
+        connection's futures), retry once on the new connection; a
+        second failure buffers unconditionally — retrying again would
+        recurse unboundedly against a flapping head."""
         if _CFG.agent_reconnect_window_s <= 0 or self._stop.is_set():
             return False
         with self._reconnect_lock:
-            if self._reconnecting:
+            if self._reconnecting or depth >= 1:
                 if len(self._pending_relays) >= 10_000:
                     return False
                 self._pending_relays.append((conn, msg))
                 return True
-        self._relay_to_head(conn, msg)
+        self._relay_to_head(conn, msg, _retry_depth=depth + 1)
         return True
 
     def shutdown(self) -> None:
@@ -323,14 +342,27 @@ class NodeAgent:
                     return
                 with self._reconnect_lock:
                     if self._reconnecting:
-                        self._pending_sends.append(msg)
+                        self._append_pending_send(msg)
                         return
                 # reconnect finished between our read of self.head and
                 # the failed send: retry once on the fresh connection
                 # (buffering here would strand the message until a
                 # future outage that may never come)
         with self._reconnect_lock:
-            self._pending_sends.append(msg)
+            self._append_pending_send(msg)
+
+    def _append_pending_send(self, msg: dict) -> None:
+        """Append under _reconnect_lock; a full buffer evicts the
+        OLDEST message — make that loss loud, it can strand a caller."""
+        if len(self._pending_sends) == self._pending_sends.maxlen:
+            self._dropped_sends += 1
+            if self._dropped_sends == 1 or self._dropped_sends % 1000 == 0:
+                sys.stderr.write(
+                    f"ray_tpu node_agent {self.node_id}: head-outage "
+                    f"buffer full; dropped {self._dropped_sends} oldest "
+                    f"state message(s) — task completions/refcounts may "
+                    f"be lost\n")
+        self._pending_sends.append(msg)
 
     def send_event(self, kind: str, **fields) -> None:
         self._send_to_head({"type": protocol.NODE_EVENT, "kind": kind,
@@ -414,7 +446,8 @@ class NodeAgent:
             stored: StoredObject = msg["stored"]
             self.store.put_stored(stored)
             self.send_event("object_at", object_id=stored.object_id,
-                            nbytes=stored.nbytes, addref=True)
+                            nbytes=stored.nbytes, addref=True,
+                            contained=list(stored.contained_ids))
             conn.reply(msg, ok=True)
         elif mtype == protocol.PULL_OBJECT:
             self._pull_server.handle_pull(conn, msg)
@@ -429,7 +462,8 @@ class NodeAgent:
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)
 
-    def _relay_to_head(self, conn: protocol.Connection, msg: dict) -> None:
+    def _relay_to_head(self, conn: protocol.Connection, msg: dict,
+                       _retry_depth: int = 0) -> None:
         """Forward a request to the head; pipe the reply back. The
         worker's rid is restored on the way back (the head sees our
         fresh rid)."""
@@ -447,7 +481,7 @@ class NodeAgent:
                 self.scheduler.worker_unblocked(wid)
             # head outage: park the request for replay after rejoin
             # (reference raylets queue GCS RPCs across GCS restarts)
-            self._buffer_relay(conn, msg)
+            self._buffer_relay(conn, msg, depth=_retry_depth)
             return
 
         def on_reply(fut) -> None:      # runs on head-conn reader thread
@@ -456,7 +490,7 @@ class NodeAgent:
             except protocol.ConnectionClosed:
                 if wid:
                     self.scheduler.worker_unblocked(wid)
-                if not self._buffer_relay(conn, msg):
+                if not self._buffer_relay(conn, msg, depth=_retry_depth):
                     try:
                         conn.reply({"rid": worker_rid}, timeout=True)
                     except protocol.ConnectionClosed:
@@ -490,7 +524,8 @@ class NodeAgent:
                     unlink_segment(name)
             else:
                 self.store.put_stored(stored)
-                located.append((stored.object_id, stored.nbytes))
+                located.append((stored.object_id, stored.nbytes,
+                                list(stored.contained_ids)))
         # release the ledger before telling the head (the head may
         # immediately route the next task here)
         if msg.get("is_actor_create"):

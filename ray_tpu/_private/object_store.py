@@ -69,6 +69,10 @@ class StoredObject:
     shm_sizes: list[int] = field(default_factory=list)
     buffer_order: list[str] = field(default_factory=list)  # "i" inline / "s" shm
     is_error: bool = False              # payload deserializes to an exception
+    # object ids of refs pickled INSIDE this value: the controller holds
+    # a count on each until this object is deleted (nested-ref ownership,
+    # reference reference_count.cc)
+    contained_ids: list[str] = field(default_factory=list)
 
     @property
     def nbytes(self) -> int:
@@ -172,8 +176,13 @@ def serialize(value: Any, object_id: Optional[str] = None,
               create_shm: bool = True) -> StoredObject:
     object_id = object_id or new_object_id()
     raw_buffers: list[pickle.PickleBuffer] = []
-    payload = cloudpickle.dumps(value, protocol=5,
-                                buffer_callback=raw_buffers.append)
+    from ray_tpu._private.refs import _capture
+    _capture.ids = contained = []
+    try:
+        payload = cloudpickle.dumps(value, protocol=5,
+                                    buffer_callback=raw_buffers.append)
+    finally:
+        _capture.ids = None
     inline: list[bytes] = []
     shm_names: list[str] = []
     shm_sizes: list[int] = []
@@ -191,7 +200,7 @@ def serialize(value: Any, object_id: Optional[str] = None,
             order.append("s")
     is_error = isinstance(value, BaseException)
     return StoredObject(object_id, payload, inline, shm_names, shm_sizes,
-                        order, is_error)
+                        order, is_error, contained_ids=contained)
 
 
 def deserialize(obj: StoredObject) -> Any:
@@ -346,7 +355,8 @@ class LocalStore:
                     si += 1
             with open(path, "wb") as f:
                 pickle.dump({"payload": obj.payload, "buffers": buffers,
-                             "is_error": obj.is_error}, f,
+                             "is_error": obj.is_error,
+                             "contained": obj.contained_ids}, f,
                             protocol=pickle.HIGHEST_PROTOCOL)
             for name in obj.shm_names:
                 unlink_segment(name)
@@ -403,7 +413,8 @@ class LocalStore:
         obj = StoredObject(oid, blob["payload"],
                            inline_buffers=list(blob["buffers"]),
                            buffer_order=["i"] * len(blob["buffers"]),
-                           is_error=blob["is_error"])
+                           is_error=blob["is_error"],
+                           contained_ids=list(blob.get("contained", ())))
         with self._cv:
             self._restoring.discard(oid)
             if oid in self._restore_cancelled:   # deleted mid-restore

@@ -48,7 +48,8 @@ def materialize(obj: StoredObject) -> StoredObject:
             si += 1
         order.append("i")
     return StoredObject(obj.object_id, obj.payload, inline, [], [],
-                        order, obj.is_error)
+                        order, obj.is_error,
+                        contained_ids=list(obj.contained_ids))
 
 
 def _encode(obj: StoredObject) -> bytes:

@@ -1,19 +1,39 @@
 """ObjectRef: the distributed future handle.
 
 Parity: reference python/ray/_raylet.pyx ObjectRef + C++ reference counting
-(src/ray/core_worker/reference_count.cc). v0 protocol is centralized: the
-driver's controller owns all refcounts. Driver-held refs inc/dec; refs
-deserialized inside workers are *borrows* that do not decrement (the
-spec-pin held by the submitting side outlives the borrow), a simplification
-of the reference's borrower protocol (reference reference_count.h:115-117)
-that is safe because borrows cannot outlive the task that carries them
-unless returned — and returned refs re-enter driver tracking.
+(src/ray/core_worker/reference_count.cc). The protocol is centralized (the
+head's controller owns all refcounts) with a real borrower protocol
+(reference reference_count.h:64,115-117 borrower registration +
+WaitForRefRemoved):
+
+- Deserializing a ref ANYWHERE registers a borrow (ADDREF) and the
+  borrowing process sends a deferred DECREF when its copy is collected —
+  so an actor may store a ref it received inside an argument past the
+  carrying task and the object stays alive until the actor drops it.
+- The submit-time pin covers the window before the borrow registers:
+  the executing worker's ADDREF and the task's TASK_DONE (which releases
+  the pin) travel the same FIFO connection, so the count can never dip
+  to zero between them.
+- Objects CONTAINING refs (a put() of a list of refs, a task returning
+  refs) register containment at seal time: the enclosing object holds a
+  count on each inner ref, released when the enclosing object is
+  deleted (reference reference_count.cc nested-ref ownership).
+
+Known conservatism: a borrowing worker that is SIGKILLed never sends its
+deferred DECREF, so its borrows leak until session shutdown (the
+reference reclaims these via per-borrower death cleanup).
 """
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from ray_tpu._private import context as _context
+
+# Serialize-time containment capture: object_store.serialize() installs a
+# collector here; ObjectRef.__reduce__ records every ref pickled into the
+# enclosing object so the store can register containment at seal.
+_capture = threading.local()
 
 
 class ObjectRef:
@@ -40,7 +60,9 @@ class ObjectRef:
         return hash(self._id)
 
     def __reduce__(self):
-        # Cross-process transfer: reconstruct as a borrowed (non-counting) ref.
+        ids = getattr(_capture, "ids", None)
+        if ids is not None:
+            ids.append(self._id)
         return (_reconstruct_borrowed, (self._id,))
 
     def __del__(self):
@@ -78,6 +100,18 @@ class ObjectRef:
 
 
 def _reconstruct_borrowed(object_id: str) -> ObjectRef:
+    """Deserialization endpoint: register a borrow with the owner (the
+    head) so the ref counts while this process holds it; the ref's
+    __del__ sends the matching deferred decref. Falls back to a
+    non-counting ref in processes without a runtime context (e.g. a
+    relaying node agent)."""
+    ctx = _context.maybe_ctx()
+    if ctx is not None:
+        try:
+            ctx.addref(object_id)
+            return ObjectRef(object_id, owned=True)
+        except Exception:
+            pass
     return ObjectRef(object_id, owned=False)
 
 

@@ -408,6 +408,8 @@ class Runtime(_context.BaseContext):
             self._on_wait(conn, msg)
         elif mtype == protocol.PUT_OBJECT:
             stored: StoredObject = msg["stored"]
+            self.controller.register_contained(stored.object_id,
+                                               stored.contained_ids)
             self.store.put_stored(stored)
             self.controller.addref(stored.object_id)
             conn.reply(msg, ok=True)
@@ -467,11 +469,13 @@ class Runtime(_context.BaseContext):
     def _on_task_done(self, conn: protocol.Connection, msg: dict) -> None:
         results: list[StoredObject] = msg.get("results", [])
         for stored in results:
+            self.controller.register_contained(stored.object_id,
+                                               stored.contained_ids)
             self.store.put_stored(stored)
             # Fire-and-forget results whose refs were already dropped must
             # be evicted here, or they accumulate until shutdown.
             if self.controller.unreferenced(stored.object_id):
-                self.store.delete(stored.object_id)
+                self._delete_everywhere(stored.object_id)
         worker_id = conn.meta.get("worker_id", "")
         wsched = self._scheduler_for_worker(worker_id)
         if msg.get("is_actor_create"):
@@ -557,6 +561,8 @@ class Runtime(_context.BaseContext):
                 proxy.on_finished(proxy._key(msg["spec"]))
             self.on_unplaceable(msg["spec"], msg["reason"])
         elif kind == "object_at":
+            self.controller.register_contained(
+                msg["object_id"], msg.get("contained", []))
             if msg.get("addref"):
                 self.controller.addref(msg["object_id"])
             self.controller.add_location(msg["object_id"], msg["node_id"],
@@ -584,10 +590,13 @@ class Runtime(_context.BaseContext):
         node_id = msg["node_id"]
         proxy = self._proxy_for(node_id)
         for stored in msg.get("inline", []):
+            self.controller.register_contained(stored.object_id,
+                                               stored.contained_ids)
             self.store.put_stored(stored)
             if self.controller.unreferenced(stored.object_id):
-                self.store.delete(stored.object_id)
-        for oid, nbytes in msg.get("located", []):
+                self._delete_everywhere(stored.object_id)
+        for oid, nbytes, contained in msg.get("located", []):
+            self.controller.register_contained(oid, contained)
             self.controller.add_location(oid, node_id, nbytes)
             self.waiters.notify(oid)
         worker_id = msg.get("worker_id", "")
@@ -856,8 +865,12 @@ class Runtime(_context.BaseContext):
         return None
 
     def _delete_everywhere(self, oid: str) -> None:
-        """Deletion fan-out: local store + every agent holding a copy."""
+        """Deletion fan-out: local store + every agent holding a copy.
+        Releases the counts this object held on refs pickled inside it
+        (nested-ref ownership), cascading deletes as counts hit zero."""
         self.store.delete(oid)
+        for cid in self.controller.pop_contained(oid):
+            self.decref(cid)
         locs = self.controller.locations(oid)
         for nid in locs:
             rec = self.cluster.get_node(nid)
@@ -944,9 +957,13 @@ class Runtime(_context.BaseContext):
 
     # ================= BaseContext API (driver) =================
     def put(self, value: Any) -> ObjectRef:
-        oid = self.store.put(value)
-        self.controller.addref(oid)
-        return ObjectRef(oid)
+        from ray_tpu._private.object_store import serialize
+        stored = serialize(value)
+        self.controller.register_contained(stored.object_id,
+                                           stored.contained_ids)
+        self.store.put_stored(stored)
+        self.controller.addref(stored.object_id)
+        return ObjectRef(stored.object_id)
 
     def get_objects(self, object_ids: list[str],
                     timeout: Optional[float]) -> list[Any]:

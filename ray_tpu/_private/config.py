@@ -98,6 +98,12 @@ _define("agent_reconnect_window_s", 60.0,
         "How long a node agent keeps redialing a lost head before "
         "giving up and shutting down (reference raylets tolerate GCS "
         "downtime); 0 restores exit-on-disconnect.")
+_define("worker_pipeline_depth", 2,
+        "Tasks dispatched to one worker before its previous task "
+        "completes (the worker executes FIFO). Depth 2 overlaps the "
+        "completion round-trip with execution — the reference's "
+        "worker-lease pipelining — roughly doubling small-task drain "
+        "throughput. 1 restores strict one-at-a-time dispatch.")
 _define("node_rejoin_grace_s", 20.0,
         "After a head restart, how long rehydrated nodes have to "
         "re-register before they are declared dead and their actors/"

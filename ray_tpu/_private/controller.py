@@ -212,17 +212,26 @@ class Controller:
         return orphaned
 
     # ---- nested-ref ownership ----
-    def register_contained(self, object_id: str, ids: list[str]) -> None:
+    def register_contained(self, object_id: str,
+                           ids: list[str]) -> list[str]:
         """The sealed object `object_id` pickled refs to `ids` inside
-        it: hold a count on each until it is deleted. First registration
-        wins (a retried task reseals the same id with the same
-        contents)."""
+        it: hold a count on each until it is deleted. A reseal with
+        DIFFERENT contents (lineage resubmission creates fresh inner
+        ids) refreshes the registration; the previously-held ids are
+        RETURNED and the caller must decref them through the full
+        deletion path."""
+        new = list(ids)
         with self._lock:
-            if not ids or object_id in self._contained:
-                return
-            self._contained[object_id] = list(ids)
-            for cid in ids:
-                self._refcounts[cid] = self._refcounts.get(cid, 0) + 1
+            old = self._contained.get(object_id)
+            if old == new or (old is None and not new):
+                return []
+            if new:
+                self._contained[object_id] = new
+                for cid in new:
+                    self._refcounts[cid] = self._refcounts.get(cid, 0) + 1
+            else:
+                self._contained.pop(object_id, None)
+            return list(old or ())
 
     def pop_contained(self, object_id: str) -> list[str]:
         with self._lock:

@@ -251,9 +251,26 @@ class NodeAgent:
                 except protocol.ConnectionClosed:
                     # head bounced again mid-flush: keep the unsent tail
                     # (order-preserving) and redial — still reconnecting
+                    tail = batch[sent:]
                     with self._reconnect_lock:
-                        self._pending_sends.extendleft(
-                            reversed(batch[sent:]))
+                        space = (self._pending_sends.maxlen
+                                 - len(self._pending_sends))
+                        overflow = len(tail) - space
+                        if overflow > 0:
+                            # evict the NEWEST buffered messages (they
+                            # sort after the tail anyway) — loudly, like
+                            # _append_pending_send
+                            self._dropped_sends += overflow
+                            sys.stderr.write(
+                                f"ray_tpu node_agent {self.node_id}: "
+                                f"head-outage buffer overflow during "
+                                f"re-flush; dropped {overflow} newest "
+                                f"state message(s)\n")
+                            for _ in range(min(
+                                    overflow,
+                                    len(self._pending_sends))):
+                                self._pending_sends.pop()
+                        self._pending_sends.extendleft(reversed(tail))
                     flush_failed = True
                     break
                 flushed += sent
@@ -331,8 +348,18 @@ class NodeAgent:
 
     def _send_to_head(self, msg: dict) -> None:
         """Fire-and-forget send that buffers during a head outage (the
-        reconnect flush replays it) instead of dropping state."""
+        reconnect flush replays it) instead of dropping state. The
+        reconnecting check comes BEFORE the direct send: once the new
+        connection is live but the buffer has not drained, a direct send
+        would overtake buffered messages (a fresh DECREF beating a
+        buffered ADDREF lets a refcount dip to zero under a live
+        borrow)."""
         for _attempt in range(2):
+            if _CFG.agent_reconnect_window_s > 0:
+                with self._reconnect_lock:
+                    if self._reconnecting:
+                        self._append_pending_send(msg)
+                        return
             try:
                 self.head.send(msg)
                 return
@@ -340,14 +367,10 @@ class NodeAgent:
                 if (_CFG.agent_reconnect_window_s <= 0
                         or self._stop.is_set()):
                     return
-                with self._reconnect_lock:
-                    if self._reconnecting:
-                        self._append_pending_send(msg)
-                        return
-                # reconnect finished between our read of self.head and
-                # the failed send: retry once on the fresh connection
-                # (buffering here would strand the message until a
-                # future outage that may never come)
+                # loop: either the outage was just detected (branch
+                # above buffers next pass) or the reconnect finished
+                # between our read of self.head and the failed send —
+                # retry once on the fresh connection
         with self._reconnect_lock:
             self._append_pending_send(msg)
 
@@ -421,15 +444,16 @@ class NodeAgent:
         wid = conn.meta.get("worker_id")
         if wid is None or self._stop.is_set():
             return
-        task, actor_id = self.scheduler.on_worker_lost(wid)
-        if task is not None:
+        tasks, actor_id = self.scheduler.on_worker_lost(wid)
+        if tasks:
             # the dead worker may have sealed result shm on THIS host
             # without delivering TASK_DONE — reap locally (the head's
             # reap only covers its own /dev/shm)
             from ray_tpu._private.object_store import reap_object_segments
-            for oid in task.return_ids:
-                reap_object_segments(oid)
-        self.send_event("worker_lost", worker_id=wid, task=task,
+            for task in tasks:
+                for oid in task.return_ids:
+                    reap_object_segments(oid)
+        self.send_event("worker_lost", worker_id=wid, tasks=tasks,
                         actor_id=actor_id)
 
     def _handle_local_msg(self, conn: protocol.Connection,
@@ -533,7 +557,7 @@ class NodeAgent:
         elif msg.get("is_actor_task"):
             pass                       # actor keeps its resources
         else:
-            self.scheduler.task_finished(worker_id)
+            self.scheduler.task_finished(worker_id, msg.get("task_id"))
         ctrl = {k: v for k, v in msg.items()
                 if k not in ("results", "rid", "type")}
         self._send_to_head({"type": protocol.NODE_TASK_DONE,

@@ -25,6 +25,7 @@ reference reclaims these via per-borrower death cleanup).
 """
 from __future__ import annotations
 
+import collections
 import threading
 from typing import Optional
 
@@ -34,6 +35,42 @@ from ray_tpu._private import context as _context
 # collector here; ObjectRef.__reduce__ records every ref pickled into the
 # enclosing object so the store can register containment at seal.
 _capture = threading.local()
+
+# Deferred decrefs: __del__ may fire during GC at ANY allocation point —
+# including while the current thread holds a non-reentrant lock that the
+# decref's deletion path needs (store lock, connection send lock), a
+# guaranteed self-deadlock. So __del__ only appends the id here; a
+# dedicated flusher thread performs the actual decref (the reference
+# defers destructor work to the core worker's io service the same way).
+_deferred: collections.deque = collections.deque()
+_flush_wake = threading.Event()
+_flusher_started = False
+
+
+def _ensure_flusher() -> None:
+    global _flusher_started
+    if _flusher_started:
+        return
+    _flusher_started = True
+    threading.Thread(target=_flush_loop, name="rtpu-decref",
+                     daemon=True).start()
+
+
+def _flush_loop() -> None:
+    while True:
+        try:
+            oid = _deferred.popleft()
+        except IndexError:
+            _flush_wake.wait(0.2)
+            _flush_wake.clear()
+            continue
+        ctx = _context.maybe_ctx()
+        if ctx is None:
+            continue
+        try:
+            ctx.decref(oid)
+        except Exception:
+            pass
 
 
 class ObjectRef:
@@ -66,13 +103,12 @@ class ObjectRef:
         return (_reconstruct_borrowed, (self._id,))
 
     def __del__(self):
-        if self._owned:
-            ctx = _context.maybe_ctx()
-            if ctx is not None:
-                try:
-                    ctx.decref(self._id)
-                except Exception:
-                    pass
+        if self._owned and _context.maybe_ctx() is not None:
+            # never decref synchronously from a destructor (see
+            # _deferred above); deque.append is GC-reentrancy-safe
+            _deferred.append(self._id)
+            _flush_wake.set()
+            _ensure_flusher()
 
     # `await ref` support inside async actors.
     def __await__(self):

@@ -273,8 +273,8 @@ class Runtime(_context.BaseContext):
         sched = self._scheduler_for_worker(wid)
         if sched is None:
             return
-        task, actor_id = sched.on_worker_lost(wid)
-        if task is not None:
+        tasks, actor_id = sched.on_worker_lost(wid)
+        for task in tasks:
             self._recover_task(task)
         if actor_id is not None:
             self._recover_actor(actor_id)
@@ -380,6 +380,13 @@ class Runtime(_context.BaseContext):
             if self.controller.unpin(oid):
                 self._delete_everywhere(oid)
 
+    def _seal_contained(self, object_id: str, ids: list[str]) -> None:
+        """Register nested-ref containment for a sealed object; inner
+        refs released by a refresh (lineage reseal with fresh ids) go
+        through the full deletion path."""
+        for cid in self.controller.register_contained(object_id, ids):
+            self.decref(cid)
+
     # ================= scheduler callbacks =================
     def on_task_dispatched(self, spec: TaskSpec, worker_id: str) -> None:
         self.controller.record_task_event(
@@ -408,8 +415,7 @@ class Runtime(_context.BaseContext):
             self._on_wait(conn, msg)
         elif mtype == protocol.PUT_OBJECT:
             stored: StoredObject = msg["stored"]
-            self.controller.register_contained(stored.object_id,
-                                               stored.contained_ids)
+            self._seal_contained(stored.object_id, stored.contained_ids)
             self.store.put_stored(stored)
             self.controller.addref(stored.object_id)
             conn.reply(msg, ok=True)
@@ -469,8 +475,7 @@ class Runtime(_context.BaseContext):
     def _on_task_done(self, conn: protocol.Connection, msg: dict) -> None:
         results: list[StoredObject] = msg.get("results", [])
         for stored in results:
-            self.controller.register_contained(stored.object_id,
-                                               stored.contained_ids)
+            self._seal_contained(stored.object_id, stored.contained_ids)
             self.store.put_stored(stored)
             # Fire-and-forget results whose refs were already dropped must
             # be evicted here, or they accumulate until shutdown.
@@ -514,7 +519,7 @@ class Runtime(_context.BaseContext):
             self.controller.record_task_event(task_id, msg.get("name", ""),
                                               state, worker_id=worker_id)
             return
-        spec = (wsched.task_finished(worker_id)
+        spec = (wsched.task_finished(worker_id, task_id)
                 if wsched is not None else None)
         if spec is not None:
             self._unpin(spec.pinned_refs)
@@ -546,8 +551,7 @@ class Runtime(_context.BaseContext):
         elif kind == "worker_lost":
             if proxy is not None:
                 proxy.on_worker_lost(msg["worker_id"])
-            task = msg.get("task")
-            if task is not None:
+            for task in msg.get("tasks", ()):
                 if proxy is not None:
                     proxy.on_finished(task.task_id)
                 self._recover_task(task)
@@ -561,8 +565,8 @@ class Runtime(_context.BaseContext):
                 proxy.on_finished(proxy._key(msg["spec"]))
             self.on_unplaceable(msg["spec"], msg["reason"])
         elif kind == "object_at":
-            self.controller.register_contained(
-                msg["object_id"], msg.get("contained", []))
+            self._seal_contained(msg["object_id"],
+                                 msg.get("contained", []))
             if msg.get("addref"):
                 self.controller.addref(msg["object_id"])
             self.controller.add_location(msg["object_id"], msg["node_id"],
@@ -590,13 +594,12 @@ class Runtime(_context.BaseContext):
         node_id = msg["node_id"]
         proxy = self._proxy_for(node_id)
         for stored in msg.get("inline", []):
-            self.controller.register_contained(stored.object_id,
-                                               stored.contained_ids)
+            self._seal_contained(stored.object_id, stored.contained_ids)
             self.store.put_stored(stored)
             if self.controller.unreferenced(stored.object_id):
                 self._delete_everywhere(stored.object_id)
         for oid, nbytes, contained in msg.get("located", []):
-            self.controller.register_contained(oid, contained)
+            self._seal_contained(oid, contained)
             self.controller.add_location(oid, node_id, nbytes)
             self.waiters.notify(oid)
         worker_id = msg.get("worker_id", "")
@@ -959,8 +962,7 @@ class Runtime(_context.BaseContext):
     def put(self, value: Any) -> ObjectRef:
         from ray_tpu._private.object_store import serialize
         stored = serialize(value)
-        self.controller.register_contained(stored.object_id,
-                                           stored.contained_ids)
+        self._seal_contained(stored.object_id, stored.contained_ids)
         self.store.put_stored(stored)
         self.controller.addref(stored.object_id)
         return ObjectRef(stored.object_id)

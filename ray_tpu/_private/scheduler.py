@@ -46,8 +46,16 @@ class WorkerRec:
     proc: Optional[subprocess.Popen] = None
     conn: Optional[protocol.Connection] = None
     state: str = STARTING
-    task: Optional[TaskSpec] = None
+    # In-flight normal tasks in dispatch (= execution) order; the worker
+    # runs them FIFO on its single exec thread, so pipelining depth>1
+    # overlaps the TASK_DONE round-trip with the next task's execution
+    # (reference worker-lease pipelining).
+    tasks: "dict[str, TaskSpec]" = field(default_factory=dict)
+    # task_id -> (need, pg_key): per-task resource charge so completions
+    # release exactly their own share.
+    task_res: dict = field(default_factory=dict)
     actor_id: Optional[str] = None
+    # actor-lifetime resources (ACTOR workers only)
     acquired: dict[str, float] = field(default_factory=dict)
     # (pg_id, bundle_index) whose ledger `acquired` was charged against,
     # or None when charged against the node's free pool.
@@ -139,6 +147,8 @@ class Scheduler:
             led = self._bundles.pop((pg_id, index), None)
             if led is not None:
                 release(self.avail, led["avail"])
+                if self._running and self._pending:
+                    self._try_dispatch_locked(self._INLINE_SCAN_LIMIT)
             self._cv.notify_all()
 
     def _bundle_for(self, spec) -> Optional[tuple]:
@@ -180,9 +190,18 @@ class Scheduler:
 
     def enqueue(self, spec) -> None:
         with self._cv:
+            was_empty = not self._pending
             self._pending.append(spec)
             self._queued_at[id(spec)] = time.monotonic()
             self._demand_add(spec)
+            # Inline dispatch on the submitting thread — saves a
+            # scheduler-loop thread handoff (the dominant sync-RTT cost
+            # on 1 core) — but ONLY when the queue was empty: with a
+            # backlog, this spec cannot jump the queue, and a per-
+            # enqueue scan makes bulk submission O(n^2). Completions
+            # drive dispatch while a backlog exists.
+            if self._running and was_empty:
+                self._try_dispatch_locked(self._INLINE_SCAN_LIMIT)
             self._cv.notify_all()
 
     def enqueue_front(self, spec) -> None:
@@ -190,6 +209,8 @@ class Scheduler:
             self._pending.appendleft(spec)
             self._queued_at[id(spec)] = time.monotonic()
             self._demand_add(spec)
+            if self._running:
+                self._try_dispatch_locked(self._INLINE_SCAN_LIMIT)
             self._cv.notify_all()
 
     def cancel_pending(self, task_id: str) -> Optional[TaskSpec]:
@@ -237,22 +258,43 @@ class Scheduler:
             self._cv.notify_all()
 
     def on_worker_lost(self, worker_id: str):
-        """Returns (task, actor_id) that were running there, for recovery."""
+        """Returns (in-flight tasks, actor_id) for recovery."""
         with self._cv:
             rec = self._workers.get(worker_id)
             if rec is None or rec.state == DEAD:
-                return None, None
+                return [], None
             if rec.state == STARTING:
                 self._spawning = max(0, self._spawning - 1)
-            task, actor_id = rec.task, rec.actor_id
-            if rec.acquired and rec.blocked_depth == 0:
-                release(self._ledger(rec), rec.acquired)
+            tasks, actor_id = list(rec.tasks.values()), rec.actor_id
+            if rec.blocked_depth == 0:
+                self._release_worker_res_locked(rec)
             rec.state = DEAD
-            rec.task = None
+            rec.tasks.clear()
+            rec.task_res.clear()
             rec.acquired = {}
             rec.pg_key = None
             self._cv.notify_all()
-            return task, actor_id
+            return tasks, actor_id
+
+    # ---- aggregate per-worker resource charge (blocked release etc.)
+    def _ledger_for_key(self, pg_key) -> dict[str, float]:
+        if pg_key is not None:
+            led = self._bundles.get(pg_key)
+            if led is not None:
+                return led["avail"]
+        return self.avail
+
+    def _release_worker_res_locked(self, rec: WorkerRec) -> None:
+        if rec.acquired:
+            release(self._ledger(rec), rec.acquired)
+        for need, pg_key in rec.task_res.values():
+            release(self._ledger_for_key(pg_key), need)
+
+    def _acquire_worker_res_locked(self, rec: WorkerRec) -> None:
+        if rec.acquired:
+            acquire(self._ledger(rec), rec.acquired)
+        for need, pg_key in rec.task_res.values():
+            acquire(self._ledger_for_key(pg_key), need)
 
     def heartbeat_snapshot(self) -> dict:
         """Consistent copies of the ledgers a node heartbeat reports —
@@ -268,12 +310,12 @@ class Scheduler:
             }
 
     def worker_running_task(self, task_id: str):
-        """(worker_id, spec) currently executing task_id, or None."""
+        """(worker_id, spec) currently executing (or queued in) the
+        worker that holds task_id, or None."""
         with self._lock:
             for rec in self._workers.values():
-                if (rec.state == BUSY and rec.task is not None
-                        and rec.task.task_id == task_id):
-                    return rec.worker_id, rec.task
+                if rec.state == BUSY and task_id in rec.tasks:
+                    return rec.worker_id, rec.tasks[task_id]
         return None
 
     def cancel_running(self, worker_id: str, task_id: str) -> bool:
@@ -311,8 +353,11 @@ class Scheduler:
             if rec is None:
                 return
             rec.blocked_depth += 1
-            if rec.blocked_depth == 1 and rec.acquired:
-                release(self._ledger(rec), rec.acquired)
+            if rec.blocked_depth == 1 and (rec.acquired or rec.task_res):
+                self._release_worker_res_locked(rec)
+                # freed resources: start queued work immediately
+                if self._running and self._pending:
+                    self._try_dispatch_locked(self._INLINE_SCAN_LIMIT)
             self._cv.notify_all()
 
     def worker_unblocked(self, worker_id: str) -> None:
@@ -321,35 +366,38 @@ class Scheduler:
             if rec is None:
                 return
             rec.blocked_depth = max(0, rec.blocked_depth - 1)
-            if rec.blocked_depth == 0 and rec.acquired and rec.state != DEAD:
+            if (rec.blocked_depth == 0 and rec.state != DEAD
+                    and (rec.acquired or rec.task_res)):
                 # Re-acquire (may oversubscribe transiently, as the reference
                 # raylet does when a blocked worker resumes).
-                acquire(self._ledger(rec), rec.acquired)
+                self._acquire_worker_res_locked(rec)
 
     # ---- completion ----
-    def task_finished(self, worker_id: str) -> Optional[TaskSpec]:
+    def task_finished(self, worker_id: str,
+                      task_id: Optional[str] = None) -> Optional[TaskSpec]:
         with self._cv:
             rec = self._workers.get(worker_id)
             if rec is None:
                 return None
-            task = rec.task
-            rec.task = None
-            if rec.state == BUSY:
-                if rec.blocked_depth == 0 and rec.acquired:
-                    release(self._ledger(rec), rec.acquired)
-                rec.acquired = {}
-                rec.pg_key = None
+            if task_id is None and rec.tasks:   # legacy callers: FIFO
+                task_id = next(iter(rec.tasks))
+            task = rec.tasks.pop(task_id, None) if task_id else None
+            need_pg = rec.task_res.pop(task_id, None) if task_id else None
+            if need_pg is not None and rec.blocked_depth == 0:
+                release(self._ledger_for_key(need_pg[1]), need_pg[0])
+            if rec.state == BUSY and not rec.tasks:
                 rec.state = IDLE
-            elif rec.state == ACTOR:
-                pass                      # actor keeps its resources
+            # dispatch the next queued spec NOW, on the completion
+            # reader thread, instead of bouncing through the loop thread
+            if self._running and self._pending:
+                self._try_dispatch_locked(self._INLINE_SCAN_LIMIT)
             self._cv.notify_all()
             return task
 
     def actor_ready(self, worker_id: str) -> None:
         with self._cv:
-            rec = self._workers.get(worker_id)
-            if rec is not None:
-                rec.task = None
+            if self._running and self._pending:
+                self._try_dispatch_locked(self._INLINE_SCAN_LIMIT)
             self._cv.notify_all()
 
     # ---- dispatch loop ----
@@ -369,16 +417,28 @@ class Scheduler:
 
     def _pick_worker(self, spec=None) -> Optional[WorkerRec]:
         """Idle worker, preferring one whose last applied runtime env
-        matches the spec's (runtime-env-keyed reuse)."""
+        matches the spec's (runtime-env-keyed reuse). For normal tasks,
+        falls back to a BUSY same-env worker with pipeline headroom —
+        the worker executes FIFO, so the queued task starts the instant
+        the previous one finishes, no round-trip bubble."""
         want = "" if spec is None else self._spec_env_hash(spec)
+        idle_only = isinstance(spec, ActorSpec)
+        depth = _CFG.worker_pipeline_depth
         fallback = None
+        pipelined = None
         for rec in self._workers.values():
-            if rec.state == IDLE and rec.conn is not None:
+            if rec.conn is None:
+                continue
+            if rec.state == IDLE:
                 if rec.env_hash == want:
                     return rec
                 if fallback is None:
                     fallback = rec
-        return fallback
+            elif (not idle_only and pipelined is None and depth > 1
+                    and rec.state == BUSY and rec.blocked_depth == 0
+                    and len(rec.tasks) < depth and rec.env_hash == want):
+                pipelined = rec
+        return fallback or pipelined
 
     def _alive_count(self) -> int:
         return sum(1 for r in self._workers.values() if r.state != DEAD)
@@ -459,13 +519,17 @@ class Scheduler:
         """The availability pool `rec.acquired` was charged against. A
         bundle released while its workers still run falls back to the
         node pool (the bundle's ledger is gone)."""
-        if rec.pg_key is not None:
-            led = self._bundles.get(rec.pg_key)
-            if led is not None:
-                return led["avail"]
-        return self.avail
+        return self._ledger_for_key(rec.pg_key)
 
     def _loop(self) -> None:
+        """Periodic dispatch backstop. Inline dispatch (enqueue/
+        completion/unblock paths) handles the hot path, so this thread
+        deliberately does NOT wake on queue notifies — per-event wakeups
+        made it re-sweep the whole backlog on every task (O(n^2) drain,
+        ~600us of head CPU per task). It ticks on a fixed cadence with a
+        bounded sweep, and runs the unbounded convergence sweep (deep
+        queues, odd resource shapes) every ~2s."""
+        last_full = 0.0
         while True:
             with self._cv:
                 if not self._running:
@@ -474,9 +538,13 @@ class Scheduler:
                     self._cluster.heartbeat(self.node_id)
                 self._reap_failed_spawns_locked()
                 self._spill_aged_locked()
-                dispatched = self._try_dispatch_locked()
-                if not dispatched:
-                    self._cv.wait(timeout=0.25)
+                now = time.monotonic()
+                if now - last_full >= 2.0:
+                    self._try_dispatch_locked()
+                    last_full = now
+                else:
+                    self._try_dispatch_locked(512)
+            time.sleep(0.05)
 
     def _spill_aged_locked(self) -> None:
         """Spillback (stage-1 redirect): hand unconstrained tasks that
@@ -549,14 +617,27 @@ class Scheduler:
                     except Exception:
                         pass
 
-    def _try_dispatch_locked(self) -> bool:
+    # Inline (event-triggered) dispatches scan at most this many queued
+    # specs: one enqueue/completion can enable at most ~one dispatch at
+    # the queue head, and an unbounded scan over a long queue of
+    # non-fitting specs made hot-path submission O(n^2). The loop
+    # thread's periodic full sweep remains the convergence backstop.
+    _INLINE_SCAN_LIMIT = 64
+
+    def _try_dispatch_locked(self, scan_limit: Optional[int] = None
+                             ) -> bool:
         """One sweep over the queue, dispatching EVERY spec a free
         worker + resources allow (a per-dispatch rescan made draining n
         queued tasks O(n^2); reference LocalTaskManager::
         DispatchScheduledTasksToWorkers drains its queue per wake the
-        same way)."""
+        same way). `scan_limit` bounds the sweep for inline callers."""
         dispatched = 0
-        for spec in list(self._pending):
+        if scan_limit is None:
+            snapshot = list(self._pending)
+        else:
+            import itertools as _it
+            snapshot = list(_it.islice(self._pending, scan_limit))
+        for spec in snapshot:
             if id(spec) not in self._queued_at:
                 continue              # removed while the lock was dropped
             need = self._effective_need(spec)
@@ -597,10 +678,10 @@ class Scheduler:
             self._queued_at.pop(id(spec), None)
             self._demand_sub(spec)
             acquire(pool, need)
-            worker.acquired = need
-            worker.pg_key = pg_key
             worker.env_hash = self._spec_env_hash(spec)
             if isinstance(spec, ActorSpec):
+                worker.acquired = need
+                worker.pg_key = pg_key
                 worker.state = ACTOR
                 worker.actor_id = spec.actor_id
                 self._rt.on_actor_dispatched(spec, worker.worker_id)
@@ -608,7 +689,8 @@ class Scheduler:
                                   "spec": spec})
             else:
                 worker.state = BUSY
-                worker.task = spec
+                worker.tasks[spec.task_id] = spec
+                worker.task_res[spec.task_id] = (need, pg_key)
                 self._rt.on_task_dispatched(spec, worker.worker_id)
                 worker.conn.send({"type": protocol.TASK, "spec": spec})
             dispatched += 1
@@ -736,8 +818,8 @@ class Scheduler:
         for rec in workers:
             if rec.state == DEAD:
                 continue
-            if rec.task is not None and isinstance(rec.task, TaskSpec):
-                running_tasks.append(rec.task)
+            running_tasks.extend(t for t in rec.tasks.values()
+                                 if isinstance(t, TaskSpec))
             if rec.actor_id is not None:
                 actor_ids.append(rec.actor_id)
             rec.state = DEAD

@@ -45,13 +45,17 @@ _capture = threading.local()
 _deferred: collections.deque = collections.deque()
 _flush_wake = threading.Event()
 _flusher_started = False
+_flusher_lock = threading.Lock()
 
 
 def _ensure_flusher() -> None:
     global _flusher_started
     if _flusher_started:
         return
-    _flusher_started = True
+    with _flusher_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
     threading.Thread(target=_flush_loop, name="rtpu-decref",
                      daemon=True).start()
 

@@ -442,8 +442,31 @@ class Runtime(_context.BaseContext):
         elif mtype == protocol.ADDREF:
             self.controller.addref(msg["object_id"])
         elif mtype == protocol.STATE_OP:
-            conn.reply(msg, value=self.state_op(msg["op"], **msg.get(
-                "kwargs", {})))
+            kwargs = msg.get("kwargs", {})
+            if (msg["op"] == "pubsub_poll"
+                    and kwargs.get("timeout")):
+                # long-poll parks in the publisher's waiter list and
+                # replies on publish/expiry — NEVER blocks this reader
+                # thread (it carries the subscriber's other traffic)
+                def _reply(msgs, cursor, conn=conn, msg=msg):
+                    try:
+                        conn.reply(msg, value=(msgs, cursor))
+                    except protocol.ConnectionClosed:
+                        pass
+                from ray_tpu._private.pubsub import StaleCursorError
+                try:
+                    self.controller.pubsub.add_waiter(
+                        kwargs["channel"], kwargs.get("cursor", 0),
+                        float(kwargs["timeout"]), _reply)
+                except StaleCursorError:
+                    # resync marker: subscriber restarts from the
+                    # current head seq (and re-reads state it missed)
+                    cur = self.controller.pubsub.current_seq(
+                        kwargs["channel"])
+                    conn.reply(msg, value=("__stale__", cur))
+            else:
+                conn.reply(msg, value=self.state_op(
+                    msg["op"], **kwargs))
         elif mtype == protocol.NODE_REGISTER:
             rec = self.cluster.add_remote_node(
                 conn, msg["resources"], labels=msg.get("labels"),
@@ -1235,6 +1258,7 @@ class Runtime(_context.BaseContext):
         # each step is independent: a wedged component must not block
         # the ones after it (especially the final shm sweep)
         for step in (self.cluster.shutdown, self.waiters.shutdown,
+                     self.controller.pubsub.close,
                      lambda: self._restore_pool.shutdown(wait=False),
                      self._listener.close, self.store.shutdown,
                      self._sweep_orphan_segments):

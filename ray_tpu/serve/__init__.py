@@ -30,6 +30,31 @@ _STREAM_IDLE_TTL_S = 300.0
 _STREAM_END = ("__rtpu_stream__", "end")   # out-of-band marker
 
 
+@dataclasses.dataclass
+class _BoundHandle:
+    """Placeholder for a bound sub-deployment inside a deployment's init
+    args: resolved to a live DeploymentHandle inside the replica at
+    construction (reference deployment-graph handle injection,
+    deployment_state.py:1245 + handle.py handle-passing)."""
+    name: str
+
+
+def _resolve_bound(value, controller_name: str):
+    """Swap _BoundHandle markers (top level or nested one container
+    deep) for live handles."""
+    if isinstance(value, _BoundHandle):
+        import ray_tpu
+        return DeploymentHandle(value.name,
+                                ray_tpu.get_actor(controller_name))
+    if isinstance(value, (list, tuple)):
+        return type(value)(_resolve_bound(v, controller_name)
+                           for v in value)
+    if isinstance(value, dict):
+        return {k: _resolve_bound(v, controller_name)
+                for k, v in value.items()}
+    return value
+
+
 class _Replica:
     """Actor wrapping one instance of the user's deployment class.
 
@@ -44,6 +69,10 @@ class _Replica:
                  deployment: str = "", replica_id: str = "",
                  controller_name: str = "",
                  report_period_s: float = 0.5):
+        if controller_name:
+            init_args = _resolve_bound(tuple(init_args), controller_name)
+            init_kwargs = _resolve_bound(dict(init_kwargs),
+                                         controller_name)
         if isinstance(cls_or_fn, type):
             self._obj = cls_or_fn(*init_args, **init_kwargs)
         else:
@@ -259,6 +288,7 @@ class ServeController:
                 ray_tpu.kill(r)
             except BaseException:
                 pass
+        self._publish_membership(name, [])
 
     def get_replicas(self, name: str) -> List[Any]:
         with self._lock:
@@ -369,9 +399,27 @@ class ServeController:
                         except BaseException:
                             pass
             with self._lock:
+                before = [rid for rid, _r, _c in
+                          self._replicas.get(name, [])]
                 self._replicas[name] = [(rid, r, c)
                                         for rid, r, c, _n in live]
+                after = [rid for rid, _r, _c, _n in live]
+            if before != after:
+                self._publish_membership(name, after)
             self._sweep_draining(name, now)
+
+    def _publish_membership(self, name: str, rids: List[str]) -> None:
+        """Push the replica-set change to subscribed handles over the
+        control-plane pubsub (reference long_poll.py config push) —
+        handles refresh on the push instead of polling."""
+        try:
+            from ray_tpu._private import context as _c
+            _c.get_ctx().state_op(
+                "pubsub_publish", channel=f"serve:{name}",
+                message={"deployment": name, "replicas": rids,
+                         "ts": time.time()})
+        except BaseException:
+            pass
 
     def _sweep_draining(self, name: str, now: float) -> None:
         """Kill drain victims that finished their in-flight work (or hit
@@ -449,9 +497,32 @@ class DeploymentHandle:
         self._inflight: Dict[int, List[Any]] = {}
         self._refreshed = 0.0
         self._rng = __import__("random").Random(id(self) & 0xffff)
+        self._watch_started = False
+
+    # handles cross process boundaries (composition, tasks): runtime
+    # state (watch thread, inflight weakrefs) never travels
+    def __getstate__(self):
+        return {"name": self._name, "controller": self._controller}
+
+    def __setstate__(self, state):
+        self.__init__(state["name"], state["controller"])
+
+    def _ensure_watch(self) -> None:
+        """Long-poll membership push (reference long_poll.py): a daemon
+        thread parks on the `serve:<name>` pubsub channel and refreshes
+        the replica list the moment the controller publishes a change —
+        the TTL poll in _refresh becomes a slow fallback."""
+        if self._watch_started:
+            return
+        self._watch_started = True
+        import weakref
+        threading.Thread(
+            target=_handle_watch_loop,
+            args=(weakref.ref(self), self._name),
+            name=f"serve-watch-{self._name}", daemon=True).start()
 
     def _refresh(self, force: bool = False) -> None:
-        if not force and time.time() - self._refreshed < 5.0:
+        if not force and time.time() - self._refreshed < 30.0:
             return
         self._replicas = ray_tpu.get(
             self._controller.get_replicas.remote(self._name))
@@ -465,7 +536,7 @@ class DeploymentHandle:
         outstanding requests (not just submission concurrency within
         one tick)."""
         import weakref as _wr
-        for idx, wrefs in self._inflight.items():
+        for idx, wrefs in list(self._inflight.items()):
             if not wrefs:
                 continue
             live = [(w, w()) for w in wrefs]
@@ -478,13 +549,13 @@ class DeploymentHandle:
             self._inflight[idx] = [w for w, r in live
                                    if r is not None and id(r) not in done]
 
-    def _pick(self) -> int:
-        n = len(self._replicas)
+    def _pick(self, n: int) -> int:
         if n == 1:
             return 0
         a, b = self._rng.sample(range(n), 2)
-        return (a if len(self._inflight[a]) <= len(self._inflight[b])
-                else b)
+        inflight = self._inflight
+        return (a if len(inflight.get(a, ()))
+                <= len(inflight.get(b, ())) else b)
 
     def inflight_count(self) -> int:
         """Outstanding requests on this handle (autoscaling signal)."""
@@ -500,19 +571,25 @@ class DeploymentHandle:
 
     def _route(self, method_name: str, args, kwargs,
                wants_stream: bool = False):
+        self._ensure_watch()
         self._refresh()
-        if not self._replicas:
+        # SNAPSHOT the replica list: the watch thread swaps
+        # self._replicas/_inflight on membership pushes, and indexing
+        # the live attributes after a swap would IndexError mid-request
+        reps = self._replicas
+        if not reps:
             self._refresh(force=True)
-            if not self._replicas:
+            reps = self._replicas
+            if not reps:
                 raise RuntimeError(
                     f"deployment {self._name!r} has no live replicas")
         self._drain_done()
-        idx = self._pick()
-        replica = self._replicas[idx]
+        idx = self._pick(len(reps))
+        replica = reps[idx]
         ref = replica.handle_request.remote(method_name, args, kwargs,
                                             wants_stream)
         import weakref as _wr
-        self._inflight[idx].append(_wr.ref(ref))
+        self._inflight.setdefault(idx, []).append(_wr.ref(ref))
         return ref, replica
 
     def stream(self, *args, method_name: str = "__call__",
@@ -546,6 +623,38 @@ class DeploymentHandle:
                     replica.close_stream.remote(sid)
                 except BaseException:
                     pass
+
+
+def _handle_watch_loop(handle_ref, name: str) -> None:
+    """Holds only a weakref to the handle: the handle stays collectable
+    and the thread exits when it goes away. Long-polls park HEAD-side in
+    the publisher's waiter list (never on a connection reader)."""
+    from ray_tpu._private import context as _context
+    cursor = 0
+    while True:
+        ctx = _context.maybe_ctx()
+        if ctx is None or handle_ref() is None:
+            return
+        try:
+            out = ctx.state_op("pubsub_poll", channel=f"serve:{name}",
+                               cursor=cursor, timeout=15.0)
+            msgs, cursor = out if out else ([], cursor)
+        except BaseException:
+            time.sleep(1.0)
+            continue
+        h = handle_ref()
+        if h is None:
+            return
+        if msgs == "__stale__":
+            # fell behind the ring: resync from the returned head seq
+            # and do one catch-up refresh for whatever was missed
+            msgs = [None]
+        if msgs:
+            try:
+                h._refresh(force=True)
+            except BaseException:
+                pass
+        del h
 
 
 # ---------------------------------------------------------- user API
@@ -599,22 +708,53 @@ def _get_controller():
 
 
 def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
-    """Deploy an application; returns its handle (reference
-    serve.run, serve/api.py:491)."""
+    """Deploy an application — including every bound sub-deployment in
+    its init args — and return the top deployment's handle (reference
+    serve.run, serve/api.py:491, with deployment-graph resolution:
+    nested `.bind()`s become handles injected at replica init,
+    deployment_state.py:1245 + handle.py)."""
     import cloudpickle
     controller = _get_controller()
     ray_tpu.get(controller.ping.remote())
-    d = app.deployment
-    dep_name = name or d.name
-    info = _DeploymentInfo(
-        name=dep_name, cls_bytes=cloudpickle.dumps(d._cls),
-        init_args=app.init_args, init_kwargs=app.init_kwargs,
-        num_replicas=d.num_replicas,
-        max_ongoing_requests=d.max_ongoing_requests,
-        ray_actor_options=d.ray_actor_options,
-        autoscaling_config=d.autoscaling_config)
-    ray_tpu.get(controller.deploy.remote(info))
-    return DeploymentHandle(dep_name, controller)
+    deployed: Dict[int, str] = {}        # id(Application) -> name
+
+    def _sub(value):
+        if isinstance(value, Application):
+            return _BoundHandle(_deploy(value))
+        if isinstance(value, (list, tuple)):
+            return type(value)(_sub(v) for v in value)
+        if isinstance(value, dict):
+            return {k: _sub(v) for k, v in value.items()}
+        return value
+
+    def _deploy(a: Application, top_name: Optional[str] = None) -> str:
+        if id(a) in deployed:            # diamond: deploy shared child once
+            return deployed[id(a)]
+        d = a.deployment
+        dep_name = top_name or d.name
+        if dep_name in deployed.values():
+            # two DISTINCT binds under one name would silently clobber
+            # each other (both handles routing to whichever deployed
+            # last) — make the user disambiguate
+            raise ValueError(
+                f"deployment name {dep_name!r} is bound more than once "
+                f"in this application graph; give each bind a distinct "
+                f"name via .options(name=...)")
+        deployed[id(a)] = dep_name
+        init_args = tuple(_sub(v) for v in a.init_args)
+        init_kwargs = {k: _sub(v) for k, v in a.init_kwargs.items()}
+        info = _DeploymentInfo(
+            name=dep_name, cls_bytes=cloudpickle.dumps(d._cls),
+            init_args=init_args, init_kwargs=init_kwargs,
+            num_replicas=d.num_replicas,
+            max_ongoing_requests=d.max_ongoing_requests,
+            ray_actor_options=d.ray_actor_options,
+            autoscaling_config=d.autoscaling_config)
+        ray_tpu.get(controller.deploy.remote(info))
+        return dep_name
+
+    top = _deploy(app, name)
+    return DeploymentHandle(top, controller)
 
 
 def get_handle(name: str) -> DeploymentHandle:

@@ -235,3 +235,74 @@ def test_serve_grpc_ingress(serve_shutdown):
         ch.close()
     finally:
         serve.stop_grpc()
+
+
+def test_serve_composition_fanout(serve_shutdown):
+    """Deployment-graph composition: an ingress deployment whose init
+    args contain two bound sub-deployments receives live handles at
+    replica init and fans requests out through them (reference
+    deployment graphs: deployment_state.py:1245 + handle.py)."""
+
+    @serve.deployment(num_replicas=1)
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment(num_replicas=1)
+    class Adder:
+        def __init__(self, inc):
+            self.inc = inc
+
+        def __call__(self, x):
+            return x + self.inc
+
+    @serve.deployment(num_replicas=1)
+    class Ingress:
+        def __init__(self, doubler, adders):
+            self.doubler = doubler           # injected handle
+            self.adders = adders             # list of injected handles
+
+        def __call__(self, x):
+            import ray_tpu as rt
+            d = rt.get(self.doubler.remote(x), timeout=60)
+            return [rt.get(a.remote(d), timeout=60)
+                    for a in self.adders]
+
+    app = Ingress.bind(Doubler.bind(),
+                       [Adder.bind(10), Adder.options(
+                           name="Adder2").bind(100)])
+    h = serve.run(app)
+    assert ray_tpu.get(h.remote(3), timeout=120) == [16, 106]
+    # all three sub-deployments are live, independently addressable
+    st = serve.status()
+    assert {"Ingress", "Doubler", "Adder", "Adder2"} <= set(st)
+    assert ray_tpu.get(
+        serve.get_handle("Doubler").remote(5), timeout=60) == 10
+
+
+def test_serve_longpoll_membership_push(serve_shutdown):
+    """Handles learn replica-set changes via the pubsub long-poll push
+    (reference long_poll.py), not the slow TTL poll: after a scale-up
+    the handle routes to the new replica well before the 30s TTL."""
+
+    @serve.deployment(num_replicas=1)
+    class W:
+        def pid(self):
+            import os
+            return os.getpid()
+
+    h = serve.run(W.bind())
+    first = ray_tpu.get(h.method("pid"), timeout=60)
+    assert first > 0
+    # watch thread is now parked on serve:W; scale to 3
+    serve.run(W.options(num_replicas=3).bind())
+    deadline = time.monotonic() + 25       # << the 30s TTL fallback
+    pids = set()
+    while time.monotonic() < deadline and len(pids) < 3:
+        try:
+            pids.add(ray_tpu.get(h.method("pid"), timeout=30))
+        except BaseException:
+            pass
+        time.sleep(0.3)
+    assert len(pids) >= 2, (
+        "handle never discovered scaled-up replicas via push")

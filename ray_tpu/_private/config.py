@@ -77,6 +77,12 @@ _define("remote_inline_max_bytes", 64 * 1024,
         "node agent to the head (owner-inline parity, reference "
         "core_worker.h AllocateReturnObject); larger results stay in "
         "the agent's store and register a location.")
+_define("auth_token", "",
+        "Shared secret for listener authentication. When set, every "
+        "accepted connection must present it (raw first frame, "
+        "constant-time compare) BEFORE any message is deserialized; "
+        "workers/agents inherit it via the environment. Strongly "
+        "recommended with bind_host=0.0.0.0 — the wire is pickle.")
 _define("bind_host", "127.0.0.1",
         "Head listener bind host. Set 0.0.0.0 (or a NIC address) to "
         "accept remote node agents; loopback by default.")

@@ -437,7 +437,7 @@ class NodeAgent:
                 return
             conn = protocol.Connection(sock, self._handle_local_msg,
                                        self._on_local_closed,
-                                       name="agent-local")
+                                       name="agent-local", server=True)
             conn.start()
 
     def _on_local_closed(self, conn: protocol.Connection) -> None:

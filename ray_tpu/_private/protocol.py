@@ -86,13 +86,25 @@ class ConnectionClosed(Exception):
     pass
 
 
+def _auth_token() -> Optional[bytes]:
+    """Shared listener secret (RAY_TPU_AUTH_TOKEN). When set, every
+    accepted connection must present it in a RAW first frame, verified
+    with a constant-time compare BEFORE any frame is unpickled — the
+    wire is pickle, so an unauthenticated peer would otherwise get
+    arbitrary code execution (reference scopes this via gRPC + tokened
+    client/job servers, python/ray/util/client/server/)."""
+    from ray_tpu._private.config import CONFIG
+    tok = CONFIG.auth_token
+    return tok.encode() if tok else None
+
+
 class Connection:
     """Full-duplex framed-message channel with request/reply correlation."""
 
     def __init__(self, sock: socket.socket,
                  handler: Callable[["Connection", dict], None],
                  on_close: Optional[Callable[["Connection"], None]] = None,
-                 name: str = ""):
+                 name: str = "", server: bool = False):
         self._sock = sock
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # Bound sends only (recv stays blocking: connections idle for
@@ -114,12 +126,51 @@ class Connection:
         self._pending: dict[int, _Future] = {}
         self._pending_lock = threading.Lock()
         self._closed = threading.Event()
+        self._server = server
         self.meta: dict = {}  # endpoint-attached metadata (worker id, etc.)
         self._reader = threading.Thread(
             target=self._read_loop, name=f"ray-tpu-conn-{name}", daemon=True)
 
     def start(self) -> None:
         self._reader.start()
+
+    def send_auth(self) -> None:
+        """Client side: present the shared secret as the raw first
+        frame (no-op when auth is disabled)."""
+        token = _auth_token()
+        if token is None:
+            return
+        with self._send_lock:
+            try:
+                self._sock.sendall(_LEN.pack(len(token)) + token)
+            except OSError as e:
+                self.close()
+                raise ConnectionClosed(str(e)) from e
+
+    def _check_auth(self) -> bool:
+        """Server side (reader thread): verify the raw first frame
+        before ANY unpickling. Closes and returns False on mismatch."""
+        token = _auth_token()
+        if token is None:
+            return True
+        try:
+            header = self._read_exact(_LEN.size)
+            (length,) = _LEN.unpack(header)
+            if length > 4096:           # token frames are tiny
+                raise ConnectionClosed("oversized auth frame")
+            presented = self._read_exact(length)
+        except (ConnectionClosed, OSError):
+            self.close()        # malformed/short frame: drop the socket
+            return False
+        import hmac
+        if not hmac.compare_digest(presented, token):
+            import sys as _sys
+            _sys.stderr.write(
+                f"ray_tpu: rejected unauthenticated connection "
+                f"({self.name})\n")
+            self.close()
+            return False
+        return True
 
     # ---- sending ----
     def send(self, msg: dict) -> None:
@@ -172,6 +223,8 @@ class Connection:
 
     def _read_loop(self) -> None:
         try:
+            if self._server and not self._check_auth():
+                return
             while True:
                 header = self._read_exact(_LEN.size)
                 (length,) = _LEN.unpack(header)
@@ -271,5 +324,6 @@ def connect(addr: tuple[str, int],
             name: str = "") -> Connection:
     sock = socket.create_connection(addr)
     conn = Connection(sock, handler, on_close, name=name)
+    conn.send_auth()             # no-op unless RAY_TPU_AUTH_TOKEN is set
     conn.start()
     return conn

@@ -25,7 +25,12 @@ ERROR_CHANNEL = "error"
 
 class StaleCursorError(Exception):
     """The cursor predates the retained window: messages were evicted
-    and are unrecoverable (the caller must resync its view)."""
+    and are unrecoverable (the caller must resync its view). The
+    ``resync`` attribute carries the current head seq to restart from."""
+
+    def __init__(self, msg: str, resync: int = 0):
+        super().__init__(msg)
+        self.resync = resync
 
 
 class Publisher:
@@ -81,8 +86,7 @@ class Publisher:
             if ring and cursor < ring[0][0]:
                 raise StaleCursorError(
                     f"channel {channel!r}: cursor {cursor} predates "
-                    f"oldest retained seq {ring[0][0]}; resync from "
-                    f"{seq}")
+                    f"oldest retained seq {ring[0][0]}", resync=seq)
             msgs = ([m for s, _, m in ring if s >= cursor]
                     if ring is not None else [])
             if msgs:
@@ -159,7 +163,7 @@ class Publisher:
                 # messages — the subscriber fell too far behind
                 raise StaleCursorError(
                     f"channel {channel!r}: cursor {cursor} predates "
-                    f"oldest retained seq {ring[0][0]}")
+                    f"oldest retained seq {ring[0][0]}", resync=seq)
             msgs = [(s, m) for s, _, m in ring if s >= cursor]
             return msgs, seq
 

@@ -250,7 +250,8 @@ class Runtime(_context.BaseContext):
             except OSError:
                 return
             conn = protocol.Connection(sock, self._handle_msg,
-                                       self._on_conn_closed, name="driver")
+                                       self._on_conn_closed, name="driver",
+                                       server=True)
             conn.start()
 
     def _on_conn_closed(self, conn: protocol.Connection) -> None:
@@ -442,31 +443,32 @@ class Runtime(_context.BaseContext):
         elif mtype == protocol.ADDREF:
             self.controller.addref(msg["object_id"])
         elif mtype == protocol.STATE_OP:
+            from ray_tpu._private.pubsub import StaleCursorError
             kwargs = msg.get("kwargs", {})
-            if (msg["op"] == "pubsub_poll"
-                    and kwargs.get("timeout")):
-                # long-poll parks in the publisher's waiter list and
-                # replies on publish/expiry — NEVER blocks this reader
-                # thread (it carries the subscriber's other traffic)
-                def _reply(msgs, cursor, conn=conn, msg=msg):
-                    try:
-                        conn.reply(msg, value=(msgs, cursor))
-                    except protocol.ConnectionClosed:
-                        pass
-                from ray_tpu._private.pubsub import StaleCursorError
-                try:
+            try:
+                if (msg["op"] == "pubsub_poll"
+                        and kwargs.get("timeout")):
+                    # long-poll parks in the publisher's waiter list and
+                    # replies on publish/expiry — NEVER blocks this
+                    # reader thread (it carries the subscriber's other
+                    # traffic)
+                    def _reply(msgs, cursor, conn=conn, msg=msg):
+                        try:
+                            conn.reply(msg, value=(msgs, cursor))
+                        except protocol.ConnectionClosed:
+                            pass
                     self.controller.pubsub.add_waiter(
                         kwargs["channel"], kwargs.get("cursor", 0),
                         float(kwargs["timeout"]), _reply)
-                except StaleCursorError:
-                    # resync marker: subscriber restarts from the
-                    # current head seq (and re-reads state it missed)
-                    cur = self.controller.pubsub.current_seq(
-                        kwargs["channel"])
-                    conn.reply(msg, value=("__stale__", cur))
-            else:
-                conn.reply(msg, value=self.state_op(
-                    msg["op"], **kwargs))
+                else:
+                    conn.reply(msg, value=self.state_op(
+                        msg["op"], **kwargs))
+            except StaleCursorError as e:
+                # one contract across transports: the client-side
+                # state_op re-raises this as StaleCursorError(resync=N)
+                conn.reply(msg, value=None, stale=True,
+                           resync=getattr(e, "resync", 0),
+                           detail=str(e))
         elif mtype == protocol.NODE_REGISTER:
             rec = self.cluster.add_remote_node(
                 conn, msg["resources"], labels=msg.get("labels"),

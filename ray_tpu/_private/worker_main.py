@@ -139,6 +139,10 @@ class WorkerContext(_context.BaseContext):
     def state_op(self, op: str, **kwargs) -> Any:
         reply = self.conn.request({"type": protocol.STATE_OP, "op": op,
                                    "kwargs": kwargs})
+        if reply.get("stale"):
+            from ray_tpu._private.pubsub import StaleCursorError
+            raise StaleCursorError(reply.get("detail", "stale cursor"),
+                                   resync=reply.get("resync", 0))
         return reply.get("value")
 
     def get_actor_handle(self, name: str, namespace: str = "default"):

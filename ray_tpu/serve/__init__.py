@@ -498,6 +498,7 @@ class DeploymentHandle:
         self._refreshed = 0.0
         self._rng = __import__("random").Random(id(self) & 0xffff)
         self._watch_started = False
+        self._watch_lock = threading.Lock()
 
     # handles cross process boundaries (composition, tasks): runtime
     # state (watch thread, inflight weakrefs) never travels
@@ -514,7 +515,10 @@ class DeploymentHandle:
         the TTL poll in _refresh becomes a slow fallback."""
         if self._watch_started:
             return
-        self._watch_started = True
+        with self._watch_lock:
+            if self._watch_started:
+                return
+            self._watch_started = True
         import weakref
         threading.Thread(
             target=_handle_watch_loop,
@@ -635,20 +639,22 @@ def _handle_watch_loop(handle_ref, name: str) -> None:
         ctx = _context.maybe_ctx()
         if ctx is None or handle_ref() is None:
             return
+        from ray_tpu._private.pubsub import StaleCursorError
         try:
             out = ctx.state_op("pubsub_poll", channel=f"serve:{name}",
                                cursor=cursor, timeout=15.0)
             msgs, cursor = out if out else ([], cursor)
+        except StaleCursorError as e:
+            # fell behind the ring: resync from the head seq and do one
+            # catch-up refresh for whatever was missed
+            cursor = getattr(e, "resync", 0)
+            msgs = [None]
         except BaseException:
             time.sleep(1.0)
             continue
         h = handle_ref()
         if h is None:
             return
-        if msgs == "__stale__":
-            # fell behind the ring: resync from the returned head seq
-            # and do one catch-up refresh for whatever was missed
-            msgs = [None]
         if msgs:
             try:
                 h._refresh(force=True)

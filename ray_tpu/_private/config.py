@@ -104,6 +104,20 @@ _define("agent_reconnect_window_s", 60.0,
         "How long a node agent keeps redialing a lost head before "
         "giving up and shutting down (reference raylets tolerate GCS "
         "downtime); 0 restores exit-on-disconnect.")
+_define("store_put_block_s", 10.0,
+        "Create-queueing backpressure (reference plasma "
+        "create_request_queue.cc): when the object store is over "
+        "capacity and nothing is spillable (all bytes pinned by "
+        "in-flight tasks), a put parks up to this long for space to "
+        "free before admitting the object over-cap with a warning. "
+        "0 disables blocking.")
+_define("memory_monitor_threshold", 0.95,
+        "Node memory-usage fraction above which the per-node memory "
+        "monitor kills a task worker to relieve pressure (reference "
+        "raylet memory_monitor + worker_killing_policy.cc). 0 "
+        "disables the monitor.")
+_define("memory_monitor_refresh_s", 1.0,
+        "Memory monitor poll period.")
 _define("worker_pipeline_depth", 2,
         "Tasks dispatched to one worker before its previous task "
         "completes (the worker executes FIFO). Depth 2 overlaps the "

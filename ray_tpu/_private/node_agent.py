@@ -472,7 +472,8 @@ class NodeAgent:
             self.send_event("object_at", object_id=stored.object_id,
                             nbytes=stored.nbytes, addref=True,
                             contained=list(stored.contained_ids))
-            conn.reply(msg, ok=True)
+            conn.reply(msg, ok=True,
+                       pressure=self.store.over_capacity())
         elif mtype == protocol.PULL_OBJECT:
             self._pull_server.handle_pull(conn, msg)
         elif mtype == protocol.PULL_CHUNK:

@@ -28,6 +28,7 @@ from __future__ import annotations
 import mmap
 import os
 import pickle
+import sys
 import threading
 import time
 import uuid
@@ -269,7 +270,13 @@ class LocalStore:
         self.on_seal = None
 
     # ------------------------------------------------------------- put
-    def put_stored(self, obj: StoredObject) -> None:
+    def put_stored(self, obj: StoredObject, block: bool = False) -> None:
+        """Admit a sealed object. ``block=True`` applies create-queueing
+        backpressure when the store is over cap and fully pinned — ONLY
+        safe on producer-owned threads (driver put); connection reader
+        threads must pass False (blocking them stalls the very messages
+        whose processing releases pins) and instead forward the
+        ``over_capacity()`` hint to the producer."""
         stale: list[str] = []
         with self._cv:
             old = self._objects.pop(obj.object_id, None)
@@ -287,12 +294,64 @@ class LocalStore:
         for name in stale:
             unlink_segment(name)
         self._write_spills(victims)
+        # Seal BEFORE any backpressure wait: consumers blocked on this
+        # object must resolve (their tasks finishing is what releases
+        # the pins that free space — delaying the seal would deadlock
+        # the very backpressure loop).
         if self.on_seal is not None:
             self.on_seal(obj.object_id)
+        if block:
+            self._put_backpressure()
 
-    def put(self, value: Any, object_id: Optional[str] = None) -> str:
+    def over_capacity(self) -> bool:
+        """Still over cap after the spill pass — i.e. the resident
+        overage is pinned. Producers use this as a throttle hint."""
+        with self._lock:
+            return (self.capacity_bytes is not None
+                    and self._bytes > self.capacity_bytes)
+
+    def _put_backpressure(self) -> None:
+        """Create-queueing parity (reference plasma
+        create_request_queue.cc): when the store is over capacity and
+        nothing is spillable — every resident byte pinned by in-flight
+        work — park the PRODUCER until space frees (deletes, unpins
+        making spill possible) or the budget runs out, then admit
+        over-cap with a loud warning instead of failing."""
+        if self.capacity_bytes is None:
+            return
+        block_s = _CFG.store_put_block_s
+        if block_s <= 0:
+            return
+        deadline = time.monotonic() + block_s
+        warned_wait = False
+        while True:
+            with self._cv:
+                if self._bytes <= self.capacity_bytes:
+                    return
+                victims = self._pick_victims_locked()
+                if not victims:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        sys.stderr.write(
+                            f"ray_tpu: object store over capacity "
+                            f"({self._bytes} > {self.capacity_bytes} "
+                            f"bytes) with all bytes pinned by in-flight "
+                            f"work after {block_s:.0f}s of "
+                            f"backpressure; admitting over-cap\n")
+                        return
+                    if not warned_wait:
+                        warned_wait = True
+                        sys.stderr.write(
+                            "ray_tpu: object store full and fully "
+                            "pinned; applying put backpressure\n")
+                    self._cv.wait(timeout=min(left, 0.2))
+                    continue
+            self._write_spills(victims)     # outside the lock
+
+    def put(self, value: Any, object_id: Optional[str] = None,
+            block: bool = True) -> str:
         obj = serialize(value, object_id)
-        self.put_stored(obj)
+        self.put_stored(obj, block=block)
         return obj.object_id
 
     # ----------------------------------------------------------- spill

@@ -154,13 +154,17 @@ class Connection:
         if token is None:
             return True
         try:
+            # hard deadline: a peer that connects and sends nothing
+            # must not pin this thread + fd forever (slowloris)
+            self._sock.settimeout(10.0)
             header = self._read_exact(_LEN.size)
             (length,) = _LEN.unpack(header)
             if length > 4096:           # token frames are tiny
                 raise ConnectionClosed("oversized auth frame")
             presented = self._read_exact(length)
+            self._sock.settimeout(None)
         except (ConnectionClosed, OSError):
-            self.close()        # malformed/short frame: drop the socket
+            self.close()        # malformed/short/slow: drop the socket
             return False
         import hmac
         if not hmac.compare_digest(presented, token):

@@ -419,7 +419,11 @@ class Runtime(_context.BaseContext):
             self._seal_contained(stored.object_id, stored.contained_ids)
             self.store.put_stored(stored)
             self.controller.addref(stored.object_id)
-            conn.reply(msg, ok=True)
+            # producer-side backpressure hint: the WORKER throttles its
+            # own puts (blocking this reader thread would stall the
+            # completions that release pins)
+            conn.reply(msg, ok=True,
+                       pressure=self.store.over_capacity())
         elif mtype == protocol.SUBMIT:
             spec: TaskSpec = msg["spec"]
             if msg.get("func_bytes") is not None:
@@ -988,7 +992,8 @@ class Runtime(_context.BaseContext):
         from ray_tpu._private.object_store import serialize
         stored = serialize(value)
         self._seal_contained(stored.object_id, stored.contained_ids)
-        self.store.put_stored(stored)
+        # driver thread: safe to apply create-queueing backpressure
+        self.store.put_stored(stored, block=True)
         self.controller.addref(stored.object_id)
         return ObjectRef(stored.object_id)
 

@@ -68,6 +68,23 @@ class WorkerRec:
     env_hash: str = ""
 
 
+def _node_memory_fraction() -> float:
+    """Fraction of node memory in use (1 - MemAvailable/MemTotal)."""
+    try:
+        with open("/proc/meminfo") as f:
+            info = {}
+            for line in f:
+                k, _, rest = line.partition(":")
+                info[k] = int(rest.split()[0])
+        total = info.get("MemTotal", 0)
+        avail = info.get("MemAvailable", total)
+        if total <= 0:
+            return 0.0
+        return 1.0 - avail / total
+    except OSError:
+        return 0.0
+
+
 def fits(avail: dict[str, float], need: dict[str, float]) -> bool:
     return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in need.items() if v)
 
@@ -120,6 +137,12 @@ class Scheduler:
         self._bundles: dict[tuple, dict] = {}
         self._running = True
         self._spawning = 0
+        # Memory-pressure monitor (reference raylet memory_monitor +
+        # worker_killing_policy.cc): injectable for tests.
+        self.memory_fraction_fn: Callable[[], float] = \
+            _node_memory_fraction
+        self._last_mem_check = 0.0
+        self._last_mem_kill = 0.0
         self._thread = threading.Thread(
             target=self._loop, name=f"ray-tpu-sched-{self.node_id}",
             daemon=True)
@@ -544,7 +567,62 @@ class Scheduler:
                     last_full = now
                 else:
                     self._try_dispatch_locked(512)
+            try:
+                self._memory_monitor_step()
+            except Exception:
+                pass          # the dispatch backstop must never die
             time.sleep(0.05)
+
+    # ------------------------------------------------ memory pressure
+    def _memory_monitor_step(self) -> None:
+        """Kill a task worker when node memory usage crosses the
+        threshold (reference raylet memory monitor). Victim selection is
+        the reference's retriable-FIFO policy
+        (worker_killing_policy.cc): retriable task workers first,
+        newest-started first — the cheapest work to redo — and never
+        actors (their loss cascades)."""
+        threshold = _CFG.memory_monitor_threshold
+        if threshold <= 0 or not self._running:
+            return
+        now = time.monotonic()
+        if now - self._last_mem_check < _CFG.memory_monitor_refresh_s:
+            return
+        self._last_mem_check = now
+        try:
+            frac = self.memory_fraction_fn()
+        except Exception:
+            return
+        if frac < threshold:
+            return
+        # cooldown: a kill takes seconds to actually release memory —
+        # without it, sustained (possibly external) pressure would
+        # massacre every worker within a few ticks
+        cooldown = max(5.0, 3 * _CFG.memory_monitor_refresh_s)
+        if now - self._last_mem_kill < cooldown:
+            return
+        with self._lock:
+            candidates = [r for r in self._workers.values()
+                          if r.state == BUSY and r.conn is not None
+                          and r.tasks]
+            if not candidates:
+                return
+
+            def retriable(rec: WorkerRec) -> bool:
+                return all(t.retries_used < t.max_retries
+                           for t in rec.tasks.values())
+
+            pool = [r for r in candidates if retriable(r)] or candidates
+            victim = max(pool, key=lambda r: r.started_at)
+            names = [t.name or t.task_id
+                     for t in victim.tasks.values()]
+            victim_id = victim.worker_id
+        self._last_mem_kill = now
+        sys.stderr.write(
+            f"ray_tpu: node {self.node_id} memory usage "
+            f"{frac:.0%} >= {threshold:.0%}; killing worker "
+            f"{victim_id} (tasks: {names}) to relieve "
+            f"pressure — retriable tasks will be retried\n")
+        self.kill_worker(victim_id)
 
     def _spill_aged_locked(self) -> None:
         """Spillback (stage-1 redirect): hand unconstrained tasks that

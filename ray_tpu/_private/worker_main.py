@@ -44,7 +44,14 @@ class WorkerContext(_context.BaseContext):
     # ---- object plane ----
     def put(self, value: Any) -> ObjectRef:
         stored = serialize(value)
-        self.conn.request({"type": protocol.PUT_OBJECT, "stored": stored})
+        rep = self.conn.request({"type": protocol.PUT_OBJECT,
+                                 "stored": stored})
+        if rep.get("pressure"):
+            # store over cap and fully pinned: self-throttle the
+            # producer (create-queueing backpressure applied in the
+            # producer process, never on a connection reader)
+            import time as _t
+            _t.sleep(0.2)
         return ObjectRef(stored.object_id, owned=True)
 
     def get_objects(self, object_ids: list[str],

@@ -349,7 +349,11 @@ class LocalStore:
             self._write_spills(victims)     # outside the lock
 
     def put(self, value: Any, object_id: Optional[str] = None,
-            block: bool = True) -> str:
+            block: bool = False) -> str:
+        # block defaults False: internal callers (error seals, recovery
+        # paths) run on connection reader threads where backpressure
+        # would stall the very messages that release pins. Producer-
+        # owned threads opt in (Runtime.put).
         obj = serialize(value, object_id)
         self.put_stored(obj, block=block)
         return obj.object_id

@@ -79,7 +79,8 @@ class NodeAgentProcess:
                  num_cpus: float = 2.0, num_tpus: float = 0.0,
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
-                 max_workers: Optional[int] = None):
+                 max_workers: Optional[int] = None,
+                 node_id: Optional[str] = None):
         import json
         import os
         import subprocess
@@ -87,7 +88,7 @@ class NodeAgentProcess:
         import uuid
         if head_address is None:
             head_address = _context.get_ctx().address
-        self.node_id = "node_" + uuid.uuid4().hex[:8]
+        self.node_id = node_id or ("node_" + uuid.uuid4().hex[:8])
         args = [sys.executable, "-m", "ray_tpu._private.node_agent",
                 "--head", f"{head_address[0]}:{head_address[1]}",
                 "--num-cpus", str(num_cpus), "--num-tpus", str(num_tpus),

@@ -29,13 +29,13 @@ def test_store_put_backpressure_fully_pinned(monkeypatch):
         # pinned — the genuinely stuck case create-queueing exists for
         pinned_fn=lambda: set(store._objects) if pressure["on"] else set())
     try:
-        store.put(np.zeros(900_000 // 8))         # ~0.9 MB resident
+        store.put(np.zeros(900_000 // 8), block=True)   # ~0.9 MB
 
         import threading
         done_at = {}
 
         def putter():
-            oid = store.put(np.ones(900_000 // 8))
+            oid = store.put(np.ones(900_000 // 8), block=True)
             done_at["t"] = time.monotonic()
             done_at["oid"] = oid
 
@@ -68,8 +68,8 @@ def test_store_overflow_admits_after_budget(monkeypatch):
                        pinned_fn=lambda: set(store._objects))
     try:
         t0 = time.monotonic()
-        store.put(np.zeros(900_000 // 8))
-        second = store.put(np.ones(900_000 // 8))
+        store.put(np.zeros(900_000 // 8), block=True)
+        second = store.put(np.ones(900_000 // 8), block=True)
         dt = time.monotonic() - t0
         assert 0.4 < dt < 10.0
         assert store.contains(second)              # admitted over-cap

@@ -160,7 +160,35 @@ def main(as_json: bool = False) -> dict:
         "refwired_ms": round(ref_lat * 1e3, 3),
         "shm_channel_ms": round(ch_lat * 1e3, 3),
         "channel_speedup": round(ref_lat / ch_lat, 2)}
-    for hop in (h1, h2, h3, h4):
+    # ---------------------- device channels: raw-array hot edge
+    # (VERDICT r4 item 6: jax.Array hand-off between actors without a
+    # host serialize on the hot edge — raw shm frame + device_put)
+    h5, h6 = Hop.remote(), Hop.remote()
+    with InputNode() as inp:
+        chain3 = h6.work.bind(h5.work.bind(inp))
+    dev_dag = chain3.experimental_compile(enable_shm_channels=True,
+                                          buffer_size_bytes=16 << 20)
+    arr = np.zeros((1024, 1024), dtype=np.float32)      # 4 MB
+    for _ in range(3):
+        dev_dag.execute(arr).get()                      # warm
+    N_DEV = 50
+    t0 = time.perf_counter()
+    for _ in range(N_DEV):
+        out = dev_dag.execute(arr).get()
+    dev_lat = (time.perf_counter() - t0) / N_DEV
+    assert out.shape == arr.shape
+    dev_dag.teardown()
+    results["dag_device_hop"] = {
+        "n": N_DEV, "unit": "executes",
+        "payload_mb": round(arr.nbytes / 2 ** 20, 1),
+        "per_execute_ms": round(dev_lat * 1e3, 3),
+        "per_second": round(1.0 / dev_lat, 1),
+        "seconds": round(dev_lat * N_DEV, 4),
+        # 3 channel crossings per execute: driver->h5, h5->h6, h6->driver
+        "channel_gbps_total": round(
+            3 * arr.nbytes / dev_lat / 2 ** 30, 2)}
+
+    for hop in (h1, h2, h3, h4, h5, h6):
         ray_tpu.kill(hop)
     time.sleep(0.5)          # let kills land before the queue scenarios
 

@@ -379,8 +379,12 @@ class Autoscaler:
             group = self._provider.group_of(nid) or [nid]
             # dead group members (a crashed slice host) count as
             # retire-ready — they can never become idle, and keeping
-            # the survivors alive for them leaks the whole slice
-            if not all(idle_map.get(m, m not in alive) for m in group):
+            # the survivors alive for them leaks the whole slice. But a
+            # member still PROVISIONING (in flight, not yet registered)
+            # blocks retirement: terminating mid-boot would thrash.
+            if not all(idle_map.get(
+                    m, m not in alive and m not in inflight_ids)
+                    for m in group):
                 continue
             tname = self._managed[nid]
             live_members = [m for m in group if m in self._managed]

@@ -41,6 +41,45 @@ from ray_tpu._private.object_store import (_create_segment, _map_segment,
 _MAGIC = 0x52545055_4348414E          # "RTPUCHAN"
 _CLOSED_LEN = (1 << 63) - 1           # writer closed the channel
 _ERROR_FLAG = 1 << 62                 # payload pickles an error repr
+# Device-channel fast path (reference torch_tensor_nccl_channel.py
+# intent, re-designed for TPU processes): the payload is a RAW
+# ndarray — u32 meta_len + pickled (dtype, shape, is_device) + bytes —
+# written with ONE memcpy from the producer's host buffer and consumed
+# by a single jax.device_put straight from the mapped segment. No
+# pickle stream, no intermediate copies on the hot edge.
+_RAW_FLAG = 1 << 61
+_LEN_MASK = (1 << 61) - 1
+
+
+def _raw_ok(dtype) -> bool:
+    # object/structured dtypes need the pickle path; the dtype OBJECT
+    # (not .str, which is lossy for bfloat16 — '<V2' — and structured
+    # dtypes) travels pickled in the meta
+    return not (dtype.hasobject or dtype.fields)
+
+
+def _array_payload(value):
+    """(meta, contiguous ndarray) for raw transport, or None for the
+    pickle path. jax.Arrays round-trip as jax.Arrays (device_put on the
+    consumer); plain numpy stays numpy (subclasses like MaskedArray
+    take the pickle path — coercion would drop their semantics)."""
+    import numpy as np
+    if type(value) is np.ndarray and _raw_ok(value.dtype):
+        arr = np.ascontiguousarray(value)
+        return pickle.dumps((arr.dtype, arr.shape, False)), arr
+    try:
+        import jax
+    except Exception:                  # pragma: no cover - jax is baked in
+        return None
+    if isinstance(value, jax.Array):
+        try:
+            arr = np.ascontiguousarray(np.asarray(value))   # D2H copy
+        except Exception:
+            return None                # e.g. sharded across devices
+        if not _raw_ok(arr.dtype):
+            return None
+        return pickle.dumps((arr.dtype, arr.shape, True)), arr
+    return None
 
 
 class ChannelClosed(Exception):
@@ -145,9 +184,39 @@ class ChannelWriter:
         ch._set_u64(16, self._seq)     # publish
 
     def write(self, value: Any, **kw) -> None:
-        self.write_bytes(cloudpickle.dumps(value,
-                                           protocol=pickle.HIGHEST_PROTOCOL),
-                         **kw)
+        payload = _array_payload(value)
+        if payload is not None:
+            self._write_array(payload[0], payload[1], **kw)
+        else:
+            self.write_bytes(
+                cloudpickle.dumps(value,
+                                  protocol=pickle.HIGHEST_PROTOCOL),
+                **kw)
+
+    def _write_array(self, meta: bytes, arr,
+                     timeout: Optional[float] = None) -> None:
+        """Raw-array frame: one memcpy into the mapped slot."""
+        import numpy as np
+        ch = self.ch
+        total = 4 + len(meta) + arr.nbytes
+        if total > ch.capacity:
+            raise ValueError(
+                f"array of {arr.nbytes} bytes exceeds channel capacity "
+                f"{ch.capacity}; recompile with a larger "
+                f"buffer_size_bytes")
+        seq = self._seq
+        _wait(lambda: all(
+            ch._u64(32 + 8 * i) >= seq for i in range(ch.n_readers)),
+            timeout, "readers to consume previous message")
+        mv = ch._map()
+        off = ch._payload_off
+        struct.pack_into("<I", mv, off, len(meta))
+        mv[off + 4:off + 4 + len(meta)] = meta
+        body = mv[off + 4 + len(meta):off + total]
+        np.frombuffer(body, dtype=arr.dtype).reshape(arr.shape)[...] = arr
+        ch._set_u64(24, total | _RAW_FLAG)
+        self._seq = seq + 1
+        ch._set_u64(16, self._seq)     # publish
 
     def close(self, timeout: float = 5.0) -> None:
         """Publish the closed marker (readers raise ChannelClosed)."""
@@ -183,18 +252,57 @@ class ChannelReader:
         ch = self.ch
         _wait(lambda: ch._u64(16) >= self._expect, timeout, "message")
         length = ch._u64(24)
+        if length != _CLOSED_LEN and (length & _RAW_FLAG):
+            # refuse BEFORE consuming: the frame stays readable via
+            # read() (decoding here would ack + advance destructively)
+            raise RuntimeError(
+                "read_bytes on a raw-array frame; use read()")
+        data, _ = self._read_frame(timeout)
+        return data
+
+    def _read_frame(self, timeout: Optional[float]):
+        ch = self.ch
+        _wait(lambda: ch._u64(16) >= self._expect, timeout, "message")
+        length = ch._u64(24)
         if length == _CLOSED_LEN:
             raise ChannelClosed(ch.name)
         error = bool(length & _ERROR_FLAG)
-        length &= _ERROR_FLAG - 1
+        raw = bool(length & _RAW_FLAG)
+        length &= _LEN_MASK
         off = ch._payload_off
+        if raw:
+            value = self._decode_array(length, off)
+            ch._set_u64(32 + 8 * self.idx, self._expect)   # ack
+            self._expect += 1
+            return value, True
         data = bytes(ch._map()[off:off + length])
         ch._set_u64(32 + 8 * self.idx, self._expect)   # ack
         self._expect += 1
         if error:
             raise RuntimeError(
                 f"upstream DAG node failed: {pickle.loads(data)}")
-        return data
+        return data, False
+
+    def _decode_array(self, length: int, off: int):
+        """Consume a raw-array frame. The device copy (jax.device_put)
+        reads STRAIGHT from the mapped slot; the slot is only acked —
+        and thus reusable by the writer — after the copy completes."""
+        import numpy as np
+        mv = self.ch._map()
+        (meta_len,) = struct.unpack_from("<I", mv, off)
+        dtype, shape, is_device = pickle.loads(
+            bytes(mv[off + 4:off + 4 + meta_len]))
+        body = mv[off + 4 + meta_len:off + length]
+        view = np.frombuffer(body, dtype=dtype).reshape(shape)
+        if is_device:
+            import jax
+            out = jax.device_put(view)
+            out.block_until_ready()    # copy done before we ack
+            return out
+        return np.array(view)          # own the bytes before ack
 
     def read(self, timeout: Optional[float] = None) -> Any:
-        return pickle.loads(self.read_bytes(timeout))
+        data, raw = self._read_frame(timeout)
+        if raw:
+            return data
+        return pickle.loads(data)

@@ -98,11 +98,35 @@ def _exec_loop(instance, method_name: str, in_channels: List[Channel],
 
     executed = 0
     while True:
-        vals = []
+        vals: List[Any] = [None] * len(readers)
         err: Any = None
         try:
-            for r in readers:
-                vals.append(bounded(r.read))
+            if len(readers) == 1:
+                vals[0] = bounded(readers[0].read)
+            else:
+                # overlap schedule (reference dag_node_operation.py
+                # intent): consume multi-node inputs in ARRIVAL order —
+                # a slow upstream never head-of-line-blocks the inputs
+                # that are already published
+                pending = set(range(len(readers)))
+                poll = 0.005
+                while pending:
+                    progressed = False
+                    for i in list(pending):
+                        try:
+                            vals[i] = readers[i].read(timeout=poll)
+                            pending.discard(i)
+                            progressed = True
+                        except ChannelTimeout:
+                            pass
+                    if progressed:
+                        poll = 0.005
+                    else:
+                        # idle between executes: back the poll off so
+                        # a parked DAG doesn't burn a core
+                        poll = min(poll * 2, 0.25)
+                        if abort.is_set():
+                            raise ChannelClosed("aborted")
         except ChannelClosed:
             # short ack wait: at teardown the driver may never ack the
             # final output, and a 5s stall here would outlive the

@@ -296,6 +296,11 @@ class ChannelReader:
         view = np.frombuffer(body, dtype=dtype).reshape(shape)
         if is_device:
             import jax
+            if jax.default_backend() == "cpu":
+                # CPU PJRT may zero-copy-alias an aligned host buffer:
+                # the returned array would mutate when the writer
+                # reuses the slot after our ack. Own the bytes first.
+                view = np.array(view)
             out = jax.device_put(view)
             out.block_until_ready()    # copy done before we ack
             return out

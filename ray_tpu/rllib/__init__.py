@@ -14,10 +14,11 @@ from ray_tpu.rllib.core.learner import (LearnerGroup, PPOLearner,
 from ray_tpu.rllib.core.rl_module import ActorCriticModule, Categorical
 from ray_tpu.rllib.env.env_runner import EnvRunnerConfig, SingleAgentEnvRunner
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rllib.tune_adapter import tune_trainable
 
 __all__ = [
     "PPO", "PPOConfig", "PPOLearner", "PPOLearnerConfig", "LearnerGroup",
     "ActorCriticModule", "Categorical", "SingleAgentEnvRunner",
     "EnvRunnerConfig", "EnvRunnerGroup", "FaultTolerantActorManager",
-    "RemoteCallResults", "CallResult",
+    "RemoteCallResults", "CallResult", "tune_trainable",
 ]

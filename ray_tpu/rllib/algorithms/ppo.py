@@ -43,6 +43,11 @@ class PPOConfig:
     target_kl: float = 0.05
     num_learners: int = 0              # 0 = local in-process learner
     seed: int = 0
+    # learner-side connector pipeline (reference rllib/connectors/
+    # learner/): e.g. [GeneralAdvantageEstimation(...),
+    # StandardizeAdvantages()] moves GAE out of the jit into a
+    # composable host-side pipeline
+    learner_connectors: Optional[Sequence] = None
 
     def environment(self, env: str) -> "PPOConfig":
         self.env = env
@@ -96,7 +101,8 @@ class PPO:
                 num_minibatches=config.num_minibatches,
                 target_kl=config.target_kl,
                 continuous=self._continuous,
-                seed=config.seed),
+                seed=config.seed,
+                learner_connectors=config.learner_connectors),
             num_learners=config.num_learners)
         self.iteration = 0
         self._total_env_steps = 0

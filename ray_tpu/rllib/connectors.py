@@ -1,15 +1,21 @@
-"""ConnectorV2: composable transform pipelines on the env↔module edges.
+"""ConnectorV2: composable transform pipelines on the env↔module edges
+AND the learner edge.
 
-Parity: reference rllib/connectors (env_to_module/, module_to_env/ —
-ConnectorV2 pieces composed into ConnectorPipelineV2, living on env
-runners). Re-shaped for this stack: a connector is a callable
-`(data, runner) -> data` over numpy batches; pipelines run on the
-env-runner hot path — obs connectors before policy inference, action
-connectors before env.step.
+Parity: reference rllib/connectors (env_to_module/, module_to_env/,
+learner/ — ConnectorV2 pieces composed into ConnectorPipelineV2).
+Re-shaped for this stack:
+- env-side connectors are callables `(data, runner) -> data` over numpy
+  batches, running on the env-runner hot path (obs connectors before
+  policy inference, action connectors before env.step);
+- learner-side connectors are callables `(batch_dict, learner) ->
+  batch_dict` over the full time-major training batch, running in the
+  Learner BEFORE the jitted update (reference
+  rllib/connectors/learner/general_advantage_estimation.py et al).
 
 Built-ins mirror the reference's defaults: observation flattening,
 running-stat normalization (the classic MeanStdFilter), observation
-clipping, action clipping/unsquashing for Box spaces.
+clipping, action clipping for Box spaces; learner-side GAE and
+advantage standardization.
 """
 from __future__ import annotations
 
@@ -153,3 +159,115 @@ class ConnectorPipeline(Connector):
         for i, c in enumerate(self.connectors):
             if i in state:
                 c.set_state(state[i])
+
+
+# ----------------------------------------------------------------------
+# Learner connectors: batch-level transforms before the jitted update
+# (reference rllib/connectors/learner/).
+# ----------------------------------------------------------------------
+class LearnerConnector:
+    """Transforms the full time-major training batch dict. Receives the
+    Learner so connectors can query the module (value predictions)."""
+
+    def __call__(self, batch: dict, learner=None) -> dict:
+        raise NotImplementedError
+
+    def get_state(self) -> dict:
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
+
+class LearnerConnectorPipeline(LearnerConnector):
+    """Ordered composition with the same edit API as the env-side
+    pipeline."""
+
+    def __init__(self, connectors=None):
+        self.connectors: List[LearnerConnector] = list(connectors or [])
+
+    def __call__(self, batch: dict, learner=None) -> dict:
+        for c in self.connectors:
+            batch = c(batch, learner)
+        return batch
+
+    def append(self, c):
+        self.connectors.append(c)
+        return self
+
+    def prepend(self, c):
+        self.connectors.insert(0, c)
+        return self
+
+    def get_state(self) -> dict:
+        return {i: c.get_state() for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state: dict) -> None:
+        for i, c in enumerate(self.connectors):
+            if i in state:
+                c.set_state(state[i])
+
+
+class GeneralAdvantageEstimation(LearnerConnector):
+    """GAE as a learner connector (reference rllib/connectors/learner/
+    general_advantage_estimation.py): queries the learner module's
+    value function, then adds ``advantages`` and ``value_targets`` to
+    the batch. Semantics mirror the in-jit path: ``terminateds`` cuts
+    the bootstrap, ``dones`` (incl. truncation) cuts only the advantage
+    chain — truncation still bootstraps off V(final obs)."""
+
+    def __init__(self, gamma: Optional[float] = None,
+                 lambda_: Optional[float] = None):
+        # None = inherit from the learner's config at call time, so the
+        # connector can never silently diverge from the algorithm's
+        # gamma/gae_lambda (the reference constructs this connector
+        # FROM the algorithm config for the same reason)
+        self.gamma = gamma
+        self.lambda_ = lambda_
+
+    def __call__(self, batch: dict, learner=None) -> dict:
+        cfg = getattr(learner, "config", None)
+        gamma = (self.gamma if self.gamma is not None
+                 else getattr(cfg, "gamma", 0.99))
+        lambda_ = (self.lambda_ if self.lambda_ is not None
+                   else getattr(cfg, "gae_lambda", 0.95))
+        values = learner.compute_values(batch["obs"])     # (T+1, N)
+        rewards = np.asarray(batch["rewards"], np.float32)
+        terms = np.asarray(batch["terminateds"], np.float32)
+        dones = np.asarray(batch["dones"], np.float32)
+        T = rewards.shape[0]
+        adv = np.zeros_like(rewards)
+        carry = np.zeros_like(rewards[0])
+        for t in range(T - 1, -1, -1):
+            delta = (rewards[t]
+                     + gamma * values[t + 1] * (1.0 - terms[t])
+                     - values[t])
+            carry = (delta
+                     + gamma * lambda_ * (1.0 - dones[t])
+                     * carry)
+            adv[t] = carry
+        batch = dict(batch)
+        batch["advantages"] = adv
+        batch["value_targets"] = adv + values[:-1]
+        return batch
+
+
+class StandardizeAdvantages(LearnerConnector):
+    """Zero-mean/unit-variance advantages over VALID transitions only
+    (mask-aware), matching the in-jit normalization."""
+
+    def __init__(self, eps: float = 1e-8):
+        self.eps = eps
+
+    def __call__(self, batch: dict, learner=None) -> dict:
+        adv = np.asarray(batch["advantages"], np.float32)
+        mask = np.asarray(batch.get("mask",
+                                    np.ones_like(adv)), np.float32)
+        denom = max(float(mask.sum()), 1.0)
+        mu = float((adv * mask).sum()) / denom
+        var = float((np.square(adv - mu) * mask).sum()) / denom
+        batch = dict(batch)
+        batch["advantages"] = ((adv - mu)
+                               / np.sqrt(var + self.eps)).astype(
+                                   np.float32)
+        return batch

@@ -50,6 +50,13 @@ class PPOLearnerConfig:
     # inserts the gradient psum — the TPU-native form of the reference's
     # k-GPU DDP learners (torch_learner.py:566). 1 = single device.
     num_devices: int = 1
+    # Learner-side connector pipeline (reference rllib/connectors/
+    # learner/): LearnerConnector instances applied to the numpy batch
+    # BEFORE the jitted update. A pipeline containing
+    # GeneralAdvantageEstimation switches the jit to consume the
+    # connector-computed `advantages`/`value_targets` (build-time
+    # decision — no retracing).
+    learner_connectors: Optional[Sequence] = None
 
 
 class PPOLearner:
@@ -84,6 +91,16 @@ class PPOLearner:
         self._perm_key, init_key = jax.random.split(key)
         self.params = self.module.init(init_key)
         self.opt_state = self._tx.init(self.params)
+        from ray_tpu.rllib.connectors import (GeneralAdvantageEstimation,
+                                              LearnerConnectorPipeline)
+        self._connectors = (
+            LearnerConnectorPipeline(list(config.learner_connectors))
+            if config.learner_connectors else None)
+        self._precomputed_adv = bool(self._connectors and any(
+            isinstance(c, GeneralAdvantageEstimation)
+            for c in self._connectors.connectors))
+        self._values_fn = jax.jit(
+            lambda p, o: self.module.forward(p, o)[1])
         if config.num_devices > 1 and mesh is None:
             from jax.sharding import Mesh
             devs = jax.devices()
@@ -103,12 +120,14 @@ class PPOLearner:
                     mesh, P(*((None, "dp") if name != "obs"
                               else (None, "dp", None))))
             repl = NamedSharding(mesh, P())
+            batch_keys = ["obs", "actions", "logp", "rewards",
+                          "terminateds", "dones", "mask"]
+            if self._precomputed_adv:
+                batch_keys += ["advantages", "value_targets"]
             self._update_fn = jax.jit(
                 self._build_update(),
                 in_shardings=(repl, repl,
-                              {k: shard_for(k) for k in
-                               ("obs", "actions", "logp", "rewards",
-                                "terminateds", "dones", "mask")},
+                              {k: shard_for(k) for k in batch_keys},
                               repl),
                 out_shardings=(repl, repl, repl))
         else:
@@ -162,19 +181,27 @@ class PPOLearner:
                            "entropy": ent_loss, "kl": kl,
                            "clip_frac": clipped}
 
+        precomputed = self._precomputed_adv
+
         def update(params, opt_state, batch, perm_key):
             obs, rewards = batch["obs"], batch["rewards"]
             terms = batch["terminateds"]
             dones, mask = batch["dones"], batch["mask"]
             T, N = rewards.shape
-            _, values = module.forward(params, obs)      # (T+1, N)
-            adv = gae(values, rewards, terms, dones)
-            vtarg = adv + values[:-1]
-            # Normalise advantages over valid transitions only.
             denom = jnp.maximum(jnp.sum(mask), 1.0)
-            mu = jnp.sum(adv * mask) / denom
-            var = jnp.sum(jnp.square(adv - mu) * mask) / denom
-            adv = (adv - mu) * jax.lax.rsqrt(var + 1e-8)
+            _, values = module.forward(params, obs)      # (T+1, N)
+            if precomputed:
+                # the learner-connector pipeline (GAE + standardize)
+                # already produced these on the host
+                adv = batch["advantages"]
+                vtarg = batch["value_targets"]
+            else:
+                adv = gae(values, rewards, terms, dones)
+                vtarg = adv + values[:-1]
+                # Normalise advantages over valid transitions only.
+                mu = jnp.sum(adv * mask) / denom
+                var = jnp.sum(jnp.square(adv - mu) * mask) / denom
+                adv = (adv - mu) * jax.lax.rsqrt(var + 1e-8)
 
             act = batch["actions"]
             flat = {
@@ -234,8 +261,15 @@ class PPOLearner:
         return update
 
     # ------------------------------------------------------------- api
+    def compute_values(self, obs: np.ndarray) -> np.ndarray:
+        """Value predictions for a (T+1, N, obs) stack — the module
+        query learner connectors (GAE) use."""
+        return np.asarray(self._values_fn(self.params, obs))
+
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         t0 = time.perf_counter()
+        if self._connectors is not None:
+            batch = self._connectors(dict(batch), self)
         self._perm_key, sub = jax.random.split(self._perm_key)
         self.params, self.opt_state, metrics = self._update_fn(
             self.params, self.opt_state, batch, sub)
@@ -265,12 +299,17 @@ class PPOLearner:
         self.params = jax.device_put(weights)
 
     def get_state(self) -> Dict[str, Any]:
-        return {"params": jax.device_get(self.params),
-                "opt_state": jax.device_get(self.opt_state)}
+        state = {"params": jax.device_get(self.params),
+                 "opt_state": jax.device_get(self.opt_state)}
+        if self._connectors is not None:
+            state["connectors"] = self._connectors.get_state()
+        return state
 
     def set_state(self, state: Dict[str, Any]) -> None:
         self.params = jax.device_put(state["params"])
         self.opt_state = jax.device_put(state["opt_state"])
+        if self._connectors is not None and "connectors" in state:
+            self._connectors.set_state(state["connectors"])
 
     def ping(self) -> str:
         return "pong"

@@ -111,3 +111,5 @@ class Result:
     path: str
     metrics_history: list = dataclasses.field(default_factory=list)
     error: Optional[BaseException] = None
+    # trial config when produced by a Tune sweep (reference Result.config)
+    config: Optional[Dict[str, Any]] = None

@@ -84,6 +84,20 @@ class ResultGrid:
     def __len__(self) -> int:
         return len(self.trials)
 
+    def _trial_result(self, t: Trial) -> Result:
+        ckpt = (Checkpoint(t.best_checkpoint_path)
+                if t.best_checkpoint_path else None)
+        return Result(metrics=dict(t.last_result), checkpoint=ckpt,
+                      path=self.path, metrics_history=[],
+                      error=t.error, config=dict(t.config))
+
+    def __iter__(self):
+        """Per-trial Results, reference ResultGrid iteration."""
+        return (self._trial_result(t) for t in self.trials)
+
+    def __getitem__(self, i: int) -> Result:
+        return self._trial_result(self.trials[i])
+
     @property
     def num_errors(self) -> int:
         return sum(1 for t in self.trials if t.status == ERROR)
@@ -103,13 +117,12 @@ class ResultGrid:
                 best, best_v = t, v
         if best is None:
             raise ValueError(f"no trial reported metric {metric!r}")
-        ckpt = (Checkpoint(best.best_checkpoint_path)
-                if best.best_checkpoint_path else None)
-        return Result(metrics={**best.last_result,
-                               "config": best.config,
-                               "trial_id": best.trial_id},
-                      checkpoint=ckpt, path=self.path,
-                      metrics_history=[], error=None)
+        r = self._trial_result(best)
+        # kept in metrics for backwards compatibility with earlier
+        # callers; Result.config is the structured home
+        r.metrics.setdefault("config", dict(best.config))
+        r.metrics.setdefault("trial_id", best.trial_id)
+        return r
 
 
 # ------------------------------------------------------------ runners

@@ -79,6 +79,107 @@ def test_workflow_unknown_id_raises(tmp_path):
         workflow.resume("nope", storage=str(tmp_path))
 
 
+def test_workflow_content_key_invalidates_stale_steps(ray_cluster,
+                                                      tmp_path):
+    """Editing a branch between run and resume must NOT silently
+    replay the old step's result at the same call position: the
+    content key (name + arg hash) mismatches and the step re-runs."""
+    from ray_tpu import workflow
+    store = str(tmp_path / "store")
+
+    @workflow.step
+    def compute(x):
+        return x * 2
+
+    @workflow.step
+    def explode(x):
+        raise RuntimeError("boom")
+
+    def flow_v1(fail=True):
+        a = compute(3)
+        if fail:
+            explode(a)
+        return a
+
+    with pytest.raises(Exception, match="boom"):
+        workflow.run(flow_v1, workflow_id="wfk", storage=store)
+
+    # v2 changes the *first* step's argument: position 0 must not
+    # replay compute(3)'s checkpoint.
+    def flow_v2():
+        return compute(5)
+
+    out = workflow.run(flow_v2, workflow_id="wfk", storage=store)
+    assert out == 10
+    stats = workflow.last_run_stats()
+    assert stats["invalidated"] == 1 and stats["executed"] == 1
+
+
+def test_workflow_step_options_retry_and_catch(ray_cluster, tmp_path):
+    from ray_tpu import workflow
+    store = str(tmp_path / "store")
+    marker = str(tmp_path / "attempts")
+    os.makedirs(marker)
+
+    @workflow.step(retry_exceptions=(ValueError,), max_retries=3)
+    def flaky():
+        n = len(os.listdir(marker))
+        open(os.path.join(marker, f"a{n}"), "w").close()
+        if n < 2:
+            raise ValueError("transient")
+        return "ok"
+
+    @workflow.step(catch_exceptions=True)
+    def fails():
+        raise KeyError("caught")
+
+    def flow():
+        first = flaky()
+        res, err = fails()
+        return first, res, type(err).__name__
+
+    out = workflow.run(flow, workflow_id="wfr", storage=store)
+    assert out == ("ok", None, "KeyError")
+    assert len(os.listdir(marker)) == 3  # 2 failures + 1 success
+    meta = workflow.get_metadata("wfr", storage=store)
+    (step_rec,) = [m for f, m in meta["step_metadata"].items()
+                   if "flaky" in f]
+    assert step_rec["attempts"] == 3
+    kinds = [e["event"] for e in meta["events"]]
+    assert kinds.count("retrying") == 2 and "failed" in kinds
+
+
+def test_workflow_step_timeout(ray_cluster, tmp_path):
+    from ray_tpu import workflow
+    store = str(tmp_path / "store")
+
+    @workflow.step(timeout=0.5, max_retries=0)
+    def slow():
+        import time as _t
+        _t.sleep(30)
+
+    def flow():
+        return slow()
+
+    with pytest.raises(workflow.StepTimeoutError):
+        workflow.run(flow, workflow_id="wft", storage=store)
+    st = workflow.get_status("wft", storage=store)
+    assert st["status"] == "FAILED"
+
+
+def test_workflow_list_and_status(ray_cluster, tmp_path):
+    from ray_tpu import workflow
+    store = str(tmp_path / "store")
+
+    @workflow.step
+    def one():
+        return 1
+
+    workflow.run(lambda: one(), workflow_id="wl_ok", storage=store)
+    listed = dict(workflow.list_workflows(storage=store))
+    assert listed == {"wl_ok": "SUCCEEDED"}
+
+
 # ----------------------------------------------------------- dashboard
 def test_dashboard_endpoints(ray_cluster):
     from ray_tpu.dashboard import start_dashboard, stop_dashboard

@@ -56,6 +56,9 @@ class NodeTableRecord:
     death_cause: str = ""
     labels: dict = field(default_factory=dict)
     registered_at: float = field(default_factory=time.time)
+    # last per-node reporter sample (load, memory, worker RSS) carried
+    # on heartbeats — reference dashboard/modules/reporter agent
+    host_stats: dict = field(default_factory=dict)
 
 
 class Controller:
@@ -340,12 +343,19 @@ class Controller:
                 if cause:
                     rec.death_cause = cause
 
+    def update_host_stats(self, node_id: str, stats: dict) -> None:
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is not None:
+                rec.host_stats = dict(stats)
+
     def list_nodes(self) -> list[dict]:
         with self._lock:
             return [{
                 "node_id": r.node_id, "alive": r.alive,
                 "is_head": r.is_head, "resources": dict(r.resources),
                 "death_cause": r.death_cause, "labels": dict(r.labels),
+                "host_stats": dict(r.host_stats),
             } for r in self._nodes.values()]
 
     def actors_on_node(self, node_id: str) -> list[str]:
@@ -407,6 +417,13 @@ class Controller:
                 "task_id": task_id, "name": name, "state": state,
                 "worker_id": worker_id, "error": error, "ts": time.time(),
             })
+
+    def record_task_events(self, events: list[dict]) -> None:
+        """Batched ingest from worker-side event buffers (reference
+        GcsTaskManager AddTaskEventData): events carry their own
+        worker-side ts/duration_s."""
+        with self._lock:
+            self._task_events.extend(events)
 
     def list_task_events(self, limit: int = 1000) -> list[dict]:
         with self._lock:

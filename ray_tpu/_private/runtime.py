@@ -488,6 +488,8 @@ class Runtime(_context.BaseContext):
             rec = self.cluster.get_node(nid)
             if rec is not None:
                 rec.scheduler.on_heartbeat(msg)
+            if "host_stats" in msg:
+                self.controller.update_host_stats(nid, msg["host_stats"])
         elif mtype == protocol.NODE_EVENT:
             self._on_node_event(conn, msg)
         elif mtype == protocol.NODE_TASK_DONE:
@@ -1224,6 +1226,9 @@ class Runtime(_context.BaseContext):
         if op == "list_placement_groups":
             return self.cluster.pg_table()
         if op == "list_nodes":
+            # the head doesn't heartbeat to itself: sample it live
+            self.controller.update_host_stats(
+                self.head_node_id, self.scheduler.host_stats())
             return self.controller.list_nodes()
         if op == "cluster_resources":
             return self.cluster.total_resources()
@@ -1244,6 +1249,9 @@ class Runtime(_context.BaseContext):
         if op == "pubsub_publish":
             return self.controller.pubsub.publish(
                 kwargs["channel"], kwargs["message"])
+        if op == "record_task_events":
+            self.controller.record_task_events(kwargs["events"])
+            return True
         if op == "cancel_task":
             self.cancel_task(kwargs["object_id"],
                              kwargs.get("force", False))

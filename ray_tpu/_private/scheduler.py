@@ -85,6 +85,42 @@ def _node_memory_fraction() -> float:
         return 0.0
 
 
+def sample_host_stats(worker_pids=()) -> dict:
+    """Per-node reporter sample (reference dashboard/modules/reporter):
+    load, memory, and the worker pool's aggregate RSS — carried on node
+    heartbeats and surfaced by the dashboard's /nodes endpoint."""
+    stats: dict = {"ts": time.time(), "num_cpus": os.cpu_count(),
+                   "num_workers": len(worker_pids)}
+    try:
+        stats["load_1m"] = round(os.getloadavg()[0], 2)
+    except OSError:
+        pass
+    try:
+        with open("/proc/meminfo") as f:
+            info = {}
+            for line in f:
+                k, _, rest = line.partition(":")
+                info[k] = int(rest.split()[0])          # kB
+        total = info.get("MemTotal", 0)
+        avail = info.get("MemAvailable", total)
+        stats["mem_total_mb"] = total // 1024
+        stats["mem_available_mb"] = avail // 1024
+        if total > 0:
+            stats["mem_used_pct"] = round(100 * (1 - avail / total), 1)
+    except OSError:
+        pass
+    rss = 0
+    page = os.sysconf("SC_PAGE_SIZE")
+    for pid in worker_pids:
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                rss += int(f.read().split()[1]) * page
+        except (OSError, ValueError, IndexError):
+            pass
+    stats["workers_rss_mb"] = rss // (1024 * 1024)
+    return stats
+
+
 def fits(avail: dict[str, float], need: dict[str, float]) -> bool:
     return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in need.items() if v)
 
@@ -324,13 +360,26 @@ class Scheduler:
         taken under the scheduler lock so a concurrent dispatch can't
         mutate the dicts mid-serialization."""
         with self._lock:
-            return {
+            snap = {
                 "avail": dict(self.avail),
                 "total": dict(self.total),
                 "pending_demand": dict(self._pending_demand),
                 "pending_shapes": self.pending_shapes(),
                 "is_idle": self.is_idle(),
             }
+            pids = [r.proc.pid for r in self._workers.values()
+                    if r.proc is not None]
+        snap["host_stats"] = sample_host_stats(pids)
+        return snap
+
+    def host_stats(self) -> dict:
+        """Reporter sample alone (for the head's own list_nodes view) —
+        avoids copying the full resource ledgers heartbeat_snapshot
+        builds."""
+        with self._lock:
+            pids = [r.proc.pid for r in self._workers.values()
+                    if r.proc is not None]
+        return sample_host_stats(pids)
 
     def worker_running_task(self, task_id: str):
         """(worker_id, spec) currently executing (or queued in) the

@@ -18,6 +18,7 @@ import os
 import pickle
 import sys
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
@@ -237,6 +238,19 @@ class WorkerExecutor:
         self._actor_spec: Optional[ActorSpec] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.stop_event = threading.Event()
+        # worker-side task-event buffer: execution-truth timestamps
+        # (queue/env latency = gap vs the driver's RUNNING event),
+        # batched + flushed periodically instead of one RPC per event
+        # (reference src/ray/core_worker/task_event_buffer.cc)
+        self._event_buf: list[dict] = []
+        self._event_lock = threading.Lock()
+        self._event_last_flush = time.time()
+        self._event_flush_s = float(
+            os.environ.get("RAY_TPU_TASK_EVENT_FLUSH_S", "2.0"))
+        self._event_cap = int(
+            os.environ.get("RAY_TPU_TASK_EVENT_BUFFER", "32"))
+        threading.Thread(target=self._event_flush_loop,
+                         name="rtpu-task-events", daemon=True).start()
 
     # ---- message entry (called on reader thread) ----
     def handle(self, conn: protocol.Connection, msg: dict) -> None:
@@ -266,6 +280,42 @@ class WorkerExecutor:
             self.stop_event.set()
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)
+
+    # ---- worker-side task events ----
+    def _record_event(self, task_id: str, name: str, state: str,
+                      **extra) -> None:
+        ev = {"task_id": task_id, "name": name, "state": state,
+              "ts": time.time(), "worker_id": self.ctx.worker_id,
+              **extra}
+        with self._event_lock:
+            self._event_buf.append(ev)
+            should = (len(self._event_buf) >= self._event_cap
+                      or time.time() - self._event_last_flush
+                      >= self._event_flush_s)
+            if should:
+                # claim the window now so a burst of events doesn't
+                # spawn one flush thread each before the first one runs
+                self._event_last_flush = time.time()
+        if should:
+            # never block the caller (async actors record from the
+            # event-loop thread): flush on a short-lived thread
+            threading.Thread(target=self.flush_events,
+                             daemon=True).start()
+
+    def _event_flush_loop(self) -> None:
+        while not self.stop_event.wait(self._event_flush_s):
+            self.flush_events()
+
+    def flush_events(self) -> None:
+        with self._event_lock:
+            if not self._event_buf:
+                return
+            batch, self._event_buf = self._event_buf, []
+            self._event_last_flush = time.time()
+        try:
+            self.ctx.state_op("record_task_events", events=batch)
+        except Exception:
+            pass   # head unreachable (shutdown race): best-effort
 
     def _cancel_running(self, task_id: str) -> None:
         """Interrupt a running task by raising TaskCancelledError in its
@@ -392,6 +442,8 @@ class WorkerExecutor:
 
     def _run_task(self, spec: TaskSpec) -> None:
         from ray_tpu.exceptions import TaskCancelledError
+        t0 = time.time()
+        self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
         try:
             try:
                 with self._cancel_lock:
@@ -426,6 +478,9 @@ class WorkerExecutor:
             error = True
         self._send_results(spec.task_id, spec.return_ids, result,
                            spec.num_returns, error, name=spec.name)
+        self._record_event(spec.task_id, spec.name,
+                           "EXEC_FAILED" if error else "EXEC_FINISHED",
+                           duration_s=time.time() - t0)
 
     def _create_actor(self, spec: ActorSpec) -> None:
         try:
@@ -462,6 +517,8 @@ class WorkerExecutor:
         return method(*args, **kwargs)
 
     def _run_actor_task(self, spec: ActorTaskSpec) -> None:
+        t0 = time.time()
+        self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
         try:
             result = self._invoke_actor_method(spec)
             error = False
@@ -471,8 +528,13 @@ class WorkerExecutor:
         self._send_results(spec.task_id, spec.return_ids, result,
                            spec.num_returns, error, is_actor_task=True,
                            actor_id=spec.actor_id, name=spec.name)
+        self._record_event(spec.task_id, spec.name,
+                           "EXEC_FAILED" if error else "EXEC_FINISHED",
+                           duration_s=time.time() - t0)
 
     async def _run_actor_task_async(self, spec: ActorTaskSpec) -> None:
+        t0 = time.time()
+        self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
         try:
             method = getattr(self._actor, spec.method_name)
             args, kwargs = self._resolve_args(spec.args, spec.kwargs)
@@ -484,6 +546,9 @@ class WorkerExecutor:
         self._send_results(spec.task_id, spec.return_ids, result,
                            spec.num_returns, error, is_actor_task=True,
                            actor_id=spec.actor_id, name=spec.name)
+        self._record_event(spec.task_id, spec.name,
+                           "EXEC_FAILED" if error else "EXEC_FINISHED",
+                           duration_s=time.time() - t0)
 
 
 def main() -> None:
@@ -511,6 +576,7 @@ def main() -> None:
     conn.send({"type": protocol.REGISTER, "worker_id": args.worker_id,
                "pid": os.getpid()})
     executor.stop_event.wait()
+    executor.flush_events()
     conn.close()
     # Daemonic pool threads may be mid-task; hard-exit like the reference's
     # worker does on graceful shutdown after draining.

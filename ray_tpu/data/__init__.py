@@ -12,13 +12,17 @@ from ray_tpu.data.aggregate import (AbsMax, AggregateFn, Count, Max, Mean,
 from ray_tpu.data.block import Block, BlockMetadata
 from ray_tpu.data.dataset import (ActorPoolStrategy, DataIterator, Dataset,
                                   from_items, from_numpy, range, read_csv,
-                                  read_json, read_parquet)
+                                  read_binary_files, read_images,
+                                  read_json, read_parquet, read_text,
+                                  read_tfrecords)
 from ray_tpu.data.grouped_data import GroupedData
 from ray_tpu.data.jax_iter import iter_jax_batches
+from ray_tpu.data.streaming import StageSpec
 
 __all__ = [
     "Block", "BlockMetadata", "DataIterator", "Dataset", "from_items",
     "from_numpy", "range", "read_csv", "read_json", "read_parquet",
-    "iter_jax_batches", "ActorPoolStrategy", "GroupedData",
+    "read_text", "read_binary_files", "read_images", "read_tfrecords",
+    "iter_jax_batches", "ActorPoolStrategy", "GroupedData", "StageSpec",
     "AggregateFn", "Count", "Sum", "Min", "Max", "Mean", "Std", "AbsMax",
 ]

@@ -85,11 +85,16 @@ class Dataset:
     def __init__(self, read_tasks: List[ds.ReadTask],
                  ops: Optional[List[Op]] = None,
                  max_in_flight: int = 4,
-                 compute: Optional[ActorPoolStrategy] = None):
+                 compute: Optional[ActorPoolStrategy] = None,
+                 op_specs: Optional[list] = None):
         self._tasks = read_tasks
         self._ops: List[Op] = list(ops or [])
         self._max_in_flight = max_in_flight
         self._compute = compute
+        # per-op StageSpec (or None = fuse) — parallel to _ops
+        self._op_specs: list = (list(op_specs) if op_specs is not None
+                                else [None] * len(self._ops))
+        self._stats_sink: list = []
 
     # ------------------------------------------------------ transforms
     def map_batches(self, fn: Union[Callable[[Block], Dict[str, Any]], type],
@@ -97,18 +102,33 @@ class Dataset:
                     compute: Optional[ActorPoolStrategy] = None,
                     fn_constructor_args: tuple = (),
                     fn_constructor_kwargs: Optional[dict] = None,
+                    num_cpus: Optional[float] = None,
+                    concurrency: Optional[int] = None,
                     ) -> "Dataset":
         """Transform batches. `fn` may be a callable class (stateful —
         constructed once per worker); pass compute=ActorPoolStrategy(n)
-        to run the pipeline on a pool of n long-lived actors."""
+        to run the pipeline on a pool of n long-lived actors.
+
+        Passing `num_cpus` and/or `concurrency` gives this op its OWN
+        physical stage (per-operator streaming execution: separate
+        resources, in-flight window, and backpressure — reference
+        streaming_executor); `compute` then scopes the actor pool to
+        just this stage instead of the whole pipeline."""
         if isinstance(fn, type):
             from ray_tpu.data.executor import ClassSpec
             if compute is None:
                 compute = ActorPoolStrategy(2)
             fn = ClassSpec(fn)
-        out = self._with_op(("map_batches", fn, batch_size,
-                             fn_constructor_args,
-                             fn_constructor_kwargs or {}))
+        op = ("map_batches", fn, batch_size, fn_constructor_args,
+              fn_constructor_kwargs or {})
+        if num_cpus is not None or concurrency is not None:
+            from ray_tpu.data.streaming import StageSpec
+            spec = StageSpec(
+                num_cpus=num_cpus if num_cpus is not None else 1.0,
+                concurrency=concurrency if concurrency is not None else 4,
+                compute=compute)
+            return self._with_op(op, spec)
+        out = self._with_op(op)
         if compute is not None:
             out._compute = compute
         return out
@@ -122,9 +142,9 @@ class Dataset:
     def flat_map(self, fn: Callable[[Dict], Sequence[Dict]]) -> "Dataset":
         return self._with_op(("flat_map", fn))
 
-    def _with_op(self, op: Op) -> "Dataset":
+    def _with_op(self, op: Op, spec=None) -> "Dataset":
         return Dataset(self._tasks, self._ops + [op], self._max_in_flight,
-                       self._compute)
+                       self._compute, op_specs=self._op_specs + [spec])
 
     # ------------------------------------------- shuffle-backed relations
     def groupby(self, key: Union[str, List[str]],
@@ -258,7 +278,8 @@ class Dataset:
                 f"cannot split {len(self._tasks)} partitions into {n} "
                 f"shards; re-read with override_num_blocks>={n}")
         return [Dataset(self._tasks[i::n], list(self._ops),
-                        self._max_in_flight, self._compute)
+                        self._max_in_flight, self._compute,
+                        op_specs=self._op_specs)
                 for i in _irange(n)]
 
     def repartition(self, n: int) -> "Dataset":
@@ -283,12 +304,23 @@ class Dataset:
 
     # ------------------------------------------------------ consumption
     def iter_blocks(self) -> Iterator[Block]:
+        if any(s is not None for s in self._op_specs):
+            from ray_tpu.data.streaming import execute_streaming
+            return execute_streaming(self._tasks, self._ops,
+                                     self._op_specs,
+                                     stage0_compute=self._compute,
+                                     stats_sink=self._stats_sink)
         if self._compute is not None:
             from ray_tpu.data.executor import stream_blocks_actor_pool
             return stream_blocks_actor_pool(
                 self._tasks, self._ops, pool_size=self._compute.size)
         return stream_blocks(self._tasks, self._ops,
                              max_in_flight=self._max_in_flight)
+
+    def stats(self):
+        """Per-stage execution stats of the last streaming (per-op
+        staged) iteration, or None (reference Dataset.stats())."""
+        return self._stats_sink[-1] if self._stats_sink else None
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for b in self.iter_blocks():
@@ -340,6 +372,12 @@ class Dataset:
     def write_parquet(self, path: str) -> List[str]:
         return ds.write_parquet(self.iter_blocks(), path)
 
+    def write_csv(self, path: str) -> List[str]:
+        return ds.write_csv(self.iter_blocks(), path)
+
+    def write_tfrecords(self, path: str) -> List[str]:
+        return ds.write_tfrecords(self.iter_blocks(), path)
+
     # ------------------------------------------------------------ misc
     def num_partitions(self) -> int:
         return len(self._tasks)
@@ -390,6 +428,23 @@ def read_parquet(paths, *, columns: Optional[List[str]] = None,
 
 def read_csv(paths, *, rows_per_block: int = 65536) -> Dataset:
     return Dataset(ds.csv_tasks(paths, rows_per_block))
+
+
+def read_text(paths, *, rows_per_block: int = 65536) -> Dataset:
+    return Dataset(ds.text_tasks(paths, rows_per_block))
+
+
+def read_binary_files(paths, *, include_paths: bool = True) -> Dataset:
+    return Dataset(ds.binary_tasks(paths, include_paths))
+
+
+def read_images(paths, *, size=None, mode: str = "RGB",
+                include_paths: bool = False) -> Dataset:
+    return Dataset(ds.image_tasks(paths, size, mode, include_paths))
+
+
+def read_tfrecords(paths, *, rows_per_block: int = 4096) -> Dataset:
+    return Dataset(ds.tfrecord_tasks(paths, rows_per_block))
 
 
 def from_numpy(arrays: Dict[str, np.ndarray], *,

@@ -289,3 +289,187 @@ def test_trainer_consumes_dataset_shards(ray_cluster, tmp_path):
     assert result.error is None
     # each worker saw half the corpus
     assert result.metrics["seen"] == 32
+
+
+# ------------------------------------------ per-operator streaming
+def test_streaming_staged_execution(ray_cluster):
+    """An op with its own resources gets its own physical stage;
+    results and ordering match the fused path, stats expose stages."""
+    def double(b):
+        return {"id": b["id"] * 2}
+
+    def add_one(b):
+        return {"id": b["id"] + 1}
+
+    ds = (rd.range(40, override_num_blocks=4)
+          .map_batches(double)                       # fuses into read
+          .map_batches(add_one, num_cpus=1, concurrency=2))  # own stage
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == [2 * i + 1 for i in range(40)]
+    st = ds.stats()
+    assert st is not None and len(st.stages) == 2
+    assert st.stages[0]["ops"] == ["map_batches"]    # read+double fused
+    assert st.stages[1]["concurrency"] == 2
+    assert st.stages[1]["tasks"] == 4                # one per partition
+    assert st.stages[1]["blocks_out"] >= 4
+
+
+def test_streaming_stage_actor_pool(ray_cluster):
+    """A per-op ActorPoolStrategy scopes the pool to that stage only;
+    callable-class state persists across partitions within the pool."""
+    class Tagger:
+        def __init__(self, base):
+            self.base = base
+            self.seen = 0
+
+        def __call__(self, b):
+            self.seen += 1
+            return {"id": b["id"], "seen": np.full(len(b["id"]),
+                                                   self.seen),
+                    "base": np.full(len(b["id"]), self.base)}
+
+    ds = (rd.range(24, override_num_blocks=6)
+          .map_batches(Tagger, fn_constructor_args=(7,),
+                       compute=rd.ActorPoolStrategy(2),
+                       concurrency=2))
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == list(range(24))
+    assert all(r["base"] == 7 for r in rows)
+    # 6 partitions over a 2-actor pool: some actor saw >1 partition
+    assert max(r["seen"] for r in rows) > 1
+    st = ds.stats()
+    assert st.stages[1]["actor_pool"] is True
+
+
+def test_streaming_backpressure_bounds_inflight(ray_cluster):
+    """A slow downstream stage must throttle the upstream reader: the
+    upstream may run ahead only by its window + the bounded backlog."""
+    import ray_tpu as rt
+
+    class TouchCounter:
+        def __init__(self):
+            self.n = 0
+
+        def touch(self):
+            self.n += 1
+
+        def peak(self):
+            return self.n
+
+    counter = rt.remote(TouchCounter).remote()
+
+    def track(b):
+        rt.get(counter.touch.remote())
+        return b
+
+    def slow(b):
+        time.sleep(0.15)
+        return b
+
+    ds = (rd.range(64, override_num_blocks=16)
+          .map_batches(track)
+          .map_batches(slow, concurrency=1))
+    it = ds.iter_blocks()
+    next(it)  # pull ONE output block, then stop consuming
+    high = rt.get(counter.peak.remote())
+    # fused read stage window (4) + backlog slack; far below 16
+    assert high <= 12, high
+    for _ in it:
+        pass
+    assert rt.get(counter.peak.remote()) == 16  # all eventually ran
+
+
+def test_streaming_stage0_keeps_dataset_actor_pool(ray_cluster):
+    """A dataset-level ActorPoolStrategy (attached by a spec-less
+    stateful map_batches) must survive the switch to staged execution:
+    stage 0 runs on a persistent pool, not one-shot tasks."""
+    class Counter:
+        def __init__(self):
+            self.seen = 0
+
+        def __call__(self, b):
+            self.seen += 1
+            return {"id": b["id"], "seen": np.full(len(b["id"]),
+                                                   self.seen)}
+
+    ds = (rd.range(24, override_num_blocks=6)
+          .map_batches(Counter, compute=rd.ActorPoolStrategy(2))
+          .map_batches(lambda b: b, concurrency=2))   # forces staging
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == list(range(24))
+    # persistent pool => some instance saw more than one partition
+    assert max(r["seen"] for r in rows) > 1
+    st = ds.stats()
+    assert st.stages[0]["actor_pool"] is True
+
+
+def test_streaming_local_fallback_no_runtime(tmp_path):
+    ds = (rd.range(10, override_num_blocks=2)
+          .map_batches(lambda b: {"id": b["id"] + 1},
+                       num_cpus=1, concurrency=2))
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(1, 11))
+
+
+# ------------------------------------------------ datasource breadth
+def test_read_text_and_binary(tmp_path):
+    p = tmp_path / "a.txt"
+    p.write_text("alpha\nbeta\ngamma\n")
+    rows = rd.read_text(str(p)).take_all()
+    assert [r["text"] for r in rows] == ["alpha", "beta", "gamma"]
+
+    b = tmp_path / "blob.bin"
+    b.write_bytes(b"\x00\x01binary")
+    rows = rd.read_binary_files(str(b)).take_all()
+    assert rows[0]["bytes"] == b"\x00\x01binary"
+    assert rows[0]["path"].endswith("blob.bin")
+
+
+def test_read_images(tmp_path):
+    from PIL import Image
+    for i, shape in enumerate([(8, 6), (10, 12)]):
+        img = Image.fromarray(
+            (np.arange(shape[0] * shape[1] * 3) % 255).astype(
+                np.uint8).reshape(shape[0], shape[1], 3))
+        img.save(tmp_path / f"im{i}.png")
+    # resized: dense batched column
+    rows = rd.read_images(str(tmp_path / "*.png"), size=(4, 5),
+                          include_paths=True).take_all()
+    assert len(rows) == 2
+    assert all(r["image"].shape == (4, 5, 3) for r in rows)
+    assert all(r["image"].dtype == np.uint8 for r in rows)
+    assert {os.path.basename(r["path"]) for r in rows} == {"im0.png",
+                                                           "im1.png"}
+
+
+def test_tfrecords_roundtrip(tmp_path):
+    ds1 = rd.from_items([
+        {"name": "a", "score": 1.5, "count": 7,
+         "vec": np.asarray([1.0, 2.0, 3.0], dtype=np.float32),
+         "raw": b"\x00\xff"},
+        {"name": "b", "score": -2.25, "count": -3,
+         "vec": np.asarray([4.0, 5.0, 6.0], dtype=np.float32),
+         "raw": b"xyz"},
+    ], override_num_blocks=1)
+    (out,) = ds1.write_tfrecords(str(tmp_path / "tfr"))
+    rows = sorted(rd.read_tfrecords(out).take_all(),
+                  key=lambda r: r["name"])
+    assert [r["name"] for r in rows] == [b"a", b"b"]  # tf semantics:
+    assert rows[0]["raw"] == b"\x00\xff"              # strings = bytes
+    assert rows[0]["count"] == 7 and rows[1]["count"] == -3
+    assert abs(rows[1]["score"] - (-2.25)) < 1e-6
+    np.testing.assert_allclose(rows[0]["vec"], [1, 2, 3])
+
+
+def test_tfrecord_crc_is_real_crc32c(tmp_path):
+    # known-answer test: crc32c("123456789") == 0xE3069283
+    from ray_tpu.data.datasource import _crc32c
+    assert _crc32c(b"123456789") == 0xE3069283
+
+
+def test_write_csv_roundtrip(tmp_path):
+    ds1 = rd.from_items([{"x": i, "y": f"s{i}"} for i in range(5)],
+                        override_num_blocks=2)
+    (out,) = ds1.write_csv(str(tmp_path / "csv"))
+    rows = sorted(rd.read_csv(out).take_all(), key=lambda r: r["x"])
+    assert [int(r["x"]) for r in rows] == list(range(5))
+    assert [r["y"] for r in rows] == [f"s{i}" for i in range(5)]

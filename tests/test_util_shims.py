@@ -105,3 +105,39 @@ def test_state_api_lists(ray_cluster):
     assert nodes and nodes[0]["alive"]
     assert state.cluster_resources().get("CPU", 0) > 0
     assert "bytes" in state.object_store_stats()
+
+
+def test_worker_side_task_events_and_host_stats(ray_cluster):
+    """Workers buffer EXEC_* events locally and flush them batched to
+    the head (reference task_event_buffer.cc); node listings carry the
+    per-node reporter sample from heartbeats."""
+    import time as _t
+
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def work():
+        _t.sleep(0.05)
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(3)])
+    # flush interval is 2s; poll until the batch lands
+    deadline = _t.time() + 10
+    evs = []
+    while _t.time() < deadline:
+        # task name is the qualname (here: <test fn>.<locals>.work)
+        evs = [e for e in state.list_tasks()
+               if e["state"].startswith("EXEC_")
+               and e.get("name", "").endswith("work")]
+        if sum(e["state"] == "EXEC_FINISHED" for e in evs) >= 3:
+            break
+        _t.sleep(0.25)
+    finished = [e for e in evs if e["state"] == "EXEC_FINISHED"]
+    assert len(finished) >= 3
+    assert all(e["duration_s"] >= 0.05 for e in finished)
+    assert all(e["worker_id"] for e in finished)
+
+    nodes = state.list_nodes()
+    hs = nodes[0]["host_stats"]
+    assert hs["mem_total_mb"] > 0 and hs["num_cpus"] >= 1
+    assert "workers_rss_mb" in hs

@@ -237,8 +237,13 @@ def main(as_json: bool = False) -> dict:
         print(json.dumps(results))
     else:
         for name, r in results.items():
-            print(f"{name:28s} {r['per_second']:>12} {r['unit']}/s "
-                  f"(n={r['n']}, {r['seconds']}s)")
+            if "per_second" in r:
+                print(f"{name:28s} {r['per_second']:>12} {r['unit']}/s "
+                      f"(n={r['n']}, {r.get('seconds', '?')}s)")
+            else:
+                extra = {k: v for k, v in r.items()
+                         if k not in ("n", "unit")}
+                print(f"{name:28s} {extra}")
     return results
 
 

@@ -77,7 +77,8 @@ class ClusterTaskManager:
         # against node TYPES, not live nodes, when autoscaling).
         self.autoscaling_enabled = False
         self.autoscaler_node_types: List[dict] = []
-        self._lock = threading.RLock()
+        from ray_tpu._private.debug_sync import make_lock
+        self._lock = make_lock("cluster", reentrant=True)
         self._nodes: Dict[str, NodeRecord] = {}
         self._pgs: Dict[str, PGRecord] = {}
         self._pending_pgs: List[str] = []
@@ -199,10 +200,16 @@ class ClusterTaskManager:
 
     # ------------------------------------------------- worker routing
     def scheduler_for_worker(self, worker_id: str) -> Optional[Scheduler]:
+        # Snapshot under the cluster lock, probe AFTER releasing it:
+        # owns_worker takes the node's scheduler lock, and dispatch paths
+        # hold that lock while calling back into cluster methods — probing
+        # lock-held is a cluster->scheduler / scheduler->cluster ABBA
+        # (flagged by the RAY_TPU_DEBUG_LOCKS order detector).
         with self._lock:
-            for n in self._nodes.values():
-                if n.scheduler.owns_worker(worker_id):
-                    return n.scheduler
+            nodes = list(self._nodes.values())
+        for n in nodes:
+            if n.scheduler.owns_worker(worker_id):
+                return n.scheduler
         return None
 
     def scheduler_for_node(self, node_id: str) -> Optional[Scheduler]:
@@ -258,11 +265,17 @@ class ClusterTaskManager:
         if getattr(spec, "node_id", None) or getattr(
                 spec, "placement_group_id", None):
             return False                  # constrained: cannot move
+        constraints = getattr(spec, "label_constraints", None)
         need = Scheduler.need_of(spec)
         best = None
         for n in self.alive_nodes():
             if n.node_id == from_node_id:
                 continue
+            if constraints is not None:
+                from ray_tpu.util.scheduling_strategies import \
+                    labels_match
+                if not labels_match(n.labels, constraints[0]):
+                    continue
             if fits(n.scheduler.effective_avail(), need):
                 best = n
                 break
@@ -296,6 +309,20 @@ class ClusterTaskManager:
             return None
         need = Scheduler.need_of(spec)
         feasible = [n for n in nodes if fits(n.scheduler.total, need)]
+        constraints = getattr(spec, "label_constraints", None)
+        if constraints is not None:
+            # node-label scheduling (reference
+            # NodeLabelSchedulingStrategy): hard constraints filter,
+            # soft constraints prefer among the survivors
+            from ray_tpu.util.scheduling_strategies import labels_match
+            hard, soft = constraints
+            feasible = [n for n in feasible
+                        if labels_match(n.labels, hard)]
+            if soft:
+                preferred = [n for n in feasible
+                             if labels_match(n.labels, soft)]
+                if preferred:
+                    feasible = preferred
         if not feasible:
             return None
         # Pack phase: first node (stable order) with enough room now and

@@ -66,7 +66,8 @@ class Controller:
         if task_event_capacity is None:
             from ray_tpu._private.config import CONFIG as _CFG
             task_event_capacity = _CFG.task_event_history
-        self._lock = threading.RLock()
+        from ray_tpu._private.debug_sync import make_lock
+        self._lock = make_lock("controller", reentrant=True)
         self._kv: dict[tuple[str, str], Any] = {}
         self._actors: dict[str, ActorRecord] = {}
         self._named_actors: dict[tuple[str, str], str] = {}

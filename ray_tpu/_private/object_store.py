@@ -262,7 +262,8 @@ class LocalStore:
         self._bytes = 0
         self._spilled_bytes_total = 0
         self._restored_bytes_total = 0
-        self._lock = threading.Lock()
+        from ray_tpu._private.debug_sync import make_lock
+        self._lock = make_lock("object_store")
         self._cv = threading.Condition(self._lock)
         # Seal hook: called AFTER an object lands (outside the lock)
         # with its id — the runtime's waiter registry resolves blocked

@@ -12,18 +12,22 @@ src/ray/rpc/: gcs_server/, node_manager/, worker/) with one multiplexed
 channel per process pair — appropriate because our control plane is
 centralized in the driver process for the single-node runtime, and the
 bulk data plane is shared memory, not the socket.
+
+Frame bodies are versioned protobuf Envelopes (`ray_tpu/protos/
+wire.proto` via `_private/wire.py`): control data is schema'd and
+language-neutral; Python-only payloads ride an explicit `pickled`
+bytes leaf. A peer with an incompatible wire MAJOR version is refused
+at the first frame, before any pickled leaf is decoded.
 """
 from __future__ import annotations
 
-import io
 import itertools
-import pickle
 import socket
 import struct
 import threading
 from typing import Any, Callable, Optional
 
-import cloudpickle
+from ray_tpu._private.wire import WireVersionError, dumps, loads
 
 _LEN = struct.Struct("<Q")
 
@@ -44,6 +48,8 @@ DECREF = "decref"                # worker -> driver: ref-count release
 ADDREF = "addref"                # worker -> driver
 SHUTDOWN = "shutdown"            # driver -> worker
 CANCEL_TASK = "cancel_task"      # driver -> worker: interrupt a running task
+UNQUEUE_TASK = "unqueue_task"    # driver -> worker: drop a pipelined task
+                                 #   that has not started (reply ok)
 PING = "ping"                    # either
 REPLY = "reply"                  # either (generic reply)
 STATE_OP = "state_op"            # worker -> driver: state/metrics queries
@@ -69,17 +75,6 @@ OBJECT_LOOKUP = "object_lookup"        # agent -> head (reply: stored |
                                        #   location | timeout)
 PULL_OBJECT = "pull_object"            # any -> holder (reply: pull meta)
 PULL_CHUNK = "pull_chunk"              # any -> holder (reply: data)
-
-
-def dumps(obj: Any) -> bytes:
-    """Serialize a message. cloudpickle handles closures/lambdas in specs."""
-    buf = io.BytesIO()
-    cloudpickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
-    return buf.getvalue()
-
-
-def loads(data: bytes) -> Any:
-    return pickle.loads(data)
 
 
 class ConnectionClosed(Exception):
@@ -242,10 +237,15 @@ class Connection:
                     self._handler(self, msg)
         except (ConnectionClosed, OSError):
             pass
+        except WireVersionError as e:
+            import sys as _sys
+            _sys.stderr.write(
+                f"ray_tpu: refusing connection ({self.name}): {e}\n")
         except Exception:  # handler bug; don't kill silently
             import traceback
             traceback.print_exc()
         finally:
+            self.close()     # reader exit = stream dead; release the fd
             self._closed.set()
             with self._pending_lock:
                 pending, self._pending = self._pending, {}

@@ -55,6 +55,12 @@ class RemoteNodeHandle:
             self._pending_demand = dict(msg.get("pending_demand", {}))
             self._pending_shapes = list(msg.get("pending_shapes", []))
             self._idle = bool(msg.get("is_idle", False))
+            self._last_workers = list(msg.get("workers", []))
+
+    def workers_snapshot(self) -> list:
+        """Worker table rows as of the last heartbeat."""
+        with self._lock:
+            return list(getattr(self, "_last_workers", []))
 
     # ------------------------------------------- scheduler duck-typing
     @staticmethod

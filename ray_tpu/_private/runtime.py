@@ -51,6 +51,13 @@ def detect_num_tpu_chips() -> int:
     return 0
 
 
+def _summarize_by_state(rows: list) -> dict:
+    out: dict[str, int] = {}
+    for r in rows:
+        out[r.get("state", "?")] = out.get(r.get("state", "?"), 0) + 1
+    return out
+
+
 class _ActorState:
     """Driver-side actor-task routing state (actor_task_submitter.cc parity:
     per-actor ordered queue while the actor is pending/restarting, inflight
@@ -71,8 +78,11 @@ class Runtime(_context.BaseContext):
                  max_workers: Optional[int] = None,
                  namespace: str = "default",
                  bind_host: Optional[str] = None,
-                 port: Optional[int] = None):
+                 port: Optional[int] = None,
+                 labels: Optional[dict] = None):
         self.namespace = namespace
+        self._started_at = time.time()
+        self._head_labels = {k: str(v) for k, v in (labels or {}).items()}
         self.controller = Controller()
         # capacity via RAY_TPU_OBJECT_STORE_MEMORY (bytes); spill policy
         # must never touch objects pinned by in-flight tasks.
@@ -125,7 +135,8 @@ class Runtime(_context.BaseContext):
             target=self._accept_loop, name="ray-tpu-accept", daemon=True)
         self._accept_thread.start()
         head = self.cluster.add_node(node_res, max_workers=max_workers,
-                                     is_head=True)
+                                     is_head=True,
+                                     labels=self._head_labels)
         self.head_node_id = head.node_id
         self._init_head_persistence()
 
@@ -1230,6 +1241,28 @@ class Runtime(_context.BaseContext):
             self.controller.update_host_stats(
                 self.head_node_id, self.scheduler.host_stats())
             return self.controller.list_nodes()
+        if op == "list_workers":
+            out = []
+            for n in self.cluster.alive_nodes():
+                for row in n.scheduler.workers_snapshot():
+                    out.append({"node_id": n.node_id, **row})
+            return out
+        if op == "usage_stats":
+            nodes = self.controller.list_nodes()
+            return {
+                "uptime_s": round(time.time() - self._started_at, 1),
+                "nodes_alive": sum(1 for n in nodes if n["alive"]),
+                "nodes_dead": sum(1 for n in nodes if not n["alive"]),
+                "total_resources": self.cluster.total_resources(),
+                "available_resources":
+                    self.cluster.available_resources(),
+                "workers": sum(len(n.scheduler.workers_snapshot())
+                               for n in self.cluster.alive_nodes()),
+                "tasks": self.controller.summarize_tasks(),
+                "actors": _summarize_by_state(
+                    self.controller.list_actors()),
+                "object_store": self.store.stats(),
+            }
         if op == "cluster_resources":
             return self.cluster.total_resources()
         if op == "available_resources":
@@ -1305,7 +1338,8 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
          ignore_reinit_error: bool = False,
          bind_host: Optional[str] = None,
          port: Optional[int] = None,
-         address: Optional[str] = None) -> Any:
+         address: Optional[str] = None,
+         labels: Optional[dict] = None) -> Any:
     """Start the head runtime. With bind_host="0.0.0.0" (or env
     RAY_TPU_BIND_HOST) the listener accepts remote node agents:
     `python -m ray_tpu._private.node_agent --head <host>:<port>` joins
@@ -1325,7 +1359,8 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
         incompatible = {k: v for k, v in {
             "num_cpus": num_cpus, "num_tpus": num_tpus,
             "resources": resources, "max_workers": max_workers,
-            "bind_host": bind_host, "port": port}.items()
+            "bind_host": bind_host, "port": port,
+            "labels": labels}.items()
             if v is not None}
         if namespace != "default":
             incompatible["namespace"] = namespace
@@ -1337,7 +1372,7 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
         return connect(address)
     rt = Runtime(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
                  max_workers=max_workers, namespace=namespace,
-                 bind_host=bind_host, port=port)
+                 bind_host=bind_host, port=port, labels=labels)
     _context.set_ctx(rt)
     return rt
 

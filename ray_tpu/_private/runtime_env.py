@@ -168,6 +168,105 @@ def _site_packages_of(venv_dir: str) -> str:
     raise RuntimeError(f"no site-packages under {venv_dir}")
 
 
+# ------------------------------------------------------------------- uv
+def ensure_uv_env(spec: Any) -> str:
+    """Like ensure_pip_env but resolved/installed by the `uv` binary
+    (reference _private/runtime_env/uv.py): ~10-100x faster resolver
+    for big dependency sets. Gated: raises a clear error when uv is
+    not installed on this host. RAY_TPU_UV_BIN overrides discovery
+    (tests point it at a stub)."""
+    uv = os.environ.get("RAY_TPU_UV_BIN") or shutil.which("uv")
+    if not uv:
+        raise RuntimeError(
+            "runtime_env {'uv': ...} requires the `uv` binary on the "
+            "worker host (not found on PATH); install uv or use "
+            "{'pip': ...}")
+    if isinstance(spec, list):
+        spec = {"packages": list(spec), "uv_pip_install_options": []}
+    h = hashlib.sha1(json.dumps(spec, sort_keys=True).encode()
+                     ).hexdigest()[:16]
+    dest = os.path.join(_CACHE_ROOT, "uv", h)
+
+    def build(tmp: str) -> None:
+        for cmd in (
+                [uv, "venv", "--system-site-packages", tmp],
+                [uv, "pip", "install", "--python",
+                 os.path.join(tmp, "bin", "python"),
+                 *spec.get("uv_pip_install_options", []),
+                 *spec["packages"]]):
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(f"uv failed ({' '.join(cmd)}):\n"
+                                   f"{proc.stdout}\n{proc.stderr}")
+
+    if not os.path.exists(os.path.join(dest, ".ready")):
+        _locked_build(dest, build)
+    return _site_packages_of(dest)
+
+
+# ---------------------------------------------------------------- conda
+def ensure_conda_env(spec: Any) -> str:
+    """Named-environment support (reference _private/runtime_env/
+    conda.py): {'conda': 'env-name'} injects that existing env's
+    site-packages. Creating envs from a dependency dict is out of
+    scope for a TPU-image deployment (images are baked); gated with a
+    clear error either way when conda is absent."""
+    conda = os.environ.get("RAY_TPU_CONDA_BIN") or shutil.which("conda")
+    if not conda:
+        raise RuntimeError(
+            "runtime_env {'conda': ...} requires the `conda` binary on "
+            "the worker host (not found on PATH)")
+    if not isinstance(spec, str):
+        raise RuntimeError(
+            "only named conda envs are supported ({'conda': 'name'}); "
+            "bake dependency-dict envs into the image instead")
+    proc = subprocess.run([conda, "info", "--json"],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"conda info failed: {proc.stderr}")
+    info = json.loads(proc.stdout)
+    for env_dir in info.get("envs", []):
+        if os.path.basename(env_dir) == spec:
+            return _site_packages_of(env_dir)
+    raise RuntimeError(f"conda env {spec!r} not found on this host "
+                       f"(envs: {info.get('envs', [])})")
+
+
+# ------------------------------------------------------------ container
+def has_container(renv: Optional[dict]) -> bool:
+    return bool(renv and (renv.get("container")
+                          or renv.get("image_uri")))
+
+
+def container_command(renv: dict, inner_cmd: List[str]) -> List[str]:
+    """Wrap a worker spawn command to run inside the env's container
+    image (reference _private/runtime_env/image_uri.py: the worker
+    process itself starts inside the container; an already-running
+    worker cannot enter one). Engine discovery: RAY_TPU_CONTAINER_
+    RUNTIME (tests point it at a stub), else podman, else docker.
+    The image must bundle a compatible python + ray_tpu."""
+    spec = renv.get("container") or {}
+    if isinstance(spec, str):
+        spec = {"image": spec}
+    image = spec.get("image") or renv.get("image_uri")
+    if not image:
+        raise RuntimeError("container runtime_env needs an 'image'")
+    engine = (os.environ.get("RAY_TPU_CONTAINER_RUNTIME")
+              or shutil.which("podman") or shutil.which("docker"))
+    if not engine:
+        raise RuntimeError(
+            f"runtime_env container image {image!r} requires podman or "
+            f"docker on the worker host (neither found)")
+    cmd = [engine, "run", "--rm", "--network", "host",
+           "-v", f"{_CACHE_ROOT}:{_CACHE_ROOT}"]
+    for env_key in ("RAY_TPU_WORKER_ID", "RAY_TPU_NODE_ID",
+                    "RAY_TPU_SESSION", "RAY_TPU_AUTH_TOKEN"):
+        cmd += ["-e", env_key]
+    cmd += list(spec.get("run_options", []))
+    cmd.append(image)
+    return cmd + inner_cmd
+
+
 # ------------------------------------------------------------- build lock
 def _locked_build(dest: str, build_fn) -> None:
     """Build into a temp dir then atomically rename, serialized by a

@@ -66,6 +66,9 @@ class WorkerRec:
     # prefers matching workers so pooled workers skip env churn
     # (reference worker_pool.cc runtime-env-keyed reuse)
     env_hash: str = ""
+    # spawned inside a container image: permanently bound to that env —
+    # only exact-hash tasks may use it, and its hash never changes
+    container: bool = False
 
 
 def _node_memory_fraction() -> float:
@@ -158,7 +161,9 @@ class Scheduler:
         self._max_workers = (max_workers or _CFG.worker_pool_max
                              or max(int(node_resources.get("CPU", 4)) * 2,
                                     8))
-        self._lock = threading.RLock()
+        from ray_tpu._private.debug_sync import make_lock
+        self._lock = make_lock(f"scheduler:{self.node_id}",
+                               reentrant=True)
         self._cv = threading.Condition(self._lock)
         self._pending: deque = deque()           # TaskSpec | ActorSpec
         self._queued_at: dict[int, float] = {}   # id(spec) -> enqueue time
@@ -283,7 +288,7 @@ class Scheduler:
         return None
 
     # ---- worker lifecycle ----
-    def spawn_worker(self) -> WorkerRec:
+    def spawn_worker(self, renv: Optional[dict] = None) -> WorkerRec:
         wid = "w_" + uuid.uuid4().hex[:8]
         env = dict(os.environ)
         pkg_root = os.path.dirname(os.path.dirname(
@@ -291,12 +296,23 @@ class Scheduler:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         env["RAY_TPU_WORKER_ID"] = wid
         env["RAY_TPU_NODE_ID"] = self.node_id
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main",
-             "--addr", f"{self._addr[0]}:{self._addr[1]}",
-             "--worker-id", wid],
-            env=env)
-        rec = WorkerRec(worker_id=wid, proc=proc)
+        cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
+               "--addr", f"{self._addr[0]}:{self._addr[1]}",
+               "--worker-id", wid]
+        spawn_hash = ""
+        from ray_tpu._private.runtime_env import (container_command,
+                                                  has_container)
+        if has_container(renv):
+            # the worker process itself must start inside the image
+            # (reference image_uri plugin); the worker is permanently
+            # bound to this env — marked via env_hash at spawn so only
+            # matching tasks reuse it
+            cmd = container_command(renv, cmd)
+            from ray_tpu._private.runtime_env import env_hash
+            spawn_hash = env_hash(renv) or ""
+        proc = subprocess.Popen(cmd, env=env)
+        rec = WorkerRec(worker_id=wid, proc=proc, env_hash=spawn_hash,
+                        container=bool(spawn_hash))
         with self._cv:
             self._workers[wid] = rec
             self._spawning += 1
@@ -370,6 +386,7 @@ class Scheduler:
             pids = [r.proc.pid for r in self._workers.values()
                     if r.proc is not None]
         snap["host_stats"] = sample_host_stats(pids)
+        snap["workers"] = self.workers_snapshot()
         return snap
 
     def host_stats(self) -> dict:
@@ -380,6 +397,22 @@ class Scheduler:
             pids = [r.proc.pid for r in self._workers.values()
                     if r.proc is not None]
         return sample_host_stats(pids)
+
+    def workers_snapshot(self) -> list[dict]:
+        """Worker-manager table rows (reference GcsWorkerManager /
+        worker_pool.cc state): one dict per pooled worker."""
+        now = time.time()
+        with self._lock:
+            return [{
+                "worker_id": r.worker_id,
+                "pid": r.proc.pid if r.proc is not None else None,
+                "state": r.state,
+                "actor_id": r.actor_id,
+                "inflight_tasks": len(r.tasks),
+                "blocked_depth": r.blocked_depth,
+                "env_hash": r.env_hash,
+                "age_s": round(now - r.started_at, 1),
+            } for r in self._workers.values()]
 
     def worker_running_task(self, task_id: str):
         """(worker_id, spec) currently executing (or queued in) the
@@ -420,6 +453,7 @@ class Scheduler:
 
     # ---- blocked-worker accounting ----
     def worker_blocked(self, worker_id: str) -> None:
+        steal: list[str] = []
         with self._cv:
             rec = self._workers.get(worker_id)
             if rec is None:
@@ -430,7 +464,57 @@ class Scheduler:
                 # freed resources: start queued work immediately
                 if self._running and self._pending:
                     self._try_dispatch_locked(self._INLINE_SCAN_LIMIT)
+            # Steal back tasks pipelined BEHIND the now-blocked task:
+            # the worker executes FIFO on one thread, so they cannot
+            # start until the blocked get returns — and if that get
+            # transitively depends on one of them (nested submission),
+            # that is a deadlock, not just a stall.
+            if len(rec.tasks) > 1 and rec.conn is not None:
+                steal = list(rec.tasks.keys())[1:]
             self._cv.notify_all()
+        for tid in steal:
+            self._steal_queued_task(rec, tid)
+
+    def _steal_queued_task(self, rec: WorkerRec, task_id: str) -> None:
+        """Ask the worker to drop a not-yet-started pipelined task from
+        its local FIFO and requeue it here. Runs async: this path is
+        reached on the worker connection's reader thread, so a blocking
+        request would deadlock against our own reply."""
+        try:
+            fut = rec.conn.request_async(
+                {"type": protocol.UNQUEUE_TASK, "task_id": task_id})
+        except protocol.ConnectionClosed:
+            return
+
+        def _done(f) -> None:
+            try:
+                rep = f.result(0)
+            except BaseException:
+                return                # worker died: death path requeues
+            if not rep.get("ok"):
+                return                # already started: FIFO handles it
+            with self._cv:
+                cur = self._workers.get(rec.worker_id)
+                if cur is not rec:
+                    return
+                spec = rec.tasks.pop(task_id, None)
+                need_pg = rec.task_res.pop(task_id, None)
+                if spec is None:
+                    return
+                if need_pg is not None and rec.blocked_depth == 0:
+                    # the worker unblocked between steal and reply, so
+                    # its charges were re-acquired — release this one
+                    release(self._ledger_for_key(need_pg[1]), need_pg[0])
+                if rec.state == BUSY and not rec.tasks:
+                    rec.state = IDLE
+                self._pending.appendleft(spec)
+                self._queued_at[id(spec)] = time.monotonic()
+                self._demand_add(spec)
+                if self._running:
+                    self._try_dispatch_locked(self._INLINE_SCAN_LIMIT)
+                self._cv.notify_all()
+
+        fut.add_done_callback(_done)
 
     def worker_unblocked(self, worker_id: str) -> None:
         with self._cv:
@@ -495,16 +579,23 @@ class Scheduler:
         the previous one finishes, no round-trip bubble."""
         want = "" if spec is None else self._spec_env_hash(spec)
         idle_only = isinstance(spec, ActorSpec)
+        # container tasks can only run in a worker SPAWNED inside the
+        # image (exact env-hash match); plain workers can't adopt one
+        from ray_tpu._private.runtime_env import has_container
+        exact_only = spec is not None and has_container(
+            getattr(spec, "runtime_env", None))
         depth = _CFG.worker_pipeline_depth
         fallback = None
         pipelined = None
         for rec in self._workers.values():
             if rec.conn is None:
                 continue
+            if rec.container and rec.env_hash != want:
+                continue    # image-bound: invisible to other tasks
             if rec.state == IDLE:
                 if rec.env_hash == want:
                     return rec
-                if fallback is None:
+                if fallback is None and not exact_only:
                     fallback = rec
             elif (not idle_only and pipelined is None and depth > 1
                     and rec.state == BUSY and rec.blocked_depth == 0
@@ -795,17 +886,51 @@ class Scheduler:
                 # way).
                 if (pool_count - blocked < self._max_workers
                         and self._spawning < min(len(self._pending), 4)):
+                    spawn_err: Optional[BaseException] = None
                     self._cv.release()
                     try:
-                        self.spawn_worker()
+                        # container envs bind the worker at spawn time
+                        self.spawn_worker(
+                            getattr(spec, "runtime_env", None))
+                    except Exception as e:
+                        # e.g. container engine/image missing: fail THE
+                        # TASK (like a worker-side env error) instead of
+                        # letting the exception escape into whatever
+                        # thread ran this sweep and retrying forever
+                        spawn_err = e
                     finally:
                         self._cv.acquire()
+                    if spawn_err is not None:
+                        from ray_tpu._private.runtime_env import \
+                            has_container
+                        if (has_container(getattr(spec, "runtime_env",
+                                                  None))
+                                and id(spec) in self._queued_at):
+                            # env-driven spawn error (engine/image
+                            # missing): deterministic — fail the task
+                            self._pending.remove(spec)
+                            self._queued_at.pop(id(spec), None)
+                            self._demand_sub(spec)
+                            self._cv.release()
+                            try:
+                                self._rt.on_unplaceable(
+                                    spec, f"worker spawn failed: "
+                                          f"{spawn_err}")
+                            finally:
+                                self._cv.acquire()
+                        else:
+                            # transient fork/exec failure: leave the
+                            # spec queued; the 20 Hz backstop retries
+                            sys.stderr.write(
+                                f"ray_tpu: worker spawn failed "
+                                f"({spawn_err}); will retry\n")
                 break                 # no free worker: stop the sweep
             self._pending.remove(spec)
             self._queued_at.pop(id(spec), None)
             self._demand_sub(spec)
             acquire(pool, need)
-            worker.env_hash = self._spec_env_hash(spec)
+            if not worker.container:     # image-bound hash is immutable
+                worker.env_hash = self._spec_env_hash(spec)
             if isinstance(spec, ActorSpec):
                 worker.acquired = need
                 worker.pg_key = pg_key

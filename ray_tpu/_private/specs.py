@@ -70,6 +70,8 @@ class TaskSpec:
     scheduling_strategy: Any = None
     node_id: Optional[str] = None     # node affinity (cluster sim)
     affinity_soft: bool = False       # soft affinity falls back anywhere
+    # normalized (hard, soft) node-label constraints, or None
+    label_constraints: Any = None
     runtime_env: Optional[dict] = None
     # bookkeeping (filled by runtime)
     pinned_refs: list[str] = field(default_factory=list)
@@ -93,6 +95,7 @@ class ActorSpec:
     scheduling_strategy: Any = None
     node_id: Optional[str] = None
     affinity_soft: bool = False
+    label_constraints: Any = None
     runtime_env: Optional[dict] = None
 
 

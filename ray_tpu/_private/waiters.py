@@ -58,7 +58,8 @@ class WaitWaiter:
 class WaiterRegistry:
     def __init__(self, present_fn: Callable[[str], bool]):
         self._present = present_fn
-        self._lock = threading.Lock()
+        from ray_tpu._private.debug_sync import make_lock
+        self._lock = make_lock("waiters")
         self._by_oid: dict[str, set] = {}
         self._heap: list[tuple[float, int, object]] = []
         self._seq = itertools.count()

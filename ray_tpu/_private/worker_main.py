@@ -194,6 +194,18 @@ def _apply_runtime_env(renv: Optional[dict], kv_get=None) -> dict:
             site = ensure_pip_env(renv["pip"])
             sys.path.insert(0, site)
             undo["paths"].append(site)
+        if renv.get("uv"):
+            from ray_tpu._private.runtime_env import ensure_uv_env
+            site = ensure_uv_env(renv["uv"])
+            sys.path.insert(0, site)
+            undo["paths"].append(site)
+        if renv.get("conda"):
+            from ray_tpu._private.runtime_env import ensure_conda_env
+            site = ensure_conda_env(renv["conda"])
+            sys.path.insert(0, site)
+            undo["paths"].append(site)
+        # container/image_uri is a spawn-time concern (the scheduler
+        # wraps the worker command); nothing to apply in-process
         if renv.get("py_modules"):
             from ray_tpu._private.runtime_env import ensure_py_modules
             for path in ensure_py_modules(renv["py_modules"], kv_get):
@@ -251,6 +263,11 @@ class WorkerExecutor:
             os.environ.get("RAY_TPU_TASK_EVENT_BUFFER", "32"))
         threading.Thread(target=self._event_flush_loop,
                          name="rtpu-task-events", daemon=True).start()
+        # pipelined-task steal-back (see UNQUEUE_TASK): tasks the driver
+        # reclaimed before they started; _run_task skips them silently
+        self._queue_lock = threading.Lock()
+        self._started_tasks: set[str] = set()
+        self._unqueued_tasks: set[str] = set()
 
     # ---- message entry (called on reader thread) ----
     def handle(self, conn: protocol.Connection, msg: dict) -> None:
@@ -276,6 +293,18 @@ class WorkerExecutor:
                 self._pool.submit(self._run_actor_task, aspec)
         elif mtype == protocol.CANCEL_TASK:
             self._cancel_running(msg["task_id"])
+        elif mtype == protocol.UNQUEUE_TASK:
+            # driver steals back a task pipelined behind a BLOCKED task
+            # (it would deadlock if the blocked get transitively depends
+            # on it). Race-free: refuse once the task has started.
+            tid = msg["task_id"]
+            with self._queue_lock:
+                if tid in self._started_tasks:
+                    ok = False
+                else:
+                    self._unqueued_tasks.add(tid)
+                    ok = True
+            conn.reply(msg, ok=ok)
         elif mtype == protocol.SHUTDOWN:
             self.stop_event.set()
         elif mtype == protocol.PING:
@@ -442,6 +471,13 @@ class WorkerExecutor:
 
     def _run_task(self, spec: TaskSpec) -> None:
         from ray_tpu.exceptions import TaskCancelledError
+        with self._queue_lock:
+            if spec.task_id in self._unqueued_tasks:
+                # stolen back by the driver while queued: it was (or
+                # will be) re-dispatched elsewhere — skip silently
+                self._unqueued_tasks.discard(spec.task_id)
+                return
+            self._started_tasks.add(spec.task_id)
         t0 = time.time()
         self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
         try:
@@ -481,6 +517,8 @@ class WorkerExecutor:
         self._record_event(spec.task_id, spec.name,
                            "EXEC_FAILED" if error else "EXEC_FINISHED",
                            duration_s=time.time() - t0)
+        with self._queue_lock:
+            self._started_tasks.discard(spec.task_id)
 
     def _create_actor(self, spec: ActorSpec) -> None:
         try:

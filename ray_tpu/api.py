@@ -25,7 +25,8 @@ _VALID_TASK_OPTIONS = {
 
 
 _SUPPORTED_RUNTIME_ENV_KEYS = {"env_vars", "working_dir", "pip",
-                               "py_modules"}
+                               "py_modules", "uv", "conda",
+                               "container", "image_uri"}
 
 
 def validate_runtime_env(renv: Optional[dict]) -> Optional[dict]:
@@ -107,6 +108,9 @@ def _apply_scheduling(spec, opts: dict) -> None:
             "NodeAffinitySchedulingStrategy":
         spec.node_id = strategy.node_id
         spec.affinity_soft = bool(getattr(strategy, "soft", False))
+    if strategy is not None and type(strategy).__name__ == \
+            "NodeLabelSchedulingStrategy":
+        spec.label_constraints = strategy.normalized()
     if pg is not None:
         spec.placement_group_id = getattr(pg, "id", pg)
         spec.placement_group_bundle_index = (

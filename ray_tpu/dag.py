@@ -89,6 +89,68 @@ class MultiOutputNode(DAGNode):
         self.outputs = list(outputs)
 
 
+# --------------------------------------------------- collective nodes
+def _dag_allreduce(actor_self, group_name: str, world: int, rank: int,
+                   op: str, value):
+    """Runs inside each participant actor via __rtpu_apply__: joins the
+    DAG's named collective group on first use, then allreduces this
+    participant's shard (reference torch_tensor_nccl_channel collective
+    nodes; host/CPU reduction here — accelerator collectives belong to
+    XLA inside a single jit)."""
+    import numpy as np
+
+    from ray_tpu.util import collective
+    if group_name not in collective._GROUPS:
+        collective.init_collective_group(world, rank,
+                                         group_name=group_name)
+    return collective.allreduce(np.asarray(value), op=op,
+                                group_name=group_name)
+
+
+class _CollectiveGroup:
+    """One collective op instance shared by its per-actor output nodes."""
+
+    def __init__(self, inputs: List["ClassMethodNode"], op: str):
+        import uuid
+        actors = [n.actor for n in inputs]
+        if len({id(a) for a in actors}) != len(actors):
+            raise ValueError(
+                "collective participants must be distinct actors (one "
+                "rank per process; a shared actor would deadlock its "
+                "ordered call queue)")
+        self.inputs = list(inputs)
+        self.op = op
+        self.name = f"_dag_cc_{uuid.uuid4().hex[:8]}"
+
+
+class CollectiveOutputNode(DAGNode):
+    """Participant `index`'s reduced output. Depends on ALL shards: the
+    scheduler must produce every participant's input before any reduced
+    output is consumable."""
+
+    def __init__(self, group: _CollectiveGroup, index: int):
+        super().__init__(list(group.inputs))
+        self.group = group
+        self.index = index
+
+
+def allreduce_bind(nodes: List["ClassMethodNode"],
+                   op: str = "sum") -> List["CollectiveOutputNode"]:
+    """Bind an allreduce across per-actor DAG nodes: returns one output
+    node per participant carrying the reduced value on that actor
+    (reference ray.experimental.collective.allreduce.bind). Ops: sum,
+    prod, min, max, mean."""
+    if not nodes:
+        raise ValueError("allreduce_bind needs at least one node")
+    for n in nodes:
+        if not isinstance(n, ClassMethodNode):
+            raise TypeError(
+                "allreduce_bind participants must be actor method "
+                f"nodes, got {type(n).__name__}")
+    group = _CollectiveGroup(list(nodes), op)
+    return [CollectiveOutputNode(group, i) for i in range(len(nodes))]
+
+
 class _BoundMethod:
     def __init__(self, actor, name: str):
         self._actor = actor
@@ -112,7 +174,27 @@ class CompiledDAG:
         self._order = self._toposort(output)
         self._input = self._find_input()
         self._lock = threading.Lock()
+        self._used_groups: Dict[str, _CollectiveGroup] = {}
         self.num_executions = 0
+        # every participant of a collective must be reachable from the
+        # output: a partially-consumed allreduce would rendezvous with
+        # world=N but submit <N ranks — a guaranteed hang, caught here
+        # at compile time instead
+        reach: Dict[int, int] = {}
+        groups: Dict[int, _CollectiveGroup] = {}
+        for n in self._order:
+            if isinstance(n, CollectiveOutputNode):
+                reach[id(n.group)] = reach.get(id(n.group), 0) + 1
+                groups[id(n.group)] = n.group
+        for gid, count in reach.items():
+            world = len(groups[gid].inputs)
+            if count != world:
+                raise ValueError(
+                    f"collective group has {world} participants but "
+                    f"only {count} of its output nodes are consumed by "
+                    f"this DAG; bind all of them (e.g. via "
+                    f"MultiOutputNode) or the allreduce rendezvous "
+                    f"can never complete")
 
     def _toposort(self, root: DAGNode) -> List[DAGNode]:
         order: List[DAGNode] = []
@@ -157,6 +239,9 @@ class CompiledDAG:
                     values[id(node)] = [values[id(o)]
                                         for o in node.outputs]
                     continue
+                if isinstance(node, CollectiveOutputNode):
+                    self._dispatch_collective(node.group, values)
+                    continue
                 resolve = (lambda v: values[id(v)]
                            if isinstance(v, DAGNode) else v)
                 call_args = tuple(resolve(a) for a in node.args)
@@ -168,6 +253,39 @@ class CompiledDAG:
             self.num_executions += 1
             return values[id(self._output)]
 
+    def _dispatch_collective(self, group: _CollectiveGroup,
+                             values: Dict[int, Any]) -> None:
+        """Submit every participant's allreduce call (once per group per
+        execute); per-actor ordered queues give all ranks the same
+        round sequence."""
+        if any(id(n) in values for n in self._collective_outputs(group)):
+            return                        # already dispatched this round
+        import cloudpickle
+
+        from ray_tpu.actor import ActorMethod
+        fn = cloudpickle.dumps(_dag_allreduce)
+        world = len(group.inputs)
+        for out in self._collective_outputs(group):
+            up = group.inputs[out.index]
+            method = ActorMethod(up.actor, "__rtpu_apply__", {})
+            values[id(out)] = method.remote(
+                fn, group.name, world, out.index, group.op,
+                values[id(up)])
+        self._used_groups[group.name] = group
+
+    def _collective_outputs(self, group: _CollectiveGroup):
+        return [n for n in self._order
+                if isinstance(n, CollectiveOutputNode)
+                and n.group is group]
+
     def teardown(self) -> None:
-        """Reference parity hook (the reference kills its exec loops;
-        our actors keep serving normal calls)."""
+        """Kill the collective coordinators this DAG created (reference
+        tears down its exec loops; plain ref-wired actors keep serving
+        normal calls)."""
+        for name in list(self._used_groups):
+            self._used_groups.pop(name, None)
+            try:
+                coord = ray_tpu.get_actor(f"_rtpu_collective::{name}")
+                ray_tpu.kill(coord)
+            except Exception:
+                pass

@@ -10,8 +10,13 @@ the state API + metrics registry. Endpoints:
   GET /api/jobs         (submitted jobs, reference modules/job)
   GET /api/logs         (available job log files)
   GET /api/logs/<job>   (tail of one job's log; ?lines=N)
+  GET /api/serve_applications  (serve apps -> deployments/replicas)
+  GET /api/timeline     (Chrome-trace JSON of recorded task events —
+                         load in Perfetto / chrome://tracing)
   GET /metrics          (Prometheus exposition of util.metrics)
-  GET /                 (HTML tables auto-refreshing off the JSON API)
+  GET /                 (single-page frontend app: tabbed views over
+                         the JSON API with utilization + host-stats
+                         bars, auto-refreshing; no external assets)
 """
 from __future__ import annotations
 
@@ -23,33 +28,154 @@ _SERVER = None
 
 _INDEX_HTML = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title><style>
-body{font-family:monospace;margin:1.5em;background:#111;color:#ddd}
-h2{color:#7ac}table{border-collapse:collapse;margin-bottom:1.5em}
-td,th{border:1px solid #444;padding:3px 9px;text-align:left}
-th{background:#223}</style></head><body>
-<h1>ray_tpu</h1>
-<div id="out">loading…</div>
+body{font-family:ui-monospace,Menlo,monospace;margin:0;background:#0e1116;
+ color:#d6dbe3}
+header{display:flex;align-items:baseline;gap:1.2em;padding:.7em 1.2em;
+ background:#151a22;border-bottom:1px solid #2a3240}
+h1{font-size:1.1em;margin:0;color:#8ab4f8}
+#age{color:#6b7686;font-size:.8em}
+nav{display:flex;gap:.2em;padding:.4em 1em;background:#11151c}
+nav button{background:none;border:0;color:#9aa5b5;font:inherit;
+ padding:.35em .8em;cursor:pointer;border-radius:4px}
+nav button.on{background:#223049;color:#cfe1ff}
+main{padding:1em 1.2em}
+h2{color:#8ab4f8;font-size:.95em;margin:1.2em 0 .4em}
+table{border-collapse:collapse;margin-bottom:1em;font-size:.85em}
+td,th{border:1px solid #2a3240;padding:3px 9px;text-align:left}
+th{background:#1a2230;color:#aebdd4}
+tr:nth-child(even) td{background:#121823}
+.bar{display:inline-block;width:120px;height:9px;background:#222b3a;
+ border-radius:4px;vertical-align:middle;margin-right:.5em}
+.bar i{display:block;height:100%;border-radius:4px;background:#4f8ef7}
+.bar i.hot{background:#e2734b}
+.kpis{display:flex;gap:1em;flex-wrap:wrap;margin:.6em 0}
+.kpi{background:#151c28;border:1px solid #283142;border-radius:6px;
+ padding:.6em 1em;min-width:9em}
+.kpi b{display:block;font-size:1.3em;color:#e8eef7}
+.kpi span{color:#8a96a8;font-size:.75em}
+a{color:#8ab4f8}
+i.none{color:#5a6474}
+</style></head><body>
+<header><h1>ray_tpu</h1><span id="age"></span>
+<span style="flex:1"></span>
+<a href="/api/timeline" download="timeline.json">timeline</a>
+<a href="/metrics">metrics</a></header>
+<nav id="nav"></nav><main id="out">loading…</main>
 <script>
-const SECTIONS = ["cluster","nodes","actors","task_summary",
-                  "placement_groups"];
-function table(rows){
+const TABS={Overview:ovw,Nodes:nodes,Workers:workers,Actors:actors,
+            Tasks:tasks,Serve:serveApps,Jobs:jobs,
+            "Placement Groups":pgs};
+let cur="Overview", cache={};
+async function J(p){const r=await fetch("/api/"+p);return r.json()}
+function esc(x){return String(x).replace(/&/g,"&amp;").replace(/</g,"&lt;")}
+function cell(v){return typeof v==="object"&&v!==null?
+  esc(JSON.stringify(v)):esc(v)}
+function table(rows,keys){
   if(!Array.isArray(rows)) rows=[rows];
-  if(!rows.length) return "<i>none</i>";
-  const keys=Object.keys(rows[0]);
-  return "<table><tr>"+keys.map(k=>`<th>${k}</th>`).join("")+"</tr>"+
-    rows.map(r=>"<tr>"+keys.map(k=>
-      `<td>${JSON.stringify(r[k])}</td>`).join("")+"</tr>").join("")+
-    "</table>";
+  if(!rows.length) return "<i class=none>none</i>";
+  keys=keys||Object.keys(rows[0]);
+  return "<table><tr>"+keys.map(k=>`<th>${esc(k)}</th>`).join("")+"</tr>"+
+    rows.map(r=>"<tr>"+keys.map(k=>`<td>${cell(r[k]??"")}</td>`)
+      .join("")+"</tr>").join("")+"</table>";
 }
-async function refresh(){
-  let html="";
-  for(const s of SECTIONS){
-    const r=await fetch("/api/"+s); const data=await r.json();
-    html+=`<h2>${s}</h2>`+table(data);
+function bar(frac,label){
+  const pct=Math.min(100,Math.round(100*frac));
+  return `<span class=bar><i class="${pct>85?"hot":""}"
+    style="width:${pct}%"></i></span>${label??pct+"%"}`;
+}
+function kpi(v,l){return `<div class=kpi><b>${v}</b><span>${l}</span></div>`}
+async function ovw(){
+  const c=await J("cluster"),u=await J("usage");
+  let h="<div class=kpis>";
+  h+=kpi(u.nodes_alive,"alive nodes"+(u.nodes_dead?
+        ` (+${u.nodes_dead} dead)`:""));
+  h+=kpi(u.workers,"workers");
+  h+=kpi(Object.values(u.actors).reduce((a,b)=>a+b,0)||0,"actors");
+  h+=kpi(Object.entries(u.tasks).map(([k,v])=>`${k}:${v}`).join(" ")
+         ||"0","task states");
+  h+=kpi((c.object_store.bytes/1048576).toFixed(1)+" MB","object store");
+  h+=kpi((u.uptime_s/60).toFixed(1)+" min","uptime");
+  h+="</div><h2>resources</h2><table><tr><th>resource</th><th>used</th>"+
+     "<th>total</th><th></th></tr>";
+  for(const k of Object.keys(c.total)){
+    const t=c.total[k],a=c.available[k]??0,u=t-a;
+    h+=`<tr><td>${esc(k)}</td><td>${u.toFixed(1)}</td>`+
+       `<td>${t.toFixed(1)}</td><td>${bar(t?u/t:0)}</td></tr>`;
   }
-  document.getElementById("out").innerHTML=html;
+  return h+"</table>";
 }
-refresh(); setInterval(refresh, 5000);
+async function nodes(){
+  const ns=await J("nodes");
+  let h="<h2>nodes</h2><table><tr><th>node</th><th>state</th>"+
+   "<th>head</th><th>resources</th><th>labels</th><th>load</th>"+
+   "<th>memory</th><th>workers rss</th></tr>";
+  for(const n of ns){
+    const s=n.host_stats||{};
+    h+=`<tr><td>${esc(n.node_id)}</td>`+
+     `<td>${n.alive?"ALIVE":"DEAD "+esc(n.death_cause||"")}</td>`+
+     `<td>${n.is_head?"*":""}</td><td>${cell(n.resources)}</td>`+
+     `<td>${cell(n.labels)}</td>`+
+     `<td>${s.load_1m!=null?bar((s.load_1m||0)/(s.num_cpus||1),
+           s.load_1m+" / "+s.num_cpus+" cpus"):""}</td>`+
+     `<td>${s.mem_used_pct!=null?bar(s.mem_used_pct/100):""}</td>`+
+     `<td>${s.workers_rss_mb!=null?
+           s.workers_rss_mb+" MB ("+(s.num_workers||0)+"w)":""}</td></tr>`;
+  }
+  return h+"</table>";
+}
+async function workers(){
+  return "<h2>workers</h2>"+table(await J("workers"),
+   ["node_id","worker_id","pid","state","actor_id","inflight_tasks",
+    "blocked_depth","env_hash","age_s"]);
+}
+async function actors(){return "<h2>actors</h2>"+table(await J("actors"))}
+async function tasks(){
+  const sum=await J("task_summary"),evs=await J("tasks");
+  return "<h2>summary</h2>"+table([sum])+
+    "<h2>recent events</h2>"+table(evs.slice(-60).reverse());
+}
+async function pgs(){
+  return "<h2>placement groups</h2>"+table(await J("placement_groups"))}
+async function serveApps(){
+  const apps=await J("serve_applications");
+  const names=Object.keys(apps);
+  if(!names.length) return "<i class=none>no applications</i>";
+  let h="";
+  for(const a of names){
+    const rec=apps[a];
+    h+=`<h2>${esc(a)} <small>(${esc(rec.route_prefix)} → `+
+       `${esc(rec.ingress)})</small></h2>`;
+    h+=table(Object.entries(rec.deployments).map(([d,v])=>
+       Object.assign({deployment:d},v)),
+       ["deployment","live_replicas","target_replicas",
+        "ongoing_requests","autoscaling"]);
+  }
+  return h;
+}
+async function jobs(){
+  const js=await J("jobs"),logs=await J("logs");
+  return "<h2>jobs</h2>"+table(js)+"<h2>logs</h2>"+
+    (Array.isArray(logs)&&logs.length?logs.map(f=>
+     `<a href="/api/logs/${esc(f)}">${esc(f)}</a>`).join("<br>")
+     :"<i class=none>none</i>");
+}
+function nav(){
+  document.getElementById("nav").innerHTML=Object.keys(TABS).map(t=>
+   `<button class="${t===cur?"on":""}" onclick="go('${t}')">${t}</button>`
+  ).join("");
+}
+async function go(t){cur=t;nav();await refresh()}
+async function refresh(){
+  try{
+    document.getElementById("out").innerHTML=await TABS[cur]();
+    document.getElementById("age").textContent=
+      "updated "+new Date().toLocaleTimeString();
+  }catch(e){
+    document.getElementById("out").innerHTML=
+      "<i class=none>"+esc(e)+"</i>";
+  }
+}
+nav();refresh();setInterval(refresh,4000);
 </script></body></html>"""
 
 
@@ -84,6 +210,10 @@ def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> int:
             return state_api.summarize_actors()
         if path == "nodes":
             return state_api.list_nodes()
+        if path == "workers":
+            return state_api.list_workers()
+        if path == "usage":
+            return state_api.usage_stats()
         if path == "actors":
             return state_api.list_actors()
         if path == "tasks":
@@ -96,6 +226,18 @@ def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> int:
             return {"total": state_api.cluster_resources(),
                     "available": state_api.available_resources(),
                     "object_store": state_api.object_store_stats()}
+        if path == "serve_applications":
+            try:
+                import ray_tpu
+                from ray_tpu.serve import _CONTROLLER_NAME
+                controller = ray_tpu.get_actor(_CONTROLLER_NAME)
+            except ValueError:
+                return {}          # serve not running
+            return ray_tpu.get(
+                controller.list_applications.remote(), timeout=10)
+        if path == "timeline":
+            from ray_tpu.util.metrics import timeline
+            return timeline()
         raise KeyError(path)
 
     class Handler(BaseHTTPRequestHandler):

@@ -8,6 +8,7 @@ FaultTolerantActorManager as the shared actor-fleet substrate.
 from ray_tpu.rllib.actor_manager import (CallResult,
                                          FaultTolerantActorManager,
                                          RemoteCallResults)
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.core.learner import (LearnerGroup, PPOLearner,
                                         PPOLearnerConfig)
@@ -17,6 +18,7 @@ from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
 from ray_tpu.rllib.tune_adapter import tune_trainable
 
 __all__ = [
+    "AlgorithmConfig",
     "PPO", "PPOConfig", "PPOLearner", "PPOLearnerConfig", "LearnerGroup",
     "ActorCriticModule", "Categorical", "SingleAgentEnvRunner",
     "EnvRunnerConfig", "EnvRunnerGroup", "FaultTolerantActorManager",

@@ -34,9 +34,6 @@ class APPOConfig(IMPALAConfig):
     kl_coef: float = 0.2
     target_network_update_freq: int = 16    # learner updates per refresh
 
-    def build(self) -> "APPO":
-        return APPO(self)
-
 
 @dataclasses.dataclass
 class APPOLearnerConfig(IMPALALearnerConfig):
@@ -147,3 +144,6 @@ class APPO(IMPALA):
         else:
             self.learner.target_params = jax.tree_util.tree_map(
                 jnp.copy, self.learner.params)
+
+
+APPOConfig.algo_class = APPO

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
                                                 ReplayBuffer)
 from ray_tpu.rllib.utils.schedules import LinearSchedule
@@ -304,7 +305,7 @@ class QEnvRunner:
 
 
 @dataclasses.dataclass
-class DQNConfig:
+class DQNConfig(AlgorithmConfig):
     env: str = "CartPole-v1"
     num_env_runners: int = 0              # 0 = local
     num_envs_per_env_runner: int = 8
@@ -331,24 +332,6 @@ class DQNConfig:
     epsilon_timesteps: int = 10_000
     double_q: bool = True
     seed: int = 0
-
-    def environment(self, env: str) -> "DQNConfig":
-        self.env = env
-        return self
-
-    def training(self, **kw) -> "DQNConfig":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown DQN option {k!r}")
-            setattr(self, k, v)
-        return self
-
-    def env_runners(self, **kw) -> "DQNConfig":
-        return self.training(**kw)
-
-    def build(self) -> "DQN":
-        return DQN(self)
-
 
 class DQN:
     """Iterative trainer: sample -> buffer -> k double-DQN updates."""
@@ -575,3 +558,6 @@ class DQN:
                     r.stop()
             except BaseException:
                 pass
+
+
+DQNConfig.algo_class = DQN

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+
 
 def symlog(x):
     return jnp.sign(x) * jnp.log1p(jnp.abs(x))
@@ -75,7 +77,7 @@ def _gru(p, h, x):
 
 
 @dataclasses.dataclass
-class DreamerV3Config:
+class DreamerV3Config(AlgorithmConfig):
     env: str = "CartPole-v1"
     num_envs: int = 16
     rollout_length: int = 32
@@ -99,21 +101,6 @@ class DreamerV3Config:
     imag_starts: int = 256                 # imagined trajectories/update
     num_updates_per_iteration: int = 4
     seed: int = 0
-
-    def environment(self, env: str) -> "DreamerV3Config":
-        self.env = env
-        return self
-
-    def training(self, **kw) -> "DreamerV3Config":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown DreamerV3 option {k!r}")
-            setattr(self, k, v)
-        return self
-
-    def build(self) -> "DreamerV3":
-        return DreamerV3(self)
-
 
 class DreamerV3:
     """sample (recurrent runner) -> world-model + behavior updates."""
@@ -470,3 +457,6 @@ class DreamerV3:
 
     def stop(self) -> None:
         self._envs.close()
+
+
+DreamerV3Config.algo_class = DreamerV3

@@ -25,13 +25,14 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.core.rl_module import ActorCriticModule, Categorical
 from ray_tpu.rllib.env.env_runner import EnvRunnerConfig
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
 
 
 @dataclasses.dataclass
-class IMPALAConfig:
+class IMPALAConfig(AlgorithmConfig):
     env: str = "CartPole-v1"
     # --- rollouts (async: runners resample as soon as they finish)
     num_env_runners: int = 2
@@ -386,3 +387,6 @@ class IMPALA:
 
     def stop(self) -> None:
         self.env_runner_group.stop()
+
+
+IMPALAConfig.algo_class = IMPALA

@@ -20,8 +20,11 @@ from ray_tpu.rllib.env.env_runner import EnvRunnerConfig
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
 
 
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+
+
 @dataclasses.dataclass
-class PPOConfig:
+class PPOConfig(AlgorithmConfig):
     env: str = "CartPole-v1"
     # --- rollouts
     num_env_runners: int = 0           # 0 = local in-process runner
@@ -48,32 +51,6 @@ class PPOConfig:
     # StandardizeAdvantages()] moves GAE out of the jit into a
     # composable host-side pipeline
     learner_connectors: Optional[Sequence] = None
-
-    def environment(self, env: str) -> "PPOConfig":
-        self.env = env
-        return self
-
-    def env_runners(self, *, num_env_runners: Optional[int] = None,
-                    num_envs_per_env_runner: Optional[int] = None,
-                    rollout_length: Optional[int] = None) -> "PPOConfig":
-        if num_env_runners is not None:
-            self.num_env_runners = num_env_runners
-        if num_envs_per_env_runner is not None:
-            self.num_envs_per_env_runner = num_envs_per_env_runner
-        if rollout_length is not None:
-            self.rollout_length = rollout_length
-        return self
-
-    def training(self, **kwargs) -> "PPOConfig":
-        for k, v in kwargs.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown PPO training option {k!r}")
-            setattr(self, k, v)
-        return self
-
-    def build(self) -> "PPO":
-        return PPO(self)
-
 
 class PPO:
     """Iterative trainer: each `train()` = sample -> update -> sync."""
@@ -163,3 +140,6 @@ class PPO:
     def stop(self) -> None:
         self.env_runner_group.stop()
         self.learner_group.shutdown()
+
+
+PPOConfig.algo_class = PPO

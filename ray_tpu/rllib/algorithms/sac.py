@@ -18,6 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+
 from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
 
 _LOG_STD_MIN, _LOG_STD_MAX = -20.0, 2.0
@@ -181,7 +183,7 @@ class SACEnvRunner:
 
 
 @dataclasses.dataclass
-class SACConfig:
+class SACConfig(AlgorithmConfig):
     env: str = "Pendulum-v1"
     num_env_runners: int = 0               # 0 = local
     num_envs_per_env_runner: int = 8
@@ -200,24 +202,6 @@ class SACConfig:
     learning_starts: int = 1_000           # env steps before updates
     random_steps: int = 1_000              # uniform exploration warmup
     seed: int = 0
-
-    def environment(self, env: str) -> "SACConfig":
-        self.env = env
-        return self
-
-    def training(self, **kw) -> "SACConfig":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown SAC option {k!r}")
-            setattr(self, k, v)
-        return self
-
-    def env_runners(self, **kw) -> "SACConfig":
-        return self.training(**kw)
-
-    def build(self) -> "SAC":
-        return SAC(self)
-
 
 class SAC:
     """Iterative trainer: sample -> buffer -> k SAC updates (critic +
@@ -410,3 +394,6 @@ class SAC:
                     r.stop()
             except BaseException:
                 pass
+
+
+SACConfig.algo_class = SAC

@@ -61,30 +61,22 @@ def record_transitions(env_name: str, policy_fn: Callable, path: str,
 
 
 
-class _OfflineConfigMixin:
-    """Shared builder surface of the offline algorithm configs
-    (environment/offline_data/training/build)."""
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
 
+
+class _OfflineConfigMixin(AlgorithmConfig):
+    """Offline configs share the unified AlgorithmConfig surface, plus
+    the offline-data source group (reference config.offline_data())."""
+
+    # legacy alias: subclasses may still set _ALGO
     _ALGO: type = None
-
-    def environment(self, env: str):
-        self.env = env
-        return self
 
     def offline_data(self, input_path: str):
         self.input_path = input_path
         return self
 
-    def training(self, **kw):
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(
-                    f"unknown {type(self).__name__} option {k!r}")
-            setattr(self, k, v)
-        return self
-
     def build(self):
-        return self._ALGO(self)
+        return (self.algo_class or self._ALGO)(self)
 
 @dataclasses.dataclass
 class BCConfig(_OfflineConfigMixin):

@@ -235,6 +235,10 @@ class ServeController:
 
     def __init__(self):
         self._deployments: Dict[str, _DeploymentInfo] = {}
+        # application table: app name -> {route_prefix, ingress,
+        # deployments} (reference serve multi-app: one controller owns
+        # many independent deployment graphs, api.py serve.run(name=...))
+        self._apps: Dict[str, dict] = {}
         # name -> [(replica_id, handle, created_monotonic), ...]
         self._replicas: Dict[str, List[Any]] = {}
         # (name, replica_id) -> (ongoing, reported_monotonic)
@@ -290,6 +294,66 @@ class ServeController:
                 pass
         self._publish_membership(name, [])
 
+    # -------------------------------------------------- application api
+    def _check_app(self, name: str, route_prefix: str,
+                   deployments: List[str]) -> None:
+        """Collision rules vs OTHER apps (call with self._lock held)."""
+        for other, rec in self._apps.items():
+            if other == name:
+                continue
+            if rec["route_prefix"] == route_prefix:
+                raise ValueError(
+                    f"route_prefix {route_prefix!r} is already "
+                    f"taken by application {other!r}")
+            clash = set(deployments) & set(rec["deployments"])
+            if clash:
+                raise ValueError(
+                    f"deployment name(s) {sorted(clash)} already "
+                    f"belong to application {other!r}; rename via "
+                    f".options(name=...)")
+
+    def deploy_application(self, name: str, route_prefix: str,
+                           ingress: str,
+                           infos: List[_DeploymentInfo]) -> None:
+        """Atomically validate + register + deploy an application (a
+        named deployment graph with an HTTP route prefix). The
+        collision check and the app-table write happen under one lock,
+        so two racing serve.run() calls cannot both pass validation and
+        strand orphan deployments; deployments dropped by a redeploy
+        are deleted. `infos` arrive children-first so handles resolve
+        as replicas come up."""
+        dep_names = [i.name for i in infos]
+        with self._lock:
+            self._check_app(name, route_prefix, dep_names)
+            prev = self._apps.get(name)
+            stale = ([d for d in prev["deployments"]
+                      if d not in dep_names] if prev else [])
+            self._apps[name] = {"route_prefix": route_prefix,
+                                "ingress": ingress,
+                                "deployments": list(dep_names)}
+        for d in stale:
+            self.delete_deployment(d)
+        for info in infos:
+            self.deploy(info)
+
+    def delete_app(self, name: str) -> bool:
+        with self._lock:
+            rec = self._apps.pop(name, None)
+        if rec is None:
+            return False
+        for d in rec["deployments"]:
+            self.delete_deployment(d)
+        return True
+
+    def list_applications(self) -> Dict[str, dict]:
+        deps = self.list_deployments()
+        with self._lock:
+            return {n: {"route_prefix": rec["route_prefix"],
+                        "ingress": rec["ingress"],
+                        "deployments": {d: deps.get(d, {})
+                                        for d in rec["deployments"]}}
+                    for n, rec in self._apps.items()}
+
     def get_replicas(self, name: str) -> List[Any]:
         with self._lock:
             if name not in self._deployments:
@@ -308,6 +372,8 @@ class ServeController:
 
     def shutdown(self) -> None:
         self._running = False
+        with self._lock:
+            self._apps.clear()
         for name in list(self._deployments):
             self.delete_deployment(name)
 
@@ -713,32 +779,41 @@ def _get_controller():
         name=_CONTROLLER_NAME, get_if_exists=True).remote()
 
 
-def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
+def run(app: Application, name: Optional[str] = None,
+        route_prefix: Optional[str] = None) -> DeploymentHandle:
     """Deploy an application — including every bound sub-deployment in
     its init args — and return the top deployment's handle (reference
     serve.run, serve/api.py:491, with deployment-graph resolution:
     nested `.bind()`s become handles injected at replica init,
-    deployment_state.py:1245 + handle.py)."""
+    deployment_state.py:1245 + handle.py).
+
+    Multi-app (reference serve multi-application): `name` names the
+    application (and its ingress deployment); apps coexist under one
+    controller with independent lifecycles. `route_prefix` (default
+    `/<name>`) routes HTTP ingress traffic to this app's ingress
+    deployment by longest-prefix match."""
     import cloudpickle
     controller = _get_controller()
     ray_tpu.get(controller.ping.remote())
-    deployed: Dict[int, str] = {}        # id(Application) -> name
+    names: Dict[int, str] = {}           # id(Application) -> name
 
-    def _sub(value):
+    # ---- phase 1: assign names + validate (no side effects, so a
+    # refused app leaves no orphan deployments)
+    def _walk(value):
         if isinstance(value, Application):
-            return _BoundHandle(_deploy(value))
-        if isinstance(value, (list, tuple)):
-            return type(value)(_sub(v) for v in value)
-        if isinstance(value, dict):
-            return {k: _sub(v) for k, v in value.items()}
-        return value
+            _assign(value)
+        elif isinstance(value, (list, tuple)):
+            for v in value:
+                _walk(v)
+        elif isinstance(value, dict):
+            for v in value.values():
+                _walk(v)
 
-    def _deploy(a: Application, top_name: Optional[str] = None) -> str:
-        if id(a) in deployed:            # diamond: deploy shared child once
-            return deployed[id(a)]
-        d = a.deployment
-        dep_name = top_name or d.name
-        if dep_name in deployed.values():
+    def _assign(a: Application, top_name: Optional[str] = None) -> None:
+        if id(a) in names:               # diamond: shared child, once
+            return
+        dep_name = top_name or a.deployment.name
+        if dep_name in names.values():
             # two DISTINCT binds under one name would silently clobber
             # each other (both handles routing to whichever deployed
             # last) — make the user disambiguate
@@ -746,20 +821,50 @@ def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
                 f"deployment name {dep_name!r} is bound more than once "
                 f"in this application graph; give each bind a distinct "
                 f"name via .options(name=...)")
-        deployed[id(a)] = dep_name
+        names[id(a)] = dep_name
+        for v in list(a.init_args) + list(a.init_kwargs.values()):
+            _walk(v)
+
+    _assign(app, name)
+    top = names[id(app)]
+    app_name = name or top
+    prefix = route_prefix if route_prefix is not None else f"/{app_name}"
+
+    # ---- phase 2: build infos children-first (still no side effects)
+    infos: List[_DeploymentInfo] = []
+    built: set = set()
+
+    def _sub(value):
+        if isinstance(value, Application):
+            _build(value)
+            return _BoundHandle(names[id(value)])
+        if isinstance(value, (list, tuple)):
+            return type(value)(_sub(v) for v in value)
+        if isinstance(value, dict):
+            return {k: _sub(v) for k, v in value.items()}
+        return value
+
+    def _build(a: Application) -> None:
+        if id(a) in built:
+            return
+        built.add(id(a))
+        d = a.deployment
         init_args = tuple(_sub(v) for v in a.init_args)
         init_kwargs = {k: _sub(v) for k, v in a.init_kwargs.items()}
-        info = _DeploymentInfo(
-            name=dep_name, cls_bytes=cloudpickle.dumps(d._cls),
+        infos.append(_DeploymentInfo(
+            name=names[id(a)], cls_bytes=cloudpickle.dumps(d._cls),
             init_args=init_args, init_kwargs=init_kwargs,
             num_replicas=d.num_replicas,
             max_ongoing_requests=d.max_ongoing_requests,
             ray_actor_options=d.ray_actor_options,
-            autoscaling_config=d.autoscaling_config)
-        ray_tpu.get(controller.deploy.remote(info))
-        return dep_name
+            autoscaling_config=d.autoscaling_config))
 
-    top = _deploy(app, name)
+    _build(app)
+    # ---- phase 3: ONE atomic controller call (validate + register +
+    # deploy under the controller's lock — no validate/deploy TOCTOU
+    # between concurrent serve.run()s)
+    ray_tpu.get(controller.deploy_application.remote(
+        app_name, prefix, top, infos))
     return DeploymentHandle(top, controller)
 
 
@@ -768,14 +873,31 @@ def get_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name, controller)
 
 
+def get_app_handle(name: str) -> DeploymentHandle:
+    """Handle to a named application's ingress deployment."""
+    controller = _get_controller()
+    apps = ray_tpu.get(controller.list_applications.remote())
+    if name not in apps:
+        raise ValueError(f"no application named {name!r}")
+    return DeploymentHandle(apps[name]["ingress"], controller)
+
+
 def status() -> Dict[str, dict]:
     controller = _get_controller()
     return ray_tpu.get(controller.list_deployments.remote())
 
 
-def delete(name: str) -> None:
+def status_applications() -> Dict[str, dict]:
     controller = _get_controller()
-    ray_tpu.get(controller.delete_deployment.remote(name))
+    return ray_tpu.get(controller.list_applications.remote())
+
+
+def delete(name: str) -> None:
+    """Delete an application (the whole graph, by app name) or a single
+    standalone deployment."""
+    controller = _get_controller()
+    if not ray_tpu.get(controller.delete_app.remote(name)):
+        ray_tpu.get(controller.delete_deployment.remote(name))
 
 
 def shutdown() -> None:
@@ -815,6 +937,34 @@ def start_http(port: int = 8000, host: str = "127.0.0.1") -> int:
         stop_http()          # never orphan a running ingress
 
     handles: Dict[str, DeploymentHandle] = {}
+    # application route table, refreshed lazily (reference proxy keeps
+    # routes current via long-poll; a 2s TTL poll is our equivalent)
+    routes_cache = {"ts": 0.0, "apps": {}}
+
+    def _app_routes() -> Dict[str, dict]:
+        now = time.time()
+        if now - routes_cache["ts"] > 2.0:
+            try:
+                controller = _get_controller()
+                routes_cache["apps"] = ray_tpu.get(
+                    controller.list_applications.remote(), timeout=10)
+                routes_cache["ts"] = now
+            except BaseException:
+                pass
+        return routes_cache["apps"]
+
+    def _match_app(path: str):
+        """Longest-prefix match of `path` against app route_prefixes;
+        returns (ingress deployment, remaining path) or None."""
+        best = None
+        for rec in _app_routes().values():
+            p = rec["route_prefix"].rstrip("/")
+            if path == p or path == p + "/" or path.startswith(p + "/"):
+                if best is None or len(p) > len(best[0]):
+                    best = (p, rec["ingress"])
+        if best is None:
+            return None
+        return best[1], path[len(best[0]):].strip("/")
 
     class Ingress(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -822,9 +972,14 @@ def start_http(port: int = 8000, host: str = "127.0.0.1") -> int:
         def do_POST(self):
             from urllib.parse import parse_qs, urlsplit
             url = urlsplit(self.path)
-            parts = url.path.strip("/").split("/")
-            name = parts[0]
-            streaming = (len(parts) > 1 and parts[1] == "stream") or \
+            matched = _match_app(url.path)
+            if matched is not None:
+                name, rest = matched
+                sub = rest.split("/") if rest else []
+            else:           # legacy: POST /<deployment>[/stream]
+                parts = url.path.strip("/").split("/")
+                name, sub = parts[0], parts[1:]
+            streaming = ("stream" in sub[:1]) or \
                 parse_qs(url.query).get("stream", ["0"])[0] == "1"
             try:
                 n = int(self.headers.get("Content-Length", 0))

@@ -89,6 +89,8 @@ class _Coordinator:
             out = np.maximum.reduce(arrays)
         elif op == "min":
             out = np.minimum.reduce(arrays)
+        elif op == "prod":
+            out = np.multiply.reduce(arrays)
         elif op == "mean":
             out = sum(arrays[1:], arrays[0].copy()) / len(arrays)
         else:

@@ -81,6 +81,19 @@ def list_placement_groups(
     return _apply_filters(_op("list_placement_groups"), filters)
 
 
+def list_workers(filters: Optional[Sequence[Filter]] = None) -> List[Dict]:
+    """Worker-manager table: every pooled worker process across the
+    cluster (reference `ray list workers` / GcsWorkerManager)."""
+    return _apply_filters(_op("list_workers"), filters)
+
+
+def usage_stats() -> Dict[str, Any]:
+    """Cluster usage rollup: uptime, node/worker counts, task + actor
+    state summaries, resources, object store (reference usage-stats
+    aggregation, shaped for the dashboard)."""
+    return _op("usage_stats")
+
+
 def _get_by_id(rows: List[Dict], key: str, value: str) -> Optional[Dict]:
     for r in rows:
         if r.get(key) == value:
@@ -140,6 +153,7 @@ _LISTERS = {
     "tasks": list_tasks,
     "nodes": list_nodes,
     "placement-groups": list_placement_groups,
+    "workers": list_workers,
 }
 
 

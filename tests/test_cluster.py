@@ -269,3 +269,53 @@ def test_trainer_schedules_through_placement_group():
                     resources_per_worker={"CPU": 64}),
                 run_config=RunConfig(name="pgbig", storage_path=d)).fit()
     ray_tpu.shutdown()
+
+
+# -------------------------------------------------- node-label scheduling
+def test_node_label_scheduling():
+    """NodeLabelSchedulingStrategy: hard constraints filter nodes, soft
+    constraints prefer, infeasible labels park until a matching node
+    joins (reference NodeLabelSchedulingStrategy + label match exprs)."""
+    from ray_tpu.util.scheduling_strategies import (
+        DoesNotExist, Exists, In, NodeLabelSchedulingStrategy)
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, labels={"region": "us", "tier": "head"})
+    try:
+        c = Cluster(initialize_head=False)
+        n2 = c.add_node(num_cpus=2,
+                        labels={"region": "eu", "accel": "v5e"})
+        n3 = c.add_node(num_cpus=2, labels={"region": "eu"})
+
+        def where(strategy):
+            return ray_tpu.get(_where.options(
+                scheduling_strategy=strategy).remote(), timeout=120)
+
+        assert where(NodeLabelSchedulingStrategy(
+            hard={"accel": Exists()})) == n2
+        # plain string is sugar for In(value); ops compose per-key
+        assert where(NodeLabelSchedulingStrategy(
+            hard={"region": "eu", "accel": DoesNotExist()})) == n3
+        assert where(NodeLabelSchedulingStrategy(
+            soft={"accel": In("v5e")})) == n2
+        # soft-only constraint that nothing satisfies still schedules
+        # (anywhere — soft never makes a task infeasible)
+        assert where(NodeLabelSchedulingStrategy(
+            soft={"accel": In("nonexistent")}))
+
+        # hard-infeasible parks until a matching node joins
+        ref = _where.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"region": In("ap")})).remote()
+        ready, _ = ray_tpu.wait([ref], timeout=3)
+        assert not ready
+        n4 = c.add_node(num_cpus=1, labels={"region": "ap"})
+        assert ray_tpu.get(ref, timeout=120) == n4
+
+        # labels surface on the state API
+        from ray_tpu.util import state
+        by_id = {n["node_id"]: n for n in state.list_nodes()}
+        assert by_id[n2]["labels"]["accel"] == "v5e"
+    finally:
+        ray_tpu.shutdown()

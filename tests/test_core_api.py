@@ -323,7 +323,7 @@ def test_runtime_env_actor_env_vars(ray_cluster):
 
 def test_runtime_env_unsupported_keys_raise(ray_cluster):
     with pytest.raises(ValueError, match="unsupported runtime_env"):
-        ray_tpu.remote(runtime_env={"conda": "env.yml"})(lambda: 1)
+        ray_tpu.remote(runtime_env={"nfs_mount": "/x"})(lambda: 1)
 
     with pytest.raises(TypeError, match="env_vars"):
         ray_tpu.remote(runtime_env={"env_vars": {"A": 1}})(lambda: 1)
@@ -392,3 +392,27 @@ def test_cancel_infeasible_parked_task(ray_cluster):
     with pytest.raises(TaskError) as ei:
         ray_tpu.get(ref, timeout=20)
     assert isinstance(ei.value.cause, TaskCancelledError)
+
+
+def test_pipelined_task_stolen_from_blocked_worker(fresh_cluster):
+    """Deadlock regression: a task pipelined behind another task on the
+    same worker's FIFO, where the front task then blocks in a nested
+    get() on the queued one. The scheduler must steal the queued task
+    back (UNQUEUE_TASK) and run it elsewhere — without that, the get
+    waits on a task that can never start (its exec thread is the one
+    blocking)."""
+    import time as _t
+
+    @ray_tpu.remote(num_cpus=0)
+    def inner():
+        return 7
+
+    @ray_tpu.remote(num_cpus=0)
+    def outer():
+        ref = inner.remote()
+        # give the scheduler time to pipeline `inner` behind us on this
+        # worker (num_cpus=0 on a cold pool -> we are the only worker)
+        _t.sleep(0.5)
+        return ray_tpu.get(ref)
+
+    assert ray_tpu.get(outer.remote(), timeout=90) == 7

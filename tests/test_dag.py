@@ -199,3 +199,75 @@ def test_channel_dag_raw_array_fast_path(ray_cluster):
         assert isinstance(got, jax.Array)
     finally:
         dag.teardown()
+
+
+# ------------------------------------------------- collective nodes
+def test_dag_allreduce_collective_nodes(ray_cluster):
+    """allreduce_bind: per-actor shards reduce inside the DAG; each
+    participant continues with the reduced value (reference aDAG
+    collective nodes, torch_tensor_nccl_channel / collective ops)."""
+    from ray_tpu.dag import MultiOutputNode, allreduce_bind
+
+    @ray_tpu.remote
+    class Shard:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def compute(self, x):
+            return np.asarray(x, dtype=np.float64) * self.scale
+
+        def tag(self, reduced):
+            return (self.scale, np.asarray(reduced))
+
+    actors = [Shard.remote(s) for s in (1.0, 2.0, 3.0)]
+    with InputNode() as inp:
+        shards = [a.compute.bind(inp) for a in actors]
+        reduced = allreduce_bind(shards, op="sum")
+        outs = [a.tag.bind(r) for a, r in zip(actors, reduced)]
+        dag_out = MultiOutputNode(outs)
+
+    dag = dag_out.experimental_compile()
+    try:
+        x = np.array([1.0, 10.0])
+        for round_i in range(2):          # group reused across executes
+            results = ray_tpu.get(dag.execute(x + round_i), timeout=120)
+            want = (x + round_i) * 6.0    # 1x + 2x + 3x
+            scales = sorted(s for s, _ in results)
+            assert scales == [1.0, 2.0, 3.0]
+            for _s, arr in results:
+                np.testing.assert_allclose(arr, want)
+    finally:
+        dag.teardown()
+
+    # mixed ops + validation
+    with pytest.raises(ValueError, match="distinct actors"):
+        with InputNode() as inp:
+            s0 = actors[0].compute.bind(inp)
+            s1 = actors[0].compute.bind(inp)
+            allreduce_bind([s0, s1])
+
+
+def test_dag_allreduce_ops(ray_cluster):
+    from ray_tpu.dag import MultiOutputNode, allreduce_bind
+
+    @ray_tpu.remote
+    class A:
+        def __init__(self, v):
+            self.v = v
+
+        def emit(self, _):
+            return np.array([self.v], dtype=np.float64)
+
+    actors = [A.remote(v) for v in (4.0, 6.0)]
+    for op, want in (("max", 6.0), ("mean", 5.0), ("prod", 24.0)):
+        with InputNode() as inp:
+            outs = allreduce_bind([a.emit.bind(inp) for a in actors],
+                                  op=op)
+            dag_out = MultiOutputNode(outs)
+        dag = dag_out.experimental_compile()
+        try:
+            r = ray_tpu.get(dag.execute(0), timeout=120)
+            assert all(abs(float(arr[0]) - want) < 1e-9 for arr in r), (
+                op, r)
+        finally:
+            dag.teardown()

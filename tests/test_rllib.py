@@ -848,3 +848,41 @@ def test_dreamerv3_world_model_and_imagination_gate(fresh_cluster):
     assert wm_last < 0.75 * wm_first, (wm_first, wm_last)
     assert np.mean([s["imag_return_mean"] for s in stats[-3:]]) > 2.0
     assert stats[-1]["actor_entropy"] < 0.65, stats[-1]["actor_entropy"]
+
+
+# ------------------------------------------------ unified AlgorithmConfig
+def test_unified_algorithm_config_surface():
+    """Every algorithm config shares one builder base (reference
+    algorithm_config.py): fluent groups, unknown-option rejection,
+    copy/to_dict, algo_class-driven build."""
+    from ray_tpu.rllib import AlgorithmConfig
+    from ray_tpu.rllib.algorithms.appo import APPOConfig
+    from ray_tpu.rllib.algorithms.dqn import DQNConfig
+    from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3Config
+    from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    from ray_tpu.rllib.algorithms.sac import SACConfig
+    from ray_tpu.rllib.offline import BCConfig, CQLConfig, MARWILConfig
+
+    configs = [PPOConfig, DQNConfig, SACConfig, IMPALAConfig,
+               APPOConfig, DreamerV3Config, BCConfig, MARWILConfig,
+               CQLConfig]
+    for C in configs:
+        c = C()
+        assert isinstance(c, AlgorithmConfig)
+        out = c.environment("CartPole-v1").training(seed=3).debugging(
+            seed=4)
+        assert out is c and c.env == "CartPole-v1" and c.seed == 4
+        dup = c.copy()
+        dup.training(seed=9)
+        assert c.seed == 4                  # deep copy
+        assert dup.to_dict()["seed"] == 9
+        with pytest.raises(ValueError, match="unknown"):
+            c.training(definitely_not_an_option=1)
+    # build() goes through algo_class uniformly
+    algo = PPOConfig().environment("CartPole-v1").env_runners(
+        num_envs_per_env_runner=2, rollout_length=8).build()
+    try:
+        assert type(algo).__name__ == "PPO"
+    finally:
+        algo.stop()

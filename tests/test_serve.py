@@ -306,3 +306,102 @@ def test_serve_longpoll_membership_push(serve_shutdown):
         time.sleep(0.3)
     assert len(pids) >= 2, (
         "handle never discovered scaled-up replicas via push")
+
+
+# ----------------------------------------------------- multi-app
+def test_serve_multi_app_routing_and_lifecycle(serve_shutdown):
+    """Two applications under one controller: independent graphs, HTTP
+    routing by route_prefix, per-app delete (reference multi-app
+    serve.run(name=..., route_prefix=...))."""
+    @serve.deployment(num_replicas=1)
+    class Upper:
+        def __call__(self, x):
+            return str(x).upper()
+
+    @serve.deployment(num_replicas=1)
+    class Greeter:
+        def __init__(self, style, shouter):
+            self.style = style
+            self.shouter = shouter
+
+        def __call__(self, x):
+            loud = ray_tpu.get(self.shouter.remote(x), timeout=30)
+            return f"{self.style} {loud}"
+
+    h1 = serve.run(Greeter.bind("hello", Upper.bind()), name="greet",
+                   route_prefix="/api/greet")
+    h2 = serve.run(Upper.bind(), name="shout")
+
+    assert ray_tpu.get(h1.remote("bob"), timeout=60) == "hello BOB"
+    assert ray_tpu.get(h2.remote("hi"), timeout=60) == "HI"
+
+    apps = serve.status_applications()
+    assert apps["greet"]["route_prefix"] == "/api/greet"
+    assert apps["greet"]["ingress"] == "greet"
+    assert set(apps["greet"]["deployments"]) == {"greet", "Upper"}
+    assert apps["shout"]["route_prefix"] == "/shout"
+
+    # app handle resolves to the ingress deployment
+    assert ray_tpu.get(serve.get_app_handle("greet").remote("x"),
+                       timeout=30) == "hello X"
+
+    # HTTP ingress routes by prefix (nested path -> longest match)
+    port = serve.start_http(port=0)
+    try:
+        for path, want in [("/api/greet", "hello Y"), ("/shout", "Y")]:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps("y").encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert json.loads(resp.read())["result"] == want
+    finally:
+        serve.stop_http()
+
+    # deleting one app removes its whole graph, leaves the other
+    serve.delete("greet")
+    st = serve.status()
+    assert "greet" not in st and "Upper" not in st
+    assert "shout" in st
+    assert ray_tpu.get(h2.remote("ok"), timeout=30) == "OK"
+    assert "greet" not in serve.status_applications()
+
+
+def test_serve_multi_app_collisions_and_redeploy(serve_shutdown):
+    @serve.deployment(num_replicas=1)
+    def f(x):
+        return x
+
+    @serve.deployment(num_replicas=1)
+    def g(x):
+        return -x
+
+    @serve.deployment(num_replicas=1)
+    class P:
+        def __init__(self, child=None):
+            self.child = child
+
+        def __call__(self, x):
+            return x
+
+    serve.run(f.bind(), name="a1", route_prefix="/one")
+    # prefix collision with another app is refused
+    with pytest.raises(Exception, match="route_prefix"):
+        serve.run(g.bind(), name="a2", route_prefix="/one")
+    # deployment-name collision across apps is refused (a CHILD named
+    # like app a1's deployment; run(name=...) renames only the top)
+    with pytest.raises(Exception, match="belong to application"):
+        serve.run(P.bind(g.options(name="a1").bind()), name="a3",
+                  route_prefix="/three")
+    # ...and the refused app deployed NOTHING (validate-before-deploy)
+    assert "a3" not in serve.status()
+    # redeploying an app prunes deployments dropped from its graph
+    serve.run(P.bind(g.bind()), name="a1", route_prefix="/one")
+    assert "g" in serve.status()
+    serve.run(P.bind(), name="a1", route_prefix="/one")
+    deadline = time.time() + 30
+    while time.time() < deadline and "g" in serve.status():
+        time.sleep(0.2)
+    st = serve.status()
+    assert "g" not in st and "a1" in st
+    assert set(serve.status_applications()["a1"]["deployments"]) == {"a1"}

@@ -105,6 +105,11 @@ def test_state_api_lists(ray_cluster):
     assert nodes and nodes[0]["alive"]
     assert state.cluster_resources().get("CPU", 0) > 0
     assert "bytes" in state.object_store_stats()
+    workers = state.list_workers()
+    assert workers and all(w["worker_id"] for w in workers)
+    busy = state.list_workers(filters=[("state", "!=", "missing")])
+    assert len(busy) == len(workers)
+    assert state.usage_stats()["workers"] == len(workers)
 
 
 def test_worker_side_task_events_and_host_stats(ray_cluster):

@@ -209,6 +209,21 @@ def test_dashboard_endpoints(ray_cluster):
         assert "ray_tpu" in html
         metrics = get("/metrics").decode()
         assert "dashboard_test_total 3" in metrics
+        # worker-manager table + usage rollup (frontend Workers tab)
+        workers = json.loads(get("/api/workers"))
+        assert workers and all("node_id" in w and "pid" in w
+                               for w in workers)
+        assert any(w["state"] for w in workers)
+        usage = json.loads(get("/api/usage"))
+        assert usage["nodes_alive"] >= 1
+        assert usage["workers"] == len(workers)
+        assert usage["uptime_s"] > 0
+        assert usage["tasks"].get("FINISHED", 0) >= 1
+        # serve_applications degrades to {} when serve is down
+        assert json.loads(get("/api/serve_applications")) == {}
+        # chrome-trace export parses and carries task events
+        trace = json.loads(get("/api/timeline"))
+        assert isinstance(trace, list)
     finally:
         stop_dashboard()
 

@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ray_tpu._private import protocol
+from ray_tpu._private.runtime_env import has_container
 from ray_tpu._private.specs import ActorSpec, ActorTaskSpec, TaskSpec
 
 IDLE = "idle"
@@ -581,7 +582,6 @@ class Scheduler:
         idle_only = isinstance(spec, ActorSpec)
         # container tasks can only run in a worker SPAWNED inside the
         # image (exact env-hash match); plain workers can't adopt one
-        from ray_tpu._private.runtime_env import has_container
         exact_only = spec is not None and has_container(
             getattr(spec, "runtime_env", None))
         depth = _CFG.worker_pipeline_depth
@@ -901,8 +901,6 @@ class Scheduler:
                     finally:
                         self._cv.acquire()
                     if spawn_err is not None:
-                        from ray_tpu._private.runtime_env import \
-                            has_container
                         if (has_container(getattr(spec, "runtime_env",
                                                   None))
                                 and id(spec) in self._queued_at):

@@ -286,6 +286,12 @@ def _crc32c(data: bytes) -> int:
 
 
 def _masked_crc(data: bytes) -> int:
+    try:
+        from ray_tpu import native
+        if native.available():          # ~1.5 GB/s vs ~7 MB/s in Python
+            return native.masked_crc32c(data)
+    except Exception:
+        pass
     crc = _crc32c(data)
     return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
 

@@ -108,6 +108,36 @@ def _wait(predicate, timeout: Optional[float], what: str):
         sleep = min(sleep * 1.5, 1e-3)
 
 
+def _wait_words(ch: "Channel", offset: int, count: int, value: int,
+                timeout: Optional[float], what: str) -> None:
+    """Wait until the `count` u64 header words at `offset` are all
+    >= value. Native path (ray_tpu/native/core.c) spins with the GIL
+    RELEASED — the Python fallback holds the GIL between checks, which
+    on few-core hosts starves the very peer being waited on."""
+    from ray_tpu import native
+    if native.available():
+        # ≤100ms native slices: the C spin releases the GIL but also
+        # blocks Python signal delivery — slicing keeps Ctrl-C (and
+        # teardown exceptions) responsive even on timeout=None waits
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        mv = ch._map()
+        while True:
+            if deadline is None:
+                chunk = 0.1
+            else:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ChannelTimeout(
+                        f"timed out waiting for {what}")
+                chunk = min(remaining, 0.1)
+            if native.wait_u64s_ge(mv, offset, count, value, chunk):
+                return
+        # not reached
+    _wait(lambda: all(ch._u64(offset + 8 * i) >= value
+                      for i in range(count)), timeout, what)
+
+
 class Channel:
     """Descriptor + mapping for one channel. Create once (driver side),
     then hand to exactly one writer and `n_readers` readers (each with a
@@ -173,9 +203,8 @@ class ChannelWriter:
                 f"{ch.capacity}; recompile with a larger "
                 f"buffer_size_bytes")
         seq = self._seq
-        _wait(lambda: all(
-            ch._u64(32 + 8 * i) >= seq for i in range(ch.n_readers)),
-            timeout, "readers to consume previous message")
+        _wait_words(ch, 32, ch.n_readers, seq, timeout,
+                    "readers to consume previous message")
         mv = ch._map()
         off = ch._payload_off
         mv[off:off + len(data)] = data
@@ -205,9 +234,8 @@ class ChannelWriter:
                 f"{ch.capacity}; recompile with a larger "
                 f"buffer_size_bytes")
         seq = self._seq
-        _wait(lambda: all(
-            ch._u64(32 + 8 * i) >= seq for i in range(ch.n_readers)),
-            timeout, "readers to consume previous message")
+        _wait_words(ch, 32, ch.n_readers, seq, timeout,
+                    "readers to consume previous message")
         mv = ch._map()
         off = ch._payload_off
         struct.pack_into("<I", mv, off, len(meta))
@@ -223,9 +251,8 @@ class ChannelWriter:
         ch = self.ch
         try:
             seq = self._seq
-            _wait(lambda: all(
-                ch._u64(32 + 8 * i) >= seq for i in range(ch.n_readers)),
-                timeout, "readers before close")
+            _wait_words(ch, 32, ch.n_readers, seq, timeout,
+                        "readers before close")
         except ChannelTimeout:
             # A reader hasn't consumed the last published message yet;
             # stomping the len word would silently drop it. Leave the
@@ -250,7 +277,7 @@ class ChannelReader:
 
     def read_bytes(self, timeout: Optional[float] = None) -> bytes:
         ch = self.ch
-        _wait(lambda: ch._u64(16) >= self._expect, timeout, "message")
+        _wait_words(ch, 16, 1, self._expect, timeout, "message")
         length = ch._u64(24)
         if length != _CLOSED_LEN and (length & _RAW_FLAG):
             # refuse BEFORE consuming: the frame stays readable via
@@ -262,7 +289,7 @@ class ChannelReader:
 
     def _read_frame(self, timeout: Optional[float]):
         ch = self.ch
-        _wait(lambda: ch._u64(16) >= self._expect, timeout, "message")
+        _wait_words(ch, 16, 1, self._expect, timeout, "message")
         length = ch._u64(24)
         if length == _CLOSED_LEN:
             raise ChannelClosed(ch.name)

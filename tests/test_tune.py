@@ -249,7 +249,10 @@ def make_pbt_trainable():
             state = ckpt.load_state()
             start = int(state["step"])
             parent_lr = float(state["lr"])
-        for step in range(start, 10):
+        # 20 paced steps: under full-suite load worker spawns stagger
+        # trial starts by seconds — the population must still overlap
+        # long enough for at least one exploit decision
+        for step in range(start, 20):
             # pace the loop so the whole population overlaps in time —
             # PBT needs concurrent trials to compare quantiles
             _time.sleep(0.5)

@@ -97,7 +97,13 @@ class _StageState:
 
 class ExecutionStats:
     """Per-stage task counts + cumulative task seconds of the last
-    streaming execution (reference Dataset.stats())."""
+    streaming execution (reference Dataset.stats()).
+
+    `task_s` is wall time IN FLIGHT (dispatch -> completion), so it
+    includes queue and worker-spawn time, not just execution;
+    `blocks_out` is counted only for the terminal stage (intermediate
+    blocks flow worker-to-worker as refs and are never materialized on
+    the driver)."""
 
     def __init__(self, stages: List[_StageState], wall_s: float):
         self.wall_s = wall_s

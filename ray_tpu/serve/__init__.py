@@ -335,6 +335,7 @@ class ServeController:
             self.delete_deployment(d)
         for info in infos:
             self.deploy(info)
+        self._publish_routes()
 
     def delete_app(self, name: str) -> bool:
         with self._lock:
@@ -343,7 +344,19 @@ class ServeController:
             return False
         for d in rec["deployments"]:
             self.delete_deployment(d)
+        self._publish_routes()
         return True
+
+    def _publish_routes(self) -> None:
+        """Push the application route table to the HTTP proxy over the
+        control-plane pubsub (reference long_poll.py route-table push)
+        so routing reflects deploys/deletes immediately instead of on a
+        poll interval."""
+        with self._lock:
+            routes = {n: {"route_prefix": rec["route_prefix"],
+                          "ingress": rec["ingress"]}
+                      for n, rec in self._apps.items()}
+        _publish("serve:routes", {"routes": routes, "ts": time.time()})
 
     def list_applications(self) -> Dict[str, dict]:
         deps = self.list_deployments()
@@ -478,14 +491,8 @@ class ServeController:
         """Push the replica-set change to subscribed handles over the
         control-plane pubsub (reference long_poll.py config push) —
         handles refresh on the push instead of polling."""
-        try:
-            from ray_tpu._private import context as _c
-            _c.get_ctx().state_op(
-                "pubsub_publish", channel=f"serve:{name}",
-                message={"deployment": name, "replicas": rids,
-                         "ts": time.time()})
-        except BaseException:
-            pass
+        _publish(f"serve:{name}", {"deployment": name, "replicas": rids,
+                                   "ts": time.time()})
 
     def _sweep_draining(self, name: str, now: float) -> None:
         """Kill drain victims that finished their in-flight work (or hit
@@ -695,38 +702,61 @@ class DeploymentHandle:
                     pass
 
 
-def _handle_watch_loop(handle_ref, name: str) -> None:
-    """Holds only a weakref to the handle: the handle stays collectable
-    and the thread exits when it goes away. Long-polls park HEAD-side in
-    the publisher's waiter list (never on a connection reader)."""
+def _publish(channel: str, message: dict) -> None:
+    """Best-effort control-plane pubsub publish (reference
+    long_poll.py's push side)."""
+    try:
+        from ray_tpu._private import context as _c
+        _c.get_ctx().state_op("pubsub_publish", channel=channel,
+                              message=message)
+    except BaseException:
+        pass
+
+
+def _watch_channel(channel: str, on_msgs, should_stop) -> None:
+    """Shared long-poll watch skeleton (reference long_poll.py client
+    loop): park on the channel, resync on StaleCursorError (the ring
+    lapped us — treat as one coalesced notification), back off while
+    the runtime is down or unreachable. Polls park HEAD-side in the
+    publisher's waiter list (never on a connection reader)."""
     from ray_tpu._private import context as _context
+    from ray_tpu._private.pubsub import StaleCursorError
     cursor = 0
-    while True:
+    while not should_stop():
         ctx = _context.maybe_ctx()
-        if ctx is None or handle_ref() is None:
-            return
-        from ray_tpu._private.pubsub import StaleCursorError
+        if ctx is None:
+            # runtime down (or not up yet): keep the thread alive so a
+            # re-init resumes pushes instead of silently degrading to
+            # the slow fallback forever
+            time.sleep(1.0)
+            continue
         try:
-            out = ctx.state_op("pubsub_poll", channel=f"serve:{name}",
+            out = ctx.state_op("pubsub_poll", channel=channel,
                                cursor=cursor, timeout=15.0)
             msgs, cursor = out if out else ([], cursor)
         except StaleCursorError as e:
-            # fell behind the ring: resync from the head seq and do one
-            # catch-up refresh for whatever was missed
             cursor = getattr(e, "resync", 0)
             msgs = [None]
         except BaseException:
             time.sleep(1.0)
             continue
-        h = handle_ref()
-        if h is None:
-            return
-        if msgs:
+        if msgs and not should_stop():
             try:
-                h._refresh(force=True)
+                on_msgs(msgs)
             except BaseException:
                 pass
-        del h
+
+
+def _handle_watch_loop(handle_ref, name: str) -> None:
+    """Holds only a weakref to the handle: the handle stays collectable
+    and the thread exits when it goes away."""
+    def on_msgs(_msgs) -> None:
+        h = handle_ref()
+        if h is not None:
+            h._refresh(force=True)
+
+    _watch_channel(f"serve:{name}", on_msgs,
+                   lambda: handle_ref() is None)
 
 
 # ---------------------------------------------------------- user API
@@ -937,18 +967,31 @@ def start_http(port: int = 8000, host: str = "127.0.0.1") -> int:
         stop_http()          # never orphan a running ingress
 
     handles: Dict[str, DeploymentHandle] = {}
-    # application route table, refreshed lazily (reference proxy keeps
-    # routes current via long-poll; a 2s TTL poll is our equivalent)
-    routes_cache = {"ts": 0.0, "apps": {}}
+    # application route table: pushed over the `serve:routes` pubsub
+    # channel by the controller on every deploy/delete (reference
+    # long_poll.py route-table push); a slow TTL poll stays as the
+    # fallback for missed pushes
+    routes_cache = {"ts": 0.0, "apps": {}, "stop": False,
+                    "loaded_at": -1.0}
+    routes_lock = threading.Lock()
+
+    def _load_routes() -> None:
+        # ordered application: a slow fallback load that STARTED before
+        # a push-triggered reload must not overwrite the fresher table
+        started = time.monotonic()
+        controller = _get_controller()
+        apps = ray_tpu.get(controller.list_applications.remote(),
+                           timeout=10)
+        with routes_lock:
+            if started > routes_cache["loaded_at"]:
+                routes_cache["apps"] = apps
+                routes_cache["loaded_at"] = started
+                routes_cache["ts"] = time.time()
 
     def _app_routes() -> Dict[str, dict]:
-        now = time.time()
-        if now - routes_cache["ts"] > 2.0:
+        if time.time() - routes_cache["ts"] > 30.0:   # slow fallback
             try:
-                controller = _get_controller()
-                routes_cache["apps"] = ray_tpu.get(
-                    controller.list_applications.remote(), timeout=10)
-                routes_cache["ts"] = now
+                _load_routes()
             except BaseException:
                 pass
         return routes_cache["apps"]
@@ -1026,6 +1069,15 @@ def start_http(port: int = 8000, host: str = "127.0.0.1") -> int:
             pass
 
     _HTTP_SERVER = ThreadingHTTPServer((host, port), Ingress)
+    _HTTP_SERVER._rtpu_routes_cache = routes_cache   # for stop_http
+    # start the push watcher only once the server actually bound — a
+    # bind failure must not leak an unstoppable polling thread
+    threading.Thread(
+        target=_watch_channel,
+        args=("serve:routes",
+              lambda _msgs: _load_routes(),
+              lambda: routes_cache["stop"]),
+        name="serve-routes-watch", daemon=True).start()
     threading.Thread(target=_HTTP_SERVER.serve_forever,
                      daemon=True).start()
     return _HTTP_SERVER.server_address[1]
@@ -1034,6 +1086,9 @@ def start_http(port: int = 8000, host: str = "127.0.0.1") -> int:
 def stop_http() -> None:
     global _HTTP_SERVER
     if _HTTP_SERVER is not None:
+        cache = getattr(_HTTP_SERVER, "_rtpu_routes_cache", None)
+        if cache is not None:
+            cache["stop"] = True       # routes watch thread exits
         _HTTP_SERVER.shutdown()
         _HTTP_SERVER = None
 

@@ -405,3 +405,48 @@ def test_serve_multi_app_collisions_and_redeploy(serve_shutdown):
     st = serve.status()
     assert "g" not in st and "a1" in st
     assert set(serve.status_applications()["a1"]["deployments"]) == {"a1"}
+
+
+def test_serve_route_push_reaches_ingress(serve_shutdown):
+    """Deploying an app AFTER the HTTP ingress started must become
+    routable via the controller's `serve:routes` pubsub push — well
+    inside the 30s fallback poll window (reference long_poll.py
+    route-table push)."""
+    port = serve.start_http(port=0)
+    try:
+        # PRIME the route cache first (a 404-ish request triggers the
+        # initial fallback load, stamping it fresh): after this, only
+        # the pubsub push — not the 30s fallback — can make the new
+        # app routable inside the assertion window below
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/nothing-here",
+            data=b"null", headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+        except Exception:
+            pass
+
+        @serve.deployment(num_replicas=1)
+        def dbl(x):
+            return x * 2
+
+        serve.run(dbl.bind(), name="pushed", route_prefix="/pushed")
+        deadline = time.time() + 15
+        result = None
+        while time.time() < deadline:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/pushed",
+                    data=json.dumps(21).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    out = json.loads(resp.read())
+                    if out.get("result") == 42:
+                        result = out["result"]
+                        break
+            except Exception:
+                pass
+            time.sleep(0.25)
+        assert result == 42, "route push never reached the ingress"
+    finally:
+        serve.stop_http()

@@ -160,6 +160,10 @@ def test_agent_death_task_retry_and_lineage(head):
         time.sleep(0.1)
     assert rt.controller.has_location(ref.object_id)
 
+    # remember where the only copy lives BEFORE the kill: stale state
+    # (a1 not yet detected dead) must not satisfy the milestones below
+    (a1_node,) = rt.controller.locations(ref.object_id)
+
     # whack the agent; the only copy of the object dies with it
     a1.kill()
     # resource-constrained resubmit can never run (agent1 is gone), so
@@ -168,8 +172,39 @@ def test_agent_death_task_retry_and_lineage(head):
     # resource so the resubmitted task can land.
     a2 = NodeAgentProcess(num_cpus=2, resources={"agent1": 10.0})
     agents.append(a2)
-    # generous: under full-suite load on the 1-core box, agent restart +
-    # resubmit + transfer can take minutes
+
+    # staged deadlines so a failure names the wedged milestone instead
+    # of one opaque get() timeout (this test is load-sensitive in the
+    # full suite; see repo memory round5-summary)
+    def milestone(pred, what, timeout):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.25)
+        raise AssertionError(
+            f"milestone {what!r} not reached in {timeout}s; "
+            f"nodes={[(n['node_id'], n['alive']) for n in rt.controller.list_nodes()]} "
+            f"infeasible={len(rt.cluster._infeasible)} "
+            f"locations={rt.controller.locations(ref.object_id)} "
+            f"local={rt.store.contains(ref.object_id)}")
+
+    def fresh_copy() -> bool:
+        """Object available somewhere OTHER than the killed agent."""
+        if rt.store.contains(ref.object_id):
+            return True
+        for nid in rt.controller.locations(ref.object_id):
+            rec = rt.cluster.get_node(nid)
+            if nid != a1_node and rec is not None and rec.alive:
+                return True
+        return False
+
+    # a2 registers as a THIRD known node (a1 stays in the table as dead
+    # once detected — a stale-alive a1 cannot satisfy this count)
+    milestone(lambda: len(rt.controller.list_nodes()) >= 3,
+              "replacement agent registered", 120)
+    milestone(fresh_copy,
+              "object re-produced via lineage resubmit", 240)
     arr = ray_tpu.get(ref, timeout=300)
     assert arr[0] == 7.0 and arr.shape == (200_000,)
 

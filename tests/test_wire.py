@@ -167,3 +167,81 @@ def test_same_version_connection_works():
     assert rep["ok"] and rep["echo"] == 41
     conn.close()
     lsock.close()
+
+
+def test_python_plane_fast_pickle_and_fallback():
+    """Plain-pickle fast path for importable object graphs; __main__ /
+    <locals> classes and lambdas trip the tripwire and fall back to
+    cloudpickle — never by-reference bytes the peer cannot load."""
+    from ray_tpu._private.specs import TaskSpec
+
+    spec = TaskSpec(task_id="t1", func_id="f" * 16,
+                    args=(1, 2.5, "x", b"b"), kwargs={"k": [1, 2]},
+                    return_ids=["t1r0"], resources={"CPU": 1.0})
+    out = wire.loads(wire.dumps({"type": "task", "rid": 3,
+                                 "spec": spec}))
+    assert out["spec"].args == (1, 2.5, "x", b"b")
+
+    class Mainish:
+        def __init__(self, v):
+            self.v = v
+    Mainish.__module__ = "__main__"     # simulate a driver-script class
+
+    def maker():
+        class Local:
+            pass
+        return Local
+
+    msg = {"type": "reply", "rid": 9,
+           "value": [lambda x: x + 1, Mainish(7), maker()()]}
+    out = wire.loads(wire.dumps(msg))
+    assert out["value"][0](1) == 2
+    assert out["value"][1].v == 7
+    assert type(out["value"][2]).__name__ == "Local"
+
+
+def test_tripwire_catches_by_reference_main_objects():
+    """The dangerous case: objects plain pickle would serialize
+    'successfully' BY REFERENCE into this process's __main__ — a class
+    genuinely reachable as __main__.<name>, and a global-name-pickled
+    non-callable (TypeVar). The tripwire must force by-value
+    cloudpickle bytes, proven by decoding in a SUBPROCESS whose
+    __main__ has no such names."""
+    import subprocess
+    import sys
+    import typing
+
+    main = sys.modules["__main__"]
+
+    class TopLevelWireTest:
+        def __init__(self, v):
+            self.v = v
+
+    TopLevelWireTest.__module__ = "__main__"
+    TopLevelWireTest.__qualname__ = "TopLevelWireTest"
+    setattr(main, "TopLevelWireTest", TopLevelWireTest)
+    tv = typing.TypeVar("WireTestTV")
+    tv.__module__ = "__main__"
+    setattr(main, "WireTestTV", tv)
+    try:
+        # sanity: plain pickle CAN save these by reference here, so
+        # only the tripwire routes them to cloudpickle
+        import pickle as _p
+        _p.dumps(getattr(main, "TopLevelWireTest"))
+        blob = wire.dumps({"type": "reply", "rid": 1,
+                           "value": [TopLevelWireTest(9), tv]})
+        script = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from ray_tpu._private import wire\n"
+            "msg = wire.loads(sys.stdin.buffer.read())\n"
+            "inst, t = msg['value']\n"
+            "assert inst.v == 9, inst\n"
+            "assert t.__name__ == 'WireTestTV', t\n"
+            "print('DECODED-OK')\n" % (str(__import__('os').getcwd()),))
+        out = subprocess.run([sys.executable, "-c", script],
+                             input=blob, capture_output=True,
+                             timeout=120)
+        assert b"DECODED-OK" in out.stdout, out.stderr.decode()[-1500:]
+    finally:
+        delattr(main, "TopLevelWireTest")
+        delattr(main, "WireTestTV")

@@ -6,6 +6,12 @@ Two applications — a composed greeting pipeline and a standalone
 shouter — deploy with their own route prefixes; HTTP traffic routes by
 longest prefix; deleting one app leaves the other serving.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo-root import without install
+
 import json
 import urllib.request
 
@@ -35,7 +41,8 @@ def main():
 
     serve.run(Greeter.bind("Hello", Upper.bind()), name="greet",
               route_prefix="/api/greet")
-    serve.run(Upper.options(name="solo").bind(), name="shout")
+    # run(name=...) names the app AND its ingress deployment
+    serve.run(Upper.bind(), name="shout")
 
     print("applications:", json.dumps(serve.status_applications(),
                                       indent=1, default=str))
@@ -51,6 +58,15 @@ def main():
 
     serve.delete("greet")               # whole app graph goes away
     print("after delete:", sorted(serve.status()))
+    # the OTHER app keeps serving — the docstring's central claim
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/shout",
+        data=json.dumps("still here").encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        survivor = json.loads(resp.read())["result"]
+    print("/shout after delete ->", survivor)
+    assert survivor == "STILL HERE"
     serve.stop_http()
     serve.shutdown()
     ray_tpu.shutdown()

@@ -12,6 +12,7 @@ Numbers are for THIS host (the CI box is 1 CPU core; worker spawns are
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -26,10 +27,62 @@ def timed(fn, n: int, *, unit: str = "ops") -> dict:
             "per_second": round(n / dt, 1), "unit": unit}
 
 
-def main(as_json: bool = False) -> dict:
+def _frame_stats(s0: dict, n_tasks: int) -> dict:
+    """Head-process socket-frame deltas since snapshot `s0` (a copy of
+    protocol.WIRE_STATS), per completed task — the per-event syscall
+    cost the frame coalescing attacks."""
+    from ray_tpu._private import protocol
+    d = {k: protocol.WIRE_STATS[k] - s0[k] for k in s0}
+    frames = d["tx_frames"] + d["rx_frames"]
+    return {"head_frames": frames,
+            "head_msgs": d["tx_msgs"] + d["rx_msgs"],
+            "frames_per_task": round(frames / n_tasks, 2)}
+
+
+def _drain_with_frames(n_tasks: int) -> dict:
+    """Fresh runtime under the CURRENT env: drain n nop tasks and
+    report frames per completed task."""
     import ray_tpu
-    ray_tpu.init(num_cpus=4)
+    from ray_tpu._private import protocol
+    from ray_tpu._private.config import CONFIG
+    CONFIG.reload()
+    rt = ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    for _ in range(3):
+        ray_tpu.get([nop.remote() for _ in range(30)])       # warm pool
+    s0 = dict(protocol.WIRE_STATS)
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(n_tasks)]
+    ray_tpu.get(refs, timeout=300)
+    dt = time.perf_counter() - t0
+    stats = _frame_stats(s0, n_tasks)
+    ray_tpu.shutdown()
+    return {"n": n_tasks, "seconds": round(dt, 4),
+            "per_second": round(n_tasks / dt, 1), "unit": "tasks",
+            **stats}
+
+
+def main(as_json: bool = False) -> dict:
     results: dict = {}
+
+    # ------------------- control-frame coalescing: off vs on (r6)
+    # The OFF run goes first in its own runtime (workers inherit the
+    # env at spawn); the ON run is the normal 5k-drain below, which
+    # records the same frames-per-task counters for comparison.
+    os.environ["RAY_TPU_WIRE_BATCH"] = "0"
+    try:
+        results["drain_2k_unbatched"] = _drain_with_frames(2000)
+    finally:
+        os.environ.pop("RAY_TPU_WIRE_BATCH", None)
+
+    import ray_tpu
+    from ray_tpu._private.config import CONFIG as _CFG
+    _CFG.reload()
+    ray_tpu.init(num_cpus=4)
 
     # -------------------------------------------------- tasks / second
     @ray_tpu.remote
@@ -76,6 +129,45 @@ def main(as_json: bool = False) -> dict:
     results["get_gbps"] = {"n": M, "seconds": round(dt, 4),
                            "per_second": round(M * 8 / 1024 / dt, 3),
                            "unit": "GB"}
+
+    # ---------------- shm segment churn: pooled vs unpooled (r6)
+    # The large-object producer/consumer hot cycle in isolation:
+    # serialize (segment create + 8 MB copy) then free. Pooled, the
+    # freed segment is renamed into the size-class pool and the next
+    # cycle reuses its already-faulted pages; unpooled, every cycle
+    # pays shm_open/ftruncate plus kernel page zeroing + soft faults.
+    from ray_tpu._private import object_store as _osm
+    CY = 30
+
+    def _cycle(release_fn) -> float:
+        t0 = time.perf_counter()
+        for _ in range(CY):
+            obj = _osm.serialize(big)
+            for name in obj.shm_names:
+                release_fn(name)
+        return time.perf_counter() - t0
+
+    _cycle(_osm.free_segment)                       # warm the pool
+    dt_pooled = _cycle(_osm.free_segment)
+    reused = _osm.SEGMENT_POOL.reused
+    os.environ["RAY_TPU_SHM_POOL"] = "0"
+    from ray_tpu._private.config import CONFIG as _CFG2
+    _CFG2.reload()
+    try:
+        dt_unpooled = _cycle(_osm.unlink_segment)
+    finally:
+        os.environ.pop("RAY_TPU_SHM_POOL", None)
+        _CFG2.reload()
+    _osm.SEGMENT_POOL.clear()
+    results["shm_cycle_pooled_gbps"] = {
+        "n": CY, "seconds": round(dt_pooled, 4),
+        "per_second": round(CY * 8 / 1024 / dt_pooled, 3),
+        "unit": "GB", "segments_reused": reused}
+    results["shm_cycle_unpooled_gbps"] = {
+        "n": CY, "seconds": round(dt_unpooled, 4),
+        "per_second": round(CY * 8 / 1024 / dt_unpooled, 3),
+        "unit": "GB",
+        "pool_speedup": round(dt_unpooled / dt_pooled, 2)}
 
     # -------------------------------------------------- wait semantics
     K = 1000
@@ -197,7 +289,9 @@ def main(as_json: bool = False) -> dict:
     # throughput, not worker-spawn latency after the actor kills above
     for _ in range(3):
         ray_tpu.get([nop.remote() for _ in range(30)])
+    from ray_tpu._private import protocol as _protocol
     K = 5000
+    s0 = dict(_protocol.WIRE_STATS)
     t0 = time.perf_counter()
     refs = [nop.remote() for _ in range(K)]
     dt_submit = time.perf_counter() - t0
@@ -206,7 +300,8 @@ def main(as_json: bool = False) -> dict:
     results["queue_5k_tasks"] = {
         "n": K, "seconds": round(dt_total, 4),
         "submit_per_second": round(K / dt_submit, 1),
-        "per_second": round(K / dt_total, 1), "unit": "tasks"}
+        "per_second": round(K / dt_total, 1), "unit": "tasks",
+        **_frame_stats(s0, K)}
 
     # ----------------------------- 100k queued: O(1) submit check
     # Submission cost must not grow with backlog depth (reference

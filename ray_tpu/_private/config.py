@@ -118,12 +118,45 @@ _define("memory_monitor_threshold", 0.95,
         "disables the monitor.")
 _define("memory_monitor_refresh_s", 1.0,
         "Memory monitor poll period.")
-_define("worker_pipeline_depth", 2,
+_define("worker_pipeline_depth", 4,
         "Tasks dispatched to one worker before its previous task "
-        "completes (the worker executes FIFO). Depth 2 overlaps the "
+        "completes (the worker executes FIFO). Depth >1 overlaps the "
         "completion round-trip with execution — the reference's "
-        "worker-lease pipelining — roughly doubling small-task drain "
-        "throughput. 1 restores strict one-at-a-time dispatch.")
+        "worker-lease pipelining. Under saturation, queued tasks ride "
+        "the worker's existing resource grant (charged on predecessor "
+        "completion), so depth also sets how many TASK/TASK_DONE "
+        "frames coalesce per wire write. Blocked workers steal back "
+        "their queued tail, so deadlock-safety is depth-independent. "
+        "1 restores strict one-at-a-time dispatch.")
+_define("wire_batch", True,
+        "Micro-batch fire-and-forget control frames (TASK_DONE, decref "
+        "floods, multi-spec dispatch) into coalesced writes — one "
+        "BatchFrame envelope when the peer negotiated wire MINOR >= 1, "
+        "else concatenated single frames in one syscall. 0 restores "
+        "strict one-frame-per-send behavior.")
+_define("wire_batch_max_frames", 64,
+        "Coalescing queue flushes when this many frames are pending "
+        "(also the per-frame cap of a DECREF_BATCH, clamped there to "
+        "64 so its id list stays within the wire's structural-"
+        "encoding bound).")
+_define("wire_batch_delay_ms", 1.0,
+        "Coalescing window (collect-then-flush): the first lazy frame "
+        "opens a window of this width and every frame emitted inside "
+        "it rides the same write, so any lazy frame waits at most "
+        "~this long plus the flusher thread-wake latency. Reply-"
+        "bearing and other eager sends bypass the queue entirely (and "
+        "flush it first, preserving per-connection FIFO order).")
+_define("shm_pool", True,
+        "Reuse freed shm segments for subsequent large-object puts via "
+        "a size-classed free pool (segments are renamed, not "
+        "unlinked, while pooled) — skips the shm_open/ftruncate/page-"
+        "zeroing cost on the large-object hot path. 0 restores "
+        "unlink-on-free.")
+_define("shm_pool_max_bytes", 256 * 1024 * 1024,
+        "Total bytes the shm segment pool may hold; overflow falls "
+        "back to the normal unlink-by-name path.")
+_define("shm_pool_per_class", 4,
+        "Segments kept per power-of-two size class in the shm pool.")
 _define("node_rejoin_grace_s", 20.0,
         "After a head restart, how long rehydrated nodes have to "
         "re-register before they are declared dead and their actors/"

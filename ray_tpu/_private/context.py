@@ -32,6 +32,12 @@ class BaseContext:
     def addref(self, object_id: str) -> None: pass
     def decref(self, object_id: str) -> None: pass
 
+    def decref_batch(self, object_ids: list[str]) -> None:
+        """Release N refs at once. Contexts with a wire hop override
+        this with a single DECREF_BATCH frame; the default just loops."""
+        for oid in object_ids:
+            self.decref(oid)
+
     # task plane
     def submit_task(self, spec) -> list[str]: raise NotImplementedError
     def create_actor(self, spec) -> str: raise NotImplementedError
@@ -67,6 +73,11 @@ def set_ctx(ctx: Optional[BaseContext]) -> None:
         _ctx_epoch += 1
         ctx.ctx_epoch = _ctx_epoch
     _ctx = ctx
+    if ctx is not None:
+        # decrefs deferred while no context was installed (shutdown /
+        # re-init gap) drain now instead of leaking the owner count
+        from ray_tpu._private import refs as _refs
+        _refs._flush_wake.set()
 
 
 def get_ctx() -> BaseContext:

@@ -482,7 +482,8 @@ class NodeAgent:
                        protocol.SUBMIT_ACTOR, protocol.SUBMIT_ACTOR_TASK,
                        protocol.KV_OP, protocol.STATE_OP):
             self._relay_to_head(conn, msg)
-        elif mtype in (protocol.DECREF, protocol.ADDREF):
+        elif mtype in (protocol.DECREF, protocol.ADDREF,
+                       protocol.DECREF_BATCH):
             self._send_to_head(dict(msg))
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)

@@ -17,14 +17,27 @@ and the plasma shm arena, reference src/ray/object_manager/plasma/) with:
 
 Lifetime design: a segment exists *by name* in the kernel from creation
 until ``shm_unlink``; no process needs to hold a handle to keep it alive.
-Creators therefore write, then immediately close + unregister from the
-resource tracker. Readers map via raw ``mmap`` (not SharedMemory, which
-would leak an fd per attach); the mapping is freed automatically when the
-last deserialized array view is garbage collected. Unlink-while-mapped is
-safe POSIX: existing mappings survive, the name disappears.
+Creators therefore write, then immediately close the fd. Readers map via
+raw ``mmap`` (not SharedMemory, which would leak an fd per attach); the
+mapping is freed automatically when the last deserialized array view is
+garbage collected. Unlink-while-mapped is safe POSIX: existing mappings
+survive, the name disappears.
+
+Segment pooling (``_SegmentPool``): refcount-zero releases feed a
+bounded size-classed free pool (segments renamed, not unlinked) that
+the next compatible ``put`` reuses, eliminating the per-put
+create/zero-fill/fault/unlink churn on the large-object path;
+``RAY_TPU_SHM_POOL=0`` restores strict unlink-on-free. Reuse is only
+sound because nothing can still be mapping a pooled segment's pages:
+deserialized views hold a borrow on their object until collected
+(``_pin_mapped_object``), so the refcount cannot hit zero under them;
+transient copiers (pull serving) mark their names via
+``guard_segments``; and every release site that can run with live
+refs (spill, stale re-put) keeps the mapping-safe plain unlink.
 """
 from __future__ import annotations
 
+import collections
 import mmap
 import os
 import pickle
@@ -32,8 +45,8 @@ import sys
 import threading
 import time
 import uuid
+import weakref
 from dataclasses import dataclass, field
-from multiprocessing import resource_tracker, shared_memory
 from typing import Any, Optional
 
 import _posixshmem  # CPython's shm syscall wrapper (used by SharedMemory)
@@ -56,8 +69,8 @@ def _local_tag() -> str:
 
 
 def new_object_id() -> str:
-    from ray_tpu._private.specs import SESSION_TAG
-    return SESSION_TAG + uuid.uuid4().hex[:14]
+    from ray_tpu._private.specs import SESSION_TAG, rand_hex
+    return SESSION_TAG + rand_hex(14)
 
 
 @dataclass
@@ -74,33 +87,221 @@ class StoredObject:
     # a count on each until this object is deleted (nested-ref ownership,
     # reference reference_count.cc)
     contained_ids: list[str] = field(default_factory=list)
+    # kernel bytes actually backing each shm segment (pool class-
+    # rounding makes this larger than shm_sizes): what capacity/spill
+    # ledgers must charge, while shm_sizes stays the mmap data length.
+    # Empty for pre-pool producers -> nbytes falls back to shm_sizes.
+    shm_alloc_sizes: list[int] = field(default_factory=list)
 
     @property
     def nbytes(self) -> int:
+        # getattr: a StoredObject pickled by a pre-pool peer restores
+        # without the field (pickle bypasses __init__)
+        alloc = getattr(self, "shm_alloc_sizes", None)
         return (len(self.payload) + sum(len(b) for b in self.inline_buffers)
-                + sum(self.shm_sizes))
+                + sum(alloc or self.shm_sizes))
 
 
-def _create_segment(name: str, data: memoryview) -> None:
-    """Create + fill a named segment, then release all process-local
-    resources; the segment persists by name until shm_unlink."""
+class _SegmentPool:
+    """Size-classed free pool of shm segments (reference plasma keeps
+    its arena mapped for the same reason: creating + faulting fresh
+    kernel pages per large put dominates the copy itself).
+
+    A freed segment is RENAMED (atomic on the /dev/shm tmpfs) into a
+    bounded per-class free list instead of unlinked; the next put of a
+    compatible size renames it back to its object name and overwrites
+    it — skipping shm_open(O_CREAT)/ftruncate and, far more
+    importantly, the page-zeroing + soft-fault cost of first touch.
+    Pool names carry the session tag (``rtpu_<tag>_pool...``), so the
+    existing unlink-by-name lifetime rules still apply: pool overflow
+    falls back to a plain unlink, and the session shutdown sweep reaps
+    anything still pooled. Per-process: the driver (which frees most
+    result segments) feeds its own next puts."""
+
+    MIN_CLASS = 17          # 128 KiB: below that, buffers ride inline
+
+    def __init__(self):
+        self._classes: dict[int, "collections.deque[str]"] = {}
+        self._lock = threading.Lock()
+        self._bytes = 0
+        self.reused = 0     # introspection / bench counters
+        self.pooled = 0
+
+    @staticmethod
+    def _cls(nbytes: int) -> int:
+        return max((nbytes - 1).bit_length(), _SegmentPool.MIN_CLASS)
+
+    @staticmethod
+    def class_size(nbytes: int) -> int:
+        return 1 << _SegmentPool._cls(nbytes)
+
+    def _enabled(self) -> bool:
+        return _CFG.shm_pool and os.path.isdir("/dev/shm")
+
+    def acquire(self, name: str, data_len: int) -> bool:
+        """Rename a pooled segment of the right class to `name`.
+        False when the pool has nothing compatible (caller creates)."""
+        if not self._enabled():
+            return False
+        cls = self._cls(data_len)
+        with self._lock:
+            free = self._classes.get(cls)
+            if not free:
+                return False
+            pooled_name = free.popleft()
+            self._bytes -= 1 << cls
+        try:
+            os.rename("/dev/shm/" + pooled_name, "/dev/shm/" + name)
+        except OSError:
+            # pooled entry vanished (external sweep): just miss
+            return False
+        self.reused += 1
+        return True
+
+    def release(self, name: str) -> bool:
+        """Take ownership of a freed segment: rename it into the pool.
+        False -> not pooled (wrong shape / over budget / disabled /
+        mid-copy in this process); the caller must unlink it as
+        before."""
+        if not self._enabled():
+            return False
+        path = "/dev/shm/" + name
+        try:
+            size = os.stat(path).st_size
+        except OSError:
+            return False
+        # only class-shaped segments are reusable (pre-pool segments
+        # have exact data sizes; renaming those would strand capacity)
+        if size < (1 << self.MIN_CLASS) or size & (size - 1):
+            return False
+        cls = size.bit_length() - 1
+        with self._lock:
+            # a transient copier (pull serve, guard_segments) is mid-
+            # map: renaming + reusing would overwrite the pages under
+            # its copy — fall back to the unlink path, which existing
+            # mappings survive. The guard registers and the rename
+            # happens under the same lock, so there is no window where
+            # a fresh guard can race an in-flight rename.
+            if name in _guarded_segments:
+                return False
+            free = self._classes.setdefault(cls, collections.deque())
+            if (len(free) >= _CFG.shm_pool_per_class
+                    or self._bytes + size > _CFG.shm_pool_max_bytes):
+                return False
+            pooled_name = (f"rtpu_{_local_tag()}_pool{cls:02d}_"
+                           f"{uuid.uuid4().hex[:8]}")
+            try:
+                os.rename(path, "/dev/shm/" + pooled_name)
+            except OSError:
+                return False
+            free.append(pooled_name)
+            self._bytes += size
+        self.pooled += 1
+        return True
+
+    def clear(self) -> int:
+        """Unlink everything pooled (store shutdown)."""
+        with self._lock:
+            names = [n for free in self._classes.values() for n in free]
+            self._classes.clear()
+            self._bytes = 0
+        for name in names:
+            unlink_segment(name)
+        return len(names)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"pool_bytes": self._bytes,
+                    "pool_segments": sum(len(f) for f in
+                                         self._classes.values()),
+                    "pool_reused": self.reused,
+                    "pool_released": self.pooled}
+
+
+SEGMENT_POOL = _SegmentPool()
+
+# Segment names a transient copier in THIS process is currently
+# mapping (pull-serve materialize): free_segment must not pool these —
+# reuse would overwrite the pages mid-copy, where plain unlink is
+# harmless. Guarded by SEGMENT_POOL._lock (see release()).
+_guarded_segments: collections.Counter = collections.Counter()
+
+
+class guard_segments:
+    """Context manager marking `names` as mapped-for-copy so a
+    concurrent refcount-zero free in this process unlinks instead of
+    pooling them (preserving the pages under the copy)."""
+
+    def __init__(self, names):
+        self._names = list(names)
+
+    def __enter__(self):
+        with SEGMENT_POOL._lock:
+            _guarded_segments.update(self._names)
+        return self
+
+    def __exit__(self, *exc):
+        with SEGMENT_POOL._lock:
+            _guarded_segments.subtract(self._names)
+            for n in self._names:
+                if _guarded_segments[n] <= 0:
+                    del _guarded_segments[n]
+        return False
+
+
+def free_segment(name: str) -> None:
+    """Refcount-zero release path: pool the segment for reuse when
+    possible, else unlink-by-name exactly as before. Only safe for
+    segments with no established mappings — i.e. the refcount-zero
+    delete path, where the deserialize-time borrow pin
+    (_pin_mapped_object) guarantees no live views remain; every other
+    release site (spill, stale re-put) must keep unlink_segment."""
+    if not SEGMENT_POOL.release(name):
+        unlink_segment(name)
+
+
+def _create_segment(name: str, data: memoryview) -> int:
+    """Create (or reuse from the pool) + fill a named segment, then
+    release all process-local resources; the segment persists by name
+    until shm_unlink. Fresh segments are rounded up to the pool's size
+    class so they are poolable when freed (readers map only the data
+    length; mapping a prefix of the file is fine). Returns the
+    allocated kernel size — the class-rounded figure capacity ledgers
+    must charge (a reused segment's already-touched pages can span its
+    whole class regardless of this object's data length)."""
+    n = len(data)
+    size = SEGMENT_POOL.class_size(n) if SEGMENT_POOL._enabled() else n
+    if SEGMENT_POOL.acquire(name, n):
+        try:
+            fd = _posixshmem.shm_open("/" + name, os.O_RDWR, mode=0o600)
+            try:
+                mm = mmap.mmap(fd, n)
+            finally:
+                os.close(fd)
+            mm[:n] = data
+            mm.close()
+            return size
+        except (OSError, ValueError):
+            # reused segment unusable after all: fall through to create
+            unlink_segment(name)
+    flags = os.O_CREAT | os.O_EXCL | os.O_RDWR
     try:
-        shm = shared_memory.SharedMemory(name=name, create=True,
-                                         size=len(data))
+        fd = _posixshmem.shm_open("/" + name, flags, mode=0o600)
     except FileExistsError:
         # Stale segment from a killed process re-running the same task
         # (lineage resubmission re-uses the object id, and same-host
         # node agents share /dev/shm). The name encodes the producing
         # task, so reclaiming is safe.
         unlink_segment(name)
-        shm = shared_memory.SharedMemory(name=name, create=True,
-                                         size=len(data))
-    shm.buf[:len(data)] = data
+        fd = _posixshmem.shm_open("/" + name, flags, mode=0o600)
     try:
-        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
-    except Exception:
-        pass
-    shm.close()
+        os.ftruncate(fd, size)
+        mm = mmap.mmap(fd, n)
+    finally:
+        os.close(fd)
+    mm[:n] = data
+    mm.close()
+    return size
 
 
 def _map_segment(name: str, size: int) -> memoryview:
@@ -178,15 +379,22 @@ def serialize(value: Any, object_id: Optional[str] = None,
     object_id = object_id or new_object_id()
     raw_buffers: list[pickle.PickleBuffer] = []
     from ray_tpu._private.refs import _capture
+    # Save/restore the enclosing capture list instead of resetting to
+    # None: a nested serialize (user __reduce__ calling put() mid-
+    # pickle) must not stop the OUTER object's later refs from
+    # registering as contained — they would be deletable while still
+    # referenced.
+    prev_ids = getattr(_capture, "ids", None)
     _capture.ids = contained = []
     try:
         payload = cloudpickle.dumps(value, protocol=5,
                                     buffer_callback=raw_buffers.append)
     finally:
-        _capture.ids = None
+        _capture.ids = prev_ids
     inline: list[bytes] = []
     shm_names: list[str] = []
     shm_sizes: list[int] = []
+    shm_alloc: list[int] = []
     order: list[str] = []
     for i, pb in enumerate(raw_buffers):
         mv = pb.raw()
@@ -195,26 +403,66 @@ def serialize(value: Any, object_id: Optional[str] = None,
             order.append("i")
         else:
             name = f"rtpu_{_local_tag()}_{object_id}_{i}"
-            _create_segment(name, mv)
+            shm_alloc.append(_create_segment(name, mv))
             shm_names.append(name)
             shm_sizes.append(len(mv))
             order.append("s")
     is_error = isinstance(value, BaseException)
     return StoredObject(object_id, payload, inline, shm_names, shm_sizes,
-                        order, is_error, contained_ids=contained)
+                        order, is_error, contained_ids=contained,
+                        shm_alloc_sizes=shm_alloc)
+
+
+def _pin_mapped_object(object_id: str, mms: list) -> None:
+    """Hold a borrow on `object_id` while any of the given mmaps is
+    alive. Unlink-by-name made freeing at refcount zero safe for
+    already-established mappings (the pages survived); pooled reuse
+    does not — the next put OVERWRITES them. So a deserialized view
+    must keep the refcount above zero until it is collected: addref
+    now, deferred decref when the last mmap dies (same discipline as
+    ObjectRef.__del__ — never decref synchronously from a finalizer)."""
+    if not SEGMENT_POOL._enabled():
+        return                      # unlink-on-free: seed semantics
+    from ray_tpu._private import context as _context
+    from ray_tpu._private import refs as _refs
+    ctx = _context.maybe_ctx()
+    if ctx is None:
+        return
+    try:
+        ctx.addref(object_id)
+    except Exception:
+        return
+    tokens: "collections.deque[int]" = collections.deque(range(len(mms)))
+
+    def _release(_tokens=tokens, _oid=object_id):
+        _tokens.popleft()           # deque ops are GC-reentrancy-safe
+        if not _tokens:
+            _refs._deferred.append(_oid)
+            _refs._flush_wake.set()
+            _refs._ensure_flusher()
+
+    for mm in mms:
+        weakref.finalize(mm, _release)
 
 
 def deserialize(obj: StoredObject) -> Any:
     """Reconstruct the value. shm-backed buffers become zero-copy views
-    whose underlying mappings are freed when the views are collected."""
+    whose underlying mappings are freed when the views are collected;
+    while any view lives, the object is pinned (see
+    _pin_mapped_object) so the segment pool cannot reuse its pages."""
     buffers: list[Any] = []
+    mms: list[Any] = []
     ii = si = 0
     for kind in obj.buffer_order:
         if kind == "i":
             buffers.append(obj.inline_buffers[ii]); ii += 1
         else:
-            buffers.append(_map_segment(obj.shm_names[si],
-                                        obj.shm_sizes[si])); si += 1
+            mv = _map_segment(obj.shm_names[si], obj.shm_sizes[si])
+            buffers.append(mv)
+            mms.append(mv.obj)      # the underlying mmap
+            si += 1
+    if mms:
+        _pin_mapped_object(obj.object_id, mms)
     return pickle.loads(obj.payload, buffers=buffers)
 
 
@@ -293,6 +541,9 @@ class LocalStore:
             victims = self._pick_victims_locked()
             self._cv.notify_all()
         for name in stale:
+            # NOT free_segment: the replaced incarnation may still be
+            # mapped by readers (the id is live — this is a re-put);
+            # unlink keeps their pages intact, pooling would not
             unlink_segment(name)
         self._write_spills(victims)
         # Seal BEFORE any backpressure wait: consumers blocked on this
@@ -423,6 +674,10 @@ class LocalStore:
                              "contained": obj.contained_ids}, f,
                             protocol=pickle.HIGHEST_PROTOCOL)
             for name in obj.shm_names:
+                # NOT free_segment: spill victims usually have live
+                # refs, so readers may hold mapped views of these
+                # segments; unlink preserves their pages, pooled reuse
+                # would overwrite them
                 unlink_segment(name)
             with self._cv:
                 self._spilling.discard(oid)
@@ -582,7 +837,7 @@ class LocalStore:
                 self._restore_cancelled.add(object_id)
         if obj is not None:
             for name in obj.shm_names:
-                unlink_segment(name)
+                free_segment(name)
         if rec is not None:
             try:
                 os.unlink(rec.path)
@@ -591,7 +846,7 @@ class LocalStore:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "num_objects": len(self._objects) + len(self._spilled),
                 "bytes": self._bytes,
                 "num_spilled": len(self._spilled),
@@ -601,12 +856,17 @@ class LocalStore:
                 "restored_bytes_total": self._restored_bytes_total,
                 "capacity_bytes": self.capacity_bytes,
             }
+        out.update(SEGMENT_POOL.stats())
+        return out
 
     def shutdown(self) -> None:
         with self._lock:
             ids = list(self._objects) + list(self._spilled)
         for oid in ids:
             self.delete(oid)
+        # deletes above may have fed the pool; the session is over, so
+        # reap it (the tag-prefixed sweep would catch stragglers too)
+        SEGMENT_POOL.clear()
         try:
             os.rmdir(self._spill_dir)
         except OSError:

@@ -24,7 +24,8 @@ import uuid
 from typing import Optional
 
 from ray_tpu._private import protocol
-from ray_tpu._private.object_store import StoredObject, _map_segment
+from ray_tpu._private.object_store import (StoredObject, _map_segment,
+                                           guard_segments)
 
 CHUNK_BYTES = 4 * 1024 * 1024
 _SESSION_TTL_S = 120.0
@@ -38,15 +39,18 @@ def materialize(obj: StoredObject) -> StoredObject:
     inline: list[bytes] = []
     ii = si = 0
     order: list[str] = []
-    for kind in obj.buffer_order:
-        if kind == "i":
-            inline.append(obj.inline_buffers[ii]); ii += 1
-        else:
-            mv = _map_segment(obj.shm_names[si], obj.shm_sizes[si])
-            inline.append(mv.tobytes())
-            del mv
-            si += 1
-        order.append("i")
+    # guard: a concurrent refcount-zero free in this process must
+    # unlink (mapping-safe), not pool-and-reuse, while we copy
+    with guard_segments(obj.shm_names):
+        for kind in obj.buffer_order:
+            if kind == "i":
+                inline.append(obj.inline_buffers[ii]); ii += 1
+            else:
+                mv = _map_segment(obj.shm_names[si], obj.shm_sizes[si])
+                inline.append(mv.tobytes())
+                del mv
+                si += 1
+            order.append("i")
     return StoredObject(obj.object_id, obj.payload, inline, [], [],
                         order, obj.is_error,
                         contained_ids=list(obj.contained_ids))

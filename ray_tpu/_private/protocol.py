@@ -25,11 +25,20 @@ import itertools
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Optional
 
-from ray_tpu._private.wire import WireVersionError, dumps, loads
+from ray_tpu._private.wire import (BATCH_MIN_MINOR, BATCH_TYPE, WIRE_MAJOR,
+                                   WireVersionError, dumps, dumps_batch,
+                                   loads_ex)
 
 _LEN = struct.Struct("<Q")
+
+# Process-wide frame accounting (this process's connections only):
+# physical socket frames vs logical messages, both directions. Read by
+# bench_core.py to report control frames per completed task; plain int
+# increments under the GIL are accurate enough for benchmarking.
+WIRE_STATS = {"tx_frames": 0, "tx_msgs": 0, "rx_frames": 0, "rx_msgs": 0}
 
 # Message types (flat namespace; direction noted).
 REGISTER = "register"            # worker -> driver
@@ -53,6 +62,8 @@ UNQUEUE_TASK = "unqueue_task"    # driver -> worker: drop a pipelined task
 PING = "ping"                    # either
 REPLY = "reply"                  # either (generic reply)
 STATE_OP = "state_op"            # worker -> driver: state/metrics queries
+DECREF_BATCH = "decref_batch"    # worker -> driver: N ref-count releases
+BATCH = BATCH_TYPE               # either: coalesced sub-frames (MINOR>=1)
 
 # ---- multi-host: node agent <-> head (reference raylet <-> GCS,
 # gcs_node_manager.h:62 HandleRegisterNode; ray_syncer.h:88 resource
@@ -123,6 +134,17 @@ class Connection:
         self._closed = threading.Event()
         self._server = server
         self.meta: dict = {}  # endpoint-attached metadata (worker id, etc.)
+        # Wire version observed on the peer's frames (0 = nothing seen
+        # yet). Batch emission is gated on it: until the peer proves it
+        # speaks MINOR >= BATCH_MIN_MINOR, coalesced flushes go out as
+        # individual frames in one sendall (compatible with any peer).
+        self.peer_wire_version = 0
+        # Opt-in coalescing queue (enable_coalescing): fire-and-forget
+        # frames park here briefly and flush as one write.
+        self._lazy: list[dict] = []
+        self._lazy_lock = threading.Lock()
+        self._lazy_wake = threading.Event()
+        self._lazy_thread: Optional[threading.Thread] = None
         self._reader = threading.Thread(
             target=self._read_loop, name=f"ray-tpu-conn-{name}", daemon=True)
 
@@ -173,18 +195,120 @@ class Connection:
 
     # ---- sending ----
     def send(self, msg: dict) -> None:
-        data = dumps(msg)
-        header = _LEN.pack(len(data))
+        """Immediate send. If a coalescing queue is pending, its frames
+        are flushed FIRST in the same write — per-connection FIFO order
+        is preserved between lazy and eager sends (the refcount
+        protocol depends on it: an ADDREF parked in the queue must
+        never be overtaken by the TASK_DONE that releases the pin).
+        The lazy-queue drain and the socket write happen under one
+        lock (_send_lock): draining outside it would let this eager
+        frame overtake frames the flusher thread has already swapped
+        out of the queue but not yet written."""
         with self._send_lock:
+            frames = self._drain_lazy()
+            frames.append(msg)
+            self._emit_locked(frames)
+
+    def send_lazy(self, msg: dict) -> None:
+        """Queue a fire-and-forget frame on the coalescing queue: it
+        flushes with its neighbors as one write after ~wire_batch
+        thresholds (count / delay), or earlier if an eager send/reply
+        follows. Falls back to send() when coalescing is off."""
+        from ray_tpu._private.config import CONFIG
+        if self._lazy_thread is None or not CONFIG.wire_batch:
+            self.send(msg)
+            return
+        with self._lazy_lock:
+            self._lazy.append(msg)
+            n = len(self._lazy)
+        if n >= CONFIG.wire_batch_max_frames:
+            self.flush()
+        else:
+            self._lazy_wake.set()
+
+    def flush(self) -> None:
+        if not self._lazy:
+            return
+        with self._send_lock:
+            frames = self._drain_lazy()
+            if frames:
+                self._emit_locked(frames)
+
+    def _drain_lazy(self) -> list[dict]:
+        """Swap the coalescing queue out. Callers hold _send_lock so
+        the drained frames cannot be overtaken by a concurrent eager
+        send before they reach the socket (lock order: _send_lock ->
+        _lazy_lock; send_lazy takes only _lazy_lock)."""
+        if not self._lazy:
+            return []
+        with self._lazy_lock:
+            frames, self._lazy = self._lazy, []
+        return frames
+
+    def enable_coalescing(self) -> None:
+        """Opt this connection's send_lazy() into micro-batched
+        flushing (hot emitters: workers, the dispatch path). Without
+        this, send_lazy() behaves exactly like send()."""
+        if self._lazy_thread is not None:
+            return
+        self._lazy_thread = threading.Thread(
+            target=self._lazy_flush_loop,
+            name=f"ray-tpu-conn-flush-{self.name}", daemon=True)
+        self._lazy_thread.start()
+
+    def _lazy_flush_loop(self) -> None:
+        from ray_tpu._private.config import CONFIG
+        delay = max(0.0, CONFIG.wire_batch_delay_ms / 1000.0)
+        while not self._closed.is_set():
+            self._lazy_wake.wait()
+            if self._closed.is_set():
+                return
+            if delay:
+                # Collect-then-flush: the first frame of a burst opens
+                # a `delay`-wide window and every frame emitted inside
+                # it rides the same write. A lazy frame therefore waits
+                # at most ~delay; anything latency-critical uses the
+                # eager send() path, which also drains this queue
+                # first, so the window never reorders or starves it.
+                time.sleep(delay)
+            self._lazy_wake.clear()
             try:
-                self._sock.sendall(header + data)
-            except OSError as e:
-                # A failed sendall may have written a PARTIAL frame
-                # (e.g. the SO_SNDTIMEO budget expired mid-write); the
-                # stream is desynced, so the connection must die — a
-                # later send would be parsed as garbage by the peer.
-                self.close()
-                raise ConnectionClosed(str(e)) from e
+                self.flush()
+            except ConnectionClosed:
+                return
+
+    def _peer_speaks_batch(self) -> bool:
+        v = self.peer_wire_version
+        return v // 100 == WIRE_MAJOR and v % 100 >= BATCH_MIN_MINOR
+
+    def _emit_locked(self, frames: list[dict]) -> None:
+        """Encode + write a group of frames as ONE socket write: a
+        single BatchFrame envelope when the peer negotiated batch
+        support, else the individual frames concatenated (one syscall
+        either way; the latter is valid toward ANY same-major peer).
+        Caller holds _send_lock."""
+        if len(frames) > 1 and self._peer_speaks_batch():
+            data = dumps_batch(frames)
+            payload = _LEN.pack(len(data)) + data
+            WIRE_STATS["tx_frames"] += 1
+        else:
+            parts = []
+            for msg in frames:
+                data = dumps(msg)
+                parts.append(_LEN.pack(len(data)))
+                parts.append(data)
+            payload = b"".join(parts)
+            WIRE_STATS["tx_frames"] += len(frames)
+        WIRE_STATS["tx_msgs"] += len(frames)
+        try:
+            self._sock.sendall(payload)
+        except OSError as e:
+            # A failed sendall may have written a PARTIAL frame
+            # (e.g. the SO_SNDTIMEO budget expired mid-write); the
+            # stream is desynced, so the connection must die — a
+            # later send would be parsed as garbage by the peer.
+            self.close()
+            raise ConnectionClosed(str(e)) from e
 
     def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
         """Send a request and block for the matching reply."""
@@ -209,6 +333,15 @@ class Connection:
         self.send({"type": REPLY, "rid": request_msg["rid"], **fields})
 
     # ---- receiving ----
+    def _dispatch(self, msg: dict) -> None:
+        if msg.get("type") == REPLY:
+            with self._pending_lock:
+                fut = self._pending.pop(msg["rid"], None)
+            if fut is not None:
+                fut.set(msg)
+        else:
+            self._handler(self, msg)
+
     def _read_exact(self, n: int) -> bytes:
         chunks = []
         remaining = n
@@ -227,14 +360,16 @@ class Connection:
             while True:
                 header = self._read_exact(_LEN.size)
                 (length,) = _LEN.unpack(header)
-                msg = loads(self._read_exact(length))
-                if msg.get("type") == REPLY:
-                    with self._pending_lock:
-                        fut = self._pending.pop(msg["rid"], None)
-                    if fut is not None:
-                        fut.set(msg)
+                msg, version = loads_ex(self._read_exact(length))
+                self.peer_wire_version = version
+                WIRE_STATS["rx_frames"] += 1
+                if msg.get("type") == BATCH:
+                    for sub in msg["frames"]:
+                        WIRE_STATS["rx_msgs"] += 1
+                        self._dispatch(sub)
                 else:
-                    self._handler(self, msg)
+                    WIRE_STATS["rx_msgs"] += 1
+                    self._dispatch(msg)
         except (ConnectionClosed, OSError):
             pass
         except WireVersionError as e:
@@ -262,6 +397,8 @@ class Connection:
         return self._closed.is_set()
 
     def close(self) -> None:
+        self._closed.set()
+        self._lazy_wake.set()       # release the coalescing flusher
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

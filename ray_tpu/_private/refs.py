@@ -62,17 +62,32 @@ def _ensure_flusher() -> None:
 
 def _flush_loop() -> None:
     while True:
-        try:
-            oid = _deferred.popleft()
-        except IndexError:
+        if not _deferred:
             _flush_wake.wait(0.2)
             _flush_wake.clear()
             continue
         ctx = _context.maybe_ctx()
         if ctx is None:
+            # No context (shutdown / re-init gap): leave the ids parked
+            # — popping here would leak the owner-side count forever.
+            # set_ctx wakes us the moment a new context installs.
+            _flush_wake.wait(0.2)
+            _flush_wake.clear()
             continue
+        # Drain in batches: one DECREF_BATCH frame instead of N DECREF
+        # frames (context impls without a wire hop just loop locally).
+        # The configured cap is clamped to 64, the wire's structural-
+        # encoding bound for language-neutral id lists.
+        from ray_tpu._private.config import CONFIG
+        cap = min(64, max(1, int(CONFIG.wire_batch_max_frames)))
+        batch: list[str] = []
+        while len(batch) < cap:
+            try:
+                batch.append(_deferred.popleft())
+            except IndexError:
+                break
         try:
-            ctx.decref(oid)
+            ctx.decref_batch(batch)
         except Exception:
             pass
 

@@ -455,6 +455,9 @@ class Runtime(_context.BaseContext):
             conn.reply(msg, value=self._kv_dispatch(msg))
         elif mtype == protocol.DECREF:
             self.decref(msg["object_id"])
+        elif mtype == protocol.DECREF_BATCH:
+            for oid in msg["object_ids"]:
+                self.decref(oid)
         elif mtype == protocol.ADDREF:
             self.controller.addref(msg["object_id"])
         elif mtype == protocol.STATE_OP:

@@ -52,8 +52,11 @@ class WorkerRec:
     # overlaps the TASK_DONE round-trip with the next task's execution
     # (reference worker-lease pipelining).
     tasks: "dict[str, TaskSpec]" = field(default_factory=dict)
-    # task_id -> (need, pg_key): per-task resource charge so completions
-    # release exactly their own share.
+    # task_id -> (need, pg_key, charged): per-task resource charge so
+    # completions release exactly their own share. charged=False marks
+    # a task pipelined onto this worker's existing grant (reference
+    # worker-lease model: a queued task reuses the lease's resources);
+    # it is charged when its predecessor completes and releases them.
     task_res: dict = field(default_factory=dict)
     actor_id: Optional[str] = None
     # actor-lifetime resources (ACTOR workers only)
@@ -331,6 +334,9 @@ class Scheduler:
                 rec.state = IDLE
                 self._spawning = max(0, self._spawning - 1)
             conn.meta["worker_id"] = worker_id
+            # the driver side of a worker connection is a hot emitter
+            # (TASK dispatch bursts): coalesce its fire-and-forget sends
+            conn.enable_coalescing()
             self._cv.notify_all()
 
     def on_worker_lost(self, worker_id: str):
@@ -360,17 +366,33 @@ class Scheduler:
                 return led["avail"]
         return self.avail
 
+    def _promote_next_charge_locked(self, rec: WorkerRec) -> None:
+        """Lease handoff: after a CHARGED entry leaves rec.task_res
+        (completion or steal-back), charge the oldest uncharged
+        successor out of the share just released — its need fits by
+        the dispatch-time chain condition. While the worker is
+        blocked, charges are parked: mark only; worker_unblocked
+        re-acquires marked entries."""
+        for tid, (need, pg_key, charged) in rec.task_res.items():
+            if not charged:
+                if rec.blocked_depth == 0:
+                    acquire(self._ledger_for_key(pg_key), need)
+                rec.task_res[tid] = (need, pg_key, True)
+                break
+
     def _release_worker_res_locked(self, rec: WorkerRec) -> None:
         if rec.acquired:
             release(self._ledger(rec), rec.acquired)
-        for need, pg_key in rec.task_res.values():
-            release(self._ledger_for_key(pg_key), need)
+        for need, pg_key, charged in rec.task_res.values():
+            if charged:
+                release(self._ledger_for_key(pg_key), need)
 
     def _acquire_worker_res_locked(self, rec: WorkerRec) -> None:
         if rec.acquired:
             acquire(self._ledger(rec), rec.acquired)
-        for need, pg_key in rec.task_res.values():
-            acquire(self._ledger_for_key(pg_key), need)
+        for need, pg_key, charged in rec.task_res.values():
+            if charged:
+                acquire(self._ledger_for_key(pg_key), need)
 
     def heartbeat_snapshot(self) -> dict:
         """Consistent copies of the ledgers a node heartbeat reports —
@@ -502,10 +524,18 @@ class Scheduler:
                 need_pg = rec.task_res.pop(task_id, None)
                 if spec is None:
                     return
-                if need_pg is not None and rec.blocked_depth == 0:
-                    # the worker unblocked between steal and reply, so
-                    # its charges were re-acquired — release this one
-                    release(self._ledger_for_key(need_pg[1]), need_pg[0])
+                if need_pg is not None and need_pg[2]:
+                    if rec.blocked_depth == 0:
+                        # the worker unblocked between steal and reply,
+                        # so its charges were re-acquired — release
+                        # this one (uncharged pipelined tasks never
+                        # held a share)
+                        release(self._ledger_for_key(need_pg[1]),
+                                need_pg[0])
+                    # a charged entry left the chain: hand its share to
+                    # the next queued task, or the rest of the pipeline
+                    # would run permanently uncharged
+                    self._promote_next_charge_locked(rec)
                 if rec.state == BUSY and not rec.tasks:
                     rec.state = IDLE
                 self._pending.appendleft(spec)
@@ -540,13 +570,28 @@ class Scheduler:
                 task_id = next(iter(rec.tasks))
             task = rec.tasks.pop(task_id, None) if task_id else None
             need_pg = rec.task_res.pop(task_id, None) if task_id else None
-            if need_pg is not None and rec.blocked_depth == 0:
-                release(self._ledger_for_key(need_pg[1]), need_pg[0])
+            if need_pg is not None and need_pg[2]:
+                if rec.blocked_depth == 0:
+                    release(self._ledger_for_key(need_pg[1]), need_pg[0])
+                self._promote_next_charge_locked(rec)
             if rec.state == BUSY and not rec.tasks:
                 rec.state = IDLE
-            # dispatch the next queued spec NOW, on the completion
-            # reader thread, instead of bouncing through the loop thread
-            if self._running and self._pending:
+            # Dispatch the next queued specs NOW, on the completion
+            # reader thread, instead of bouncing through the loop
+            # thread — but with refill hysteresis: only sweep once this
+            # worker has >= 2 free pipeline slots (or went idle), so
+            # replacements leave as multi-spec burst frames and the
+            # worker's back-to-back completions coalesce, instead of
+            # the per-completion lock-step that emits single TASK and
+            # TASK_DONE frames. Halves the sweeps per task too. The
+            # 20 Hz loop tick remains the convergence backstop.
+            # floor of 1: at depth <= 2 every completion refills (the
+            # pre-hysteresis behavior), else the last slot would only
+            # refill via the 20 Hz backstop — a round-trip bubble
+            depth = _CFG.worker_pipeline_depth
+            if (self._running and self._pending
+                    and (rec.state != BUSY
+                         or len(rec.tasks) <= max(depth - 2, 1))):
                 self._try_dispatch_locked(self._INLINE_SCAN_LIMIT)
             self._cv.notify_all()
             return task
@@ -574,19 +619,18 @@ class Scheduler:
 
     def _pick_worker(self, spec=None) -> Optional[WorkerRec]:
         """Idle worker, preferring one whose last applied runtime env
-        matches the spec's (runtime-env-keyed reuse). For normal tasks,
-        falls back to a BUSY same-env worker with pipeline headroom —
-        the worker executes FIFO, so the queued task starts the instant
-        the previous one finishes, no round-trip bubble."""
+        matches the spec's (runtime-env-keyed reuse). Pipelining onto a
+        BUSY worker is the dispatch sweep's job (_pick_piggyback): it
+        rides the worker's lease uncharged, so a worker never holds
+        more than one resource charge — keeping spare capacity visible
+        to idle/new workers instead of concentrating charges on a few
+        pipelines."""
         want = "" if spec is None else self._spec_env_hash(spec)
-        idle_only = isinstance(spec, ActorSpec)
         # container tasks can only run in a worker SPAWNED inside the
         # image (exact env-hash match); plain workers can't adopt one
         exact_only = spec is not None and has_container(
             getattr(spec, "runtime_env", None))
-        depth = _CFG.worker_pipeline_depth
         fallback = None
-        pipelined = None
         for rec in self._workers.values():
             if rec.conn is None:
                 continue
@@ -597,11 +641,56 @@ class Scheduler:
                     return rec
                 if fallback is None and not exact_only:
                     fallback = rec
-            elif (not idle_only and pipelined is None and depth > 1
-                    and rec.state == BUSY and rec.blocked_depth == 0
-                    and len(rec.tasks) < depth and rec.env_hash == want):
-                pipelined = rec
-        return fallback or pipelined
+        return fallback
+
+    def _refillable_locked(self) -> set:
+        """Workers a dispatch sweep may pipeline onto: non-BUSY, or
+        BUSY with >= 2 free pipeline slots. Snapshotted at sweep start
+        and kept for the whole sweep, so an eligible worker is topped
+        up to FULL depth in one multi-spec burst while a worker one
+        task short of full is left alone — per-completion single-frame
+        refills (which defeat wire coalescing) cannot happen."""
+        depth = _CFG.worker_pipeline_depth
+        floor = max(depth - 2, 1)
+        return {wid for wid, rec in self._workers.items()
+                if rec.state != BUSY or len(rec.tasks) <= floor}
+
+    def _pick_piggyback(self, spec, need: dict[str, float],
+                        pg_key, eligible: set) -> Optional[WorkerRec]:
+        """Saturation-path pipelining (reference worker-lease model):
+        when the free pool cannot cover `need`, a normal task may still
+        queue FIFO on a BUSY same-env worker, riding that worker's
+        existing resource grant — uncharged until the task ahead of it
+        completes and hands its share over (task_finished). Sound
+        because of the dispatch-time chain condition: the task's need
+        fits inside its immediate predecessor's on the same ledger, so
+        the predecessor's release always covers the successor's
+        acquire."""
+        if isinstance(spec, ActorSpec):
+            return None
+        if getattr(spec, "placement_group_id", None):
+            # PG tasks keep queue-or-fail semantics: pipelining one
+            # behind a bundle's occupant would dodge the pending-queue
+            # sweep that fails it fast on remove_placement_group, and
+            # its lease hand-off would straddle a bundle ledger that
+            # can be torn down mid-chain.
+            return None
+        depth = _CFG.worker_pipeline_depth
+        if depth <= 1:
+            return None
+        want = self._spec_env_hash(spec)
+        for rec in self._workers.values():
+            if (rec.conn is None or rec.state != BUSY
+                    or rec.worker_id not in eligible
+                    or rec.blocked_depth > 0 or rec.env_hash != want
+                    or len(rec.tasks) >= depth or not rec.task_res):
+                continue
+            last_need, last_pg, _ = next(reversed(rec.task_res.values()))
+            if last_pg != pg_key:
+                continue            # predecessor charges another ledger
+            if all(last_need.get(k, 0.0) >= v for k, v in need.items()):
+                return rec
+        return None
 
     def _alive_count(self) -> int:
         return sum(1 for r in self._workers.values() if r.state != DEAD)
@@ -842,14 +931,38 @@ class Scheduler:
     # thread's periodic full sweep remains the convergence backstop.
     _INLINE_SCAN_LIMIT = 64
 
+    @staticmethod
+    def _send_dispatch_outbox(outbox: list) -> None:
+        """Ship the sweep's accumulated (conn, msg) dispatches through
+        each worker connection's coalescing queue: the flusher thread
+        pays the encode+sendall (keeping it off the submitting/
+        completion-handling thread — it was ~35% of per-submit head CPU)
+        and adjacent dispatches to one worker ride ONE BatchFrame. Must
+        run BEFORE the scheduler lock is dropped: the steal-back path
+        (worker_blocked) takes the lock and sends UNQUEUE_TASK eagerly,
+        which flushes the queue first — a TASK parked here can never be
+        overtaken, but it must already BE in the queue by then."""
+        if not outbox:
+            return
+        for conn, msg in outbox:
+            try:
+                conn.send_lazy(msg)
+            except protocol.ConnectionClosed:
+                pass      # worker-death recovery requeues its tasks
+        outbox.clear()
+
     def _try_dispatch_locked(self, scan_limit: Optional[int] = None
                              ) -> bool:
         """One sweep over the queue, dispatching EVERY spec a free
         worker + resources allow (a per-dispatch rescan made draining n
         queued tasks O(n^2); reference LocalTaskManager::
         DispatchScheduledTasksToWorkers drains its queue per wake the
-        same way). `scan_limit` bounds the sweep for inline callers."""
+        same way). `scan_limit` bounds the sweep for inline callers.
+        Dispatch frames accumulate in an outbox and ship per-connection
+        at the end of the sweep (or before any mid-sweep lock drop)."""
         dispatched = 0
+        outbox: list = []
+        refillable = self._refillable_locked()
         if scan_limit is None:
             snapshot = list(self._pending)
         else:
@@ -861,13 +974,33 @@ class Scheduler:
             need = self._effective_need(spec)
             pg_key = self._bundle_for(spec)
             if getattr(spec, "placement_group_id", None) and pg_key is None:
+                self._send_dispatch_outbox(outbox)   # next call drops lock
                 self._fail_if_pg_removed(spec)
                 continue                  # bundle not (yet) on this node
             pool = (self._bundles[pg_key]["avail"] if pg_key is not None
                     else self.avail)
+            charged = True
             if not fits(pool, need):
-                continue
-            worker = self._pick_worker(spec)
+                # Saturated: the spec may still pipeline onto a BUSY
+                # worker's existing grant (uncharged until the task
+                # ahead of it completes) — reference worker-lease
+                # pipelining. This is what keeps per-worker bursts >1
+                # task deep, which the wire coalescing turns into
+                # multi-spec TASK frames and paired TASK_DONEs.
+                worker = self._pick_piggyback(spec, need, pg_key, refillable)
+                if worker is None:
+                    continue
+                charged = False
+            else:
+                worker = self._pick_worker(spec)
+                if worker is None:
+                    # no idle worker: pipeline onto a busy one rather
+                    # than stalling the sweep on a spawn round-trip;
+                    # spawning still happens below when even piggyback
+                    # has no room, growing the pool toward max_workers
+                    worker = self._pick_piggyback(spec, need, pg_key, refillable)
+                    if worker is not None:
+                        charged = False
             if worker is None:
                 blocked = sum(1 for r in self._workers.values()
                               if r.blocked_depth > 0
@@ -887,6 +1020,7 @@ class Scheduler:
                 if (pool_count - blocked < self._max_workers
                         and self._spawning < min(len(self._pending), 4)):
                     spawn_err: Optional[BaseException] = None
+                    self._send_dispatch_outbox(outbox)
                     self._cv.release()
                     try:
                         # container envs bind the worker at spawn time
@@ -926,7 +1060,8 @@ class Scheduler:
             self._pending.remove(spec)
             self._queued_at.pop(id(spec), None)
             self._demand_sub(spec)
-            acquire(pool, need)
+            if charged:
+                acquire(pool, need)
             if not worker.container:     # image-bound hash is immutable
                 worker.env_hash = self._spec_env_hash(spec)
             if isinstance(spec, ActorSpec):
@@ -935,15 +1070,18 @@ class Scheduler:
                 worker.state = ACTOR
                 worker.actor_id = spec.actor_id
                 self._rt.on_actor_dispatched(spec, worker.worker_id)
-                worker.conn.send({"type": protocol.ACTOR_CREATE,
-                                  "spec": spec})
+                outbox.append((worker.conn,
+                               {"type": protocol.ACTOR_CREATE,
+                                "spec": spec}))
             else:
                 worker.state = BUSY
                 worker.tasks[spec.task_id] = spec
-                worker.task_res[spec.task_id] = (need, pg_key)
+                worker.task_res[spec.task_id] = (need, pg_key, charged)
                 self._rt.on_task_dispatched(spec, worker.worker_id)
-                worker.conn.send({"type": protocol.TASK, "spec": spec})
+                outbox.append((worker.conn,
+                               {"type": protocol.TASK, "spec": spec}))
             dispatched += 1
+        self._send_dispatch_outbox(outbox)
         return dispatched > 0
 
     def _fail_if_pg_removed(self, spec) -> None:

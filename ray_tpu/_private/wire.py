@@ -48,8 +48,14 @@ import cloudpickle
 from ray_tpu._private import wire_pb2 as pb
 
 WIRE_MAJOR = 1
-WIRE_MINOR = 0
+WIRE_MINOR = 1          # 1: BatchFrame coalescing (negotiated by peers)
 WIRE_VERSION = WIRE_MAJOR * 100 + WIRE_MINOR
+
+# First MINOR that understands a type=="batch" Envelope carrying a
+# BatchFrame of sub-frames. Senders check the peer's observed version
+# (Connection.peer_wire_version) before emitting one.
+BATCH_MIN_MINOR = 1
+BATCH_TYPE = "batch"
 
 _MAX_ITEMS = 64      # larger lists/dicts -> one pickled leaf
 _MAX_DEPTH = 6
@@ -65,7 +71,7 @@ class WireVersionError(Exception):
 # everything a non-Python node agent / object-transfer peer needs).
 # Kept in sync with protocol.py constants; anything else rides `__py__`.
 STRUCTURAL_TYPES = frozenset({
-    "register", "ping", "decref", "addref",
+    "register", "ping", "decref", "addref", "decref_batch",
     "node_register", "node_heartbeat", "node_event",
     "node_kill_worker", "node_delete_object", "node_shutdown",
     "object_lookup", "pull_object", "pull_chunk",
@@ -163,11 +169,11 @@ def _decode_value(v: pb.Value) -> Any:
     return None                          # unset Value (future kinds)
 
 
-def dumps(msg: dict) -> bytes:
-    """Encode a message dict as a versioned Envelope frame body."""
+def _fill_envelope(env: "pb.Envelope", msg: dict) -> None:
     mtype = msg.get("type", "")
-    env = pb.Envelope(version=WIRE_VERSION, type=mtype,
-                      rid=msg.get("rid", 0))
+    env.version = WIRE_VERSION
+    env.type = mtype
+    env.rid = msg.get("rid", 0)
     if mtype in STRUCTURAL_TYPES:
         fields = env.fields
         fields.SetInParent()
@@ -180,18 +186,30 @@ def dumps(msg: dict) -> bytes:
                 if k != "type" and k != "rid"}
         if rest:
             env.py_body = _pickle(rest)
+
+
+def dumps(msg: dict) -> bytes:
+    """Encode a message dict as a versioned Envelope frame body."""
+    if msg.get("type") == BATCH_TYPE:
+        return dumps_batch(msg["frames"])
+    env = pb.Envelope()
+    _fill_envelope(env, msg)
     return env.SerializeToString()
 
 
-def loads(data: bytes) -> dict:
-    """Decode an Envelope frame body; refuses foreign major versions
-    before touching any pickled leaf."""
-    env = pb.Envelope.FromString(data)
-    if env.version // 100 != WIRE_MAJOR:
-        raise WireVersionError(
-            f"peer wire version {env.version} is incompatible with "
-            f"ours ({WIRE_VERSION}): major "
-            f"{env.version // 100} != {WIRE_MAJOR}")
+def dumps_batch(msgs: list[dict]) -> bytes:
+    """Encode N message dicts as ONE BatchFrame envelope: one frame on
+    the wire, N sub-frames delivered in order at the receiver. Only
+    valid toward a peer that negotiated batch support (MINOR >= 1)."""
+    env = pb.Envelope(version=WIRE_VERSION, type=BATCH_TYPE)
+    batch = env.batch
+    batch.SetInParent()
+    for msg in msgs:
+        _fill_envelope(batch.frames.add(), msg)
+    return env.SerializeToString()
+
+
+def _decode_envelope(env: "pb.Envelope") -> dict:
     if env.py_body:
         msg = pickle.loads(env.py_body)
     else:
@@ -201,3 +219,27 @@ def loads(data: bytes) -> dict:
     if env.rid:
         msg["rid"] = env.rid
     return msg
+
+
+def loads_ex(data: bytes) -> tuple[dict, int]:
+    """Decode an Envelope frame body -> (msg, sender wire version);
+    refuses foreign major versions before touching any pickled leaf.
+    A type=="batch" envelope decodes to
+    {"type": "batch", "frames": [msg, ...]} preserving sub-frame
+    order."""
+    env = pb.Envelope.FromString(data)
+    if env.version // 100 != WIRE_MAJOR:
+        raise WireVersionError(
+            f"peer wire version {env.version} is incompatible with "
+            f"ours ({WIRE_VERSION}): major "
+            f"{env.version // 100} != {WIRE_MAJOR}")
+    if env.type == BATCH_TYPE:
+        return ({"type": BATCH_TYPE,
+                 "frames": [_decode_envelope(sub)
+                            for sub in env.batch.frames]},
+                env.version)
+    return _decode_envelope(env), env.version
+
+
+def loads(data: bytes) -> dict:
+    return loads_ex(data)[0]

@@ -92,13 +92,28 @@ class WorkerContext(_context.BaseContext):
 
     def decref(self, object_id: str) -> None:
         try:
-            self.conn.send({"type": protocol.DECREF, "object_id": object_id})
+            self.conn.send_lazy({"type": protocol.DECREF,
+                                 "object_id": object_id})
+        except protocol.ConnectionClosed:
+            pass
+
+    def decref_batch(self, object_ids: list[str]) -> None:
+        # one frame for the whole flush batch (refs.py decref flusher)
+        if not object_ids:
+            return
+        try:
+            self.conn.send_lazy({"type": protocol.DECREF_BATCH,
+                                 "object_ids": list(object_ids)})
         except protocol.ConnectionClosed:
             pass
 
     def addref(self, object_id: str) -> None:
+        # lazy is safe: the ADDREF and any later TASK_DONE share the
+        # coalescing queue (FIFO), and eager requests flush it first —
+        # the pin-release ordering invariant holds either way
         try:
-            self.conn.send({"type": protocol.ADDREF, "object_id": object_id})
+            self.conn.send_lazy({"type": protocol.ADDREF,
+                                 "object_id": object_id})
         except protocol.ConnectionClosed:
             pass
 
@@ -264,15 +279,28 @@ class WorkerExecutor:
         threading.Thread(target=self._event_flush_loop,
                          name="rtpu-task-events", daemon=True).start()
         # pipelined-task steal-back (see UNQUEUE_TASK): tasks the driver
-        # reclaimed before they started; _run_task skips them silently
+        # reclaimed before they started; _run_task skips them silently.
+        # _queued_tasks tracks ids received but NOT yet started — the
+        # steal may only succeed against those; replying ok to a task
+        # that already ran would leave a poisoned tombstone that
+        # silently skips a lineage-resubmitted task with the same id.
         self._queue_lock = threading.Lock()
+        self._queued_tasks: set[str] = set()
         self._started_tasks: set[str] = set()
         self._unqueued_tasks: set[str] = set()
+        # tasks/actor-calls accepted but not yet completion-reported:
+        # TASK_DONE coalesces (lazy) only while OTHER work is in
+        # flight — a lone sync round-trip must not eat the ~1 ms
+        # coalescing window
+        self._inflight = 0
 
     # ---- message entry (called on reader thread) ----
     def handle(self, conn: protocol.Connection, msg: dict) -> None:
         mtype = msg["type"]
         if mtype == protocol.TASK:
+            with self._queue_lock:
+                self._queued_tasks.add(msg["spec"].task_id)
+                self._inflight += 1
             self._pool.submit(self._run_task, msg["spec"])
         elif mtype == protocol.ACTOR_CREATE:
             spec: ActorSpec = msg["spec"]
@@ -283,6 +311,8 @@ class WorkerExecutor:
             self._pool.submit(self._create_actor, spec)
         elif mtype == protocol.ACTOR_TASK:
             aspec: ActorTaskSpec = msg["spec"]
+            with self._queue_lock:
+                self._inflight += 1
             method = getattr(type(self._actor), aspec.method_name, None) \
                 if self._actor is not None else None
             if method is not None and inspect.iscoroutinefunction(method):
@@ -296,14 +326,19 @@ class WorkerExecutor:
         elif mtype == protocol.UNQUEUE_TASK:
             # driver steals back a task pipelined behind a BLOCKED task
             # (it would deadlock if the blocked get transitively depends
-            # on it). Race-free: refuse once the task has started.
+            # on it). ok only for a task that is genuinely queued and
+            # not started — a task that already started OR already
+            # COMPLETED (raced ahead of the steal decision) must refuse,
+            # or the tombstone would skip a future lineage resubmission
+            # of the same task id and hang its caller's get().
             tid = msg["task_id"]
             with self._queue_lock:
-                if tid in self._started_tasks:
-                    ok = False
-                else:
+                if tid in self._queued_tasks:
+                    self._queued_tasks.discard(tid)
                     self._unqueued_tasks.add(tid)
                     ok = True
+                else:
+                    ok = False
             conn.reply(msg, ok=ok)
         elif mtype == protocol.SHUTDOWN:
             self.stop_event.set()
@@ -431,9 +466,21 @@ class WorkerExecutor:
                     TaskError(e, format_exception(e)), object_id=oid)
             stored.is_error = error
             stored_list.append(stored)
-        self.ctx.conn.send({"type": protocol.TASK_DONE,
-                            "task_id": task_id, "results": stored_list,
-                            "error": error, **extra})
+        # Lazy while other work is in flight: completions emitted in
+        # the same tick (pipelined tasks finishing back-to-back, seal
+        # notifications, trailing decrefs) coalesce into one frame —
+        # the ~1 ms window is far below the driver's completion-
+        # processing latency and the worker keeps executing meanwhile.
+        # A lone completion (sync round-trip) flushes eagerly instead.
+        with self._queue_lock:
+            self._inflight = max(0, self._inflight - 1)
+            busy = self._inflight > 0
+        msg = {"type": protocol.TASK_DONE, "task_id": task_id,
+               "results": stored_list, "error": error, **extra}
+        if busy:
+            self.ctx.conn.send_lazy(msg)
+        else:
+            self.ctx.conn.send(msg)
 
     def _finish_task_cleanup(self, spec: TaskSpec) -> None:
         """Idempotent post-task cleanup: deregister from the cancel
@@ -472,10 +519,12 @@ class WorkerExecutor:
     def _run_task(self, spec: TaskSpec) -> None:
         from ray_tpu.exceptions import TaskCancelledError
         with self._queue_lock:
+            self._queued_tasks.discard(spec.task_id)
             if spec.task_id in self._unqueued_tasks:
                 # stolen back by the driver while queued: it was (or
                 # will be) re-dispatched elsewhere — skip silently
                 self._unqueued_tasks.discard(spec.task_id)
+                self._inflight = max(0, self._inflight - 1)
                 return
             self._started_tasks.add(spec.task_id)
         t0 = time.time()
@@ -519,6 +568,9 @@ class WorkerExecutor:
                            duration_s=time.time() - t0)
         with self._queue_lock:
             self._started_tasks.discard(spec.task_id)
+            # completion purges any stale steal tombstone so a lineage
+            # resubmission reusing this task id can never be skipped
+            self._unqueued_tasks.discard(spec.task_id)
 
     def _create_actor(self, spec: ActorSpec) -> None:
         try:
@@ -607,6 +659,9 @@ def main() -> None:
 
     conn = protocol.connect((host, int(port)), handler, on_close,
                             name=f"worker-{args.worker_id}")
+    # the worker is a hot emitter (TASK_DONE bursts, decref floods):
+    # coalesce its fire-and-forget frames
+    conn.enable_coalescing()
     ctx = WorkerContext(conn, args.worker_id)
     _context.set_ctx(ctx)
     executor = WorkerExecutor(ctx)
@@ -615,6 +670,10 @@ def main() -> None:
                "pid": os.getpid()})
     executor.stop_event.wait()
     executor.flush_events()
+    try:
+        conn.flush()             # drain any coalescing-queued frames
+    except protocol.ConnectionClosed:
+        pass
     conn.close()
     # Daemonic pool threads may be mid-task; hard-exit like the reference's
     # worker does on graceful shutdown after draining.

@@ -33,9 +33,16 @@ static inline uint64_t now_ns(void) {
 
 /* Wait until words[0..count) are all >= value.
  * timeout_ns < 0 means no deadline. Returns 0 on success, 1 on
- * timeout. Words are written by other processes with aligned stores;
- * volatile reads are sufficient on x86-64/aarch64 for this
- * single-writer-per-word protocol. */
+ * timeout. Words are written by other processes with aligned stores.
+ * The gate words are read with ACQUIRE ordering: passing the gate must
+ * order the caller's subsequent payload reads after the writer's
+ * pre-publish payload stores, or a weakly-ordered CPU (aarch64) could
+ * serve stale payload bytes through a freshly-opened gate. (On x86-64
+ * plain loads already have acquire semantics; the builtin costs
+ * nothing there.) Writers should publish the gate word with a
+ * release-ordered store — CPython's mmap slice-assign stores are plain,
+ * which is the remaining theoretical gap on ARM writers; the C-side
+ * acquire at least restores the documented reader-side guarantee. */
 int rtpu_wait_u64s_ge(const volatile uint64_t *words, int count,
                       uint64_t value, int64_t timeout_ns) {
     uint64_t deadline = 0;
@@ -47,7 +54,10 @@ int rtpu_wait_u64s_ge(const volatile uint64_t *words, int count,
     for (;;) {
         int ok = 1;
         for (int i = 0; i < count; i++) {
-            if (words[i] < value) { ok = 0; break; }
+            if (__atomic_load_n(&words[i], __ATOMIC_ACQUIRE) < value) {
+                ok = 0;
+                break;
+            }
         }
         if (ok)
             return 0;

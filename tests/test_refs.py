@@ -92,6 +92,68 @@ def test_task_returning_ref(rt):
     assert float(ray_tpu.get(box[0], timeout=30)[0]) == 7.0
 
 
+def test_deferred_decref_parks_without_context():
+    """Regression (ADVICE r5): a decref deferred while NO context is
+    installed (shutdown / re-init gap) must stay parked and drain when
+    the next context installs — not be silently dropped."""
+    from ray_tpu._private import context as _context
+    from ray_tpu._private import refs
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    assert _context.maybe_ctx() is None
+    oid = "park_test_" + "0" * 10
+    refs._deferred.append(oid)
+    refs._flush_wake.set()
+    refs._ensure_flusher()
+    time.sleep(0.8)                     # several flusher wake cycles
+    assert oid in refs._deferred        # parked, not dropped
+
+    calls = []
+
+    class _Ctx(ray_tpu._private.context.BaseContext):
+        def decref(self, object_id):
+            calls.append(object_id)
+
+    _context.set_ctx(_Ctx())            # install wakes the flusher
+    try:
+        deadline = time.monotonic() + 10
+        while oid not in calls and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert oid in calls, "parked decref did not drain on install"
+    finally:
+        _context.set_ctx(None)
+
+
+def test_deferred_decrefs_flush_as_batches(rt):
+    """The flusher drains in DECREF_BATCH-sized groups through the
+    context's decref_batch hook (one frame per batch on wire-hop
+    contexts)."""
+    from ray_tpu._private import refs
+    batches = []
+    orig = type(rt).decref_batch
+
+    def spy(self, oids):
+        batches.append(list(oids))
+        orig(self, oids)
+
+    type(rt).decref_batch = spy
+    try:
+        for i in range(10):
+            refs._deferred.append("nonexistent_%02d" % i)
+        refs._flush_wake.set()
+        refs._ensure_flusher()
+        deadline = time.monotonic() + 10
+        while (sum(len(b) for b in batches) < 10
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        flat = [o for b in batches for o in b]
+        assert all(o in flat for o in
+                   ["nonexistent_%02d" % i for i in range(10)])
+        assert all(len(b) <= 64 for b in batches)
+    finally:
+        type(rt).decref_batch = orig
+
+
 def test_borrow_across_remote_agent(rt):
     """The borrow/decref messages relay through a real node agent."""
     from ray_tpu.cluster_utils import NodeAgentProcess

@@ -200,6 +200,165 @@ def test_python_plane_fast_pickle_and_fallback():
     assert type(out["value"][2]).__name__ == "Local"
 
 
+# ------------------------------------------------- batch frames (r6)
+def test_batch_frame_roundtrip_preserves_order():
+    msgs = [{"type": "decref", "object_id": f"oid{i:015d}"}
+            for i in range(10)]
+    msgs.append({"type": "task_done", "task_id": "t1", "ok": True})
+    msgs.append({"type": "decref_batch",
+                 "object_ids": [f"b{i}" for i in range(5)]})
+    blob = wire.dumps_batch(msgs)
+    env = pb.Envelope.FromString(blob)
+    assert env.type == wire.BATCH_TYPE
+    assert len(env.batch.frames) == len(msgs)
+    out, ver = wire.loads_ex(blob)
+    assert ver == wire.WIRE_VERSION
+    assert out["type"] == wire.BATCH_TYPE
+    assert out["frames"] == msgs          # order + content intact
+
+
+def test_decref_batch_is_language_neutral():
+    """DECREF_BATCH rides the structural node plane: zero pickled
+    leaves, like its single-frame sibling."""
+    msg = {"type": "decref_batch",
+           "object_ids": ["o" * 20, "p" * 20]}
+    env = pb.Envelope.FromString(wire.dumps(msg))
+    assert not env.py_body
+    kinds = {v.WhichOneof("kind") for v in env.fields.fields.values()}
+    assert "pickled" not in kinds
+    assert wire.loads(env.SerializeToString()) == msg
+
+
+def test_batch_emission_is_negotiated():
+    """A sender must not emit BatchFrame until it has OBSERVED the peer
+    speaking MINOR >= 1; before that, coalesced flushes go out as
+    plain concatenated frames any same-major peer can parse."""
+    got = []
+    server_box = {}
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def accept():
+        s, _ = lsock.accept()
+        c = protocol.Connection(
+            s, lambda conn, msg: got.append(msg), server=True)
+        server_box["c"] = c
+        c.start()
+
+    threading.Thread(target=accept, daemon=True).start()
+    conn = protocol.connect(("127.0.0.1", port), lambda c, m: None)
+    conn.enable_coalescing()
+    try:
+        # phase 1: nothing observed from the peer -> no BatchFrame
+        assert conn.peer_wire_version == 0
+        s0 = dict(protocol.WIRE_STATS)
+        for i in range(8):
+            conn.send_lazy({"type": "decref", "object_id": f"a{i}"})
+        conn.flush()
+        deadline = time.time() + 5
+        while len(got) < 8 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(got) == 8
+        assert (protocol.WIRE_STATS["tx_frames"] - s0["tx_frames"]) == 8
+
+        # phase 2: peer speaks -> version learned -> BatchFrame emitted
+        server_box["c"].send({"type": "ping"})
+        deadline = time.time() + 5
+        while conn.peer_wire_version == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert conn.peer_wire_version == wire.WIRE_VERSION
+        s1 = dict(protocol.WIRE_STATS)
+        for i in range(8):
+            conn.send_lazy({"type": "decref", "object_id": f"b{i}"})
+        conn.flush()
+        deadline = time.time() + 5
+        while len(got) < 17 and time.time() < deadline:
+            time.sleep(0.01)
+        assert (protocol.WIRE_STATS["tx_frames"] - s1["tx_frames"]) == 1
+        order = [m["object_id"] for m in got if m["type"] == "decref"
+                 and m["object_id"].startswith("b")]
+        assert order == [f"b{i}" for i in range(8)]
+    finally:
+        conn.close()
+        lsock.close()
+
+
+def test_eager_send_flushes_lazy_queue_in_order():
+    """A reply-bearing request bypasses the coalescing queue but must
+    drain it FIRST: per-connection FIFO between lazy and eager frames
+    is what the refcount pin-release protocol relies on."""
+    got = []
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def accept():
+        s, _ = lsock.accept()
+        c = protocol.Connection(
+            s, lambda conn, msg: got.append(msg), server=True)
+        c.start()
+
+    threading.Thread(target=accept, daemon=True).start()
+    conn = protocol.connect(("127.0.0.1", port), lambda c, m: None)
+    conn.enable_coalescing()
+    try:
+        conn.send_lazy({"type": "addref", "object_id": "pinned"})
+        conn.send({"type": "task_done", "task_id": "t9"})  # eager
+        deadline = time.time() + 5
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert [m["type"] for m in got] == ["addref", "task_done"]
+    finally:
+        conn.close()
+        lsock.close()
+
+
+def test_wire_batch_disable_flag():
+    """RAY_TPU_WIRE_BATCH=0 restores one-frame-per-send behavior even
+    on a coalescing-enabled connection."""
+    import os
+    from ray_tpu._private.config import CONFIG
+    prev = os.environ.get("RAY_TPU_WIRE_BATCH")
+    os.environ["RAY_TPU_WIRE_BATCH"] = "0"
+    CONFIG.reload()
+    got = []
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def accept():
+        s, _ = lsock.accept()
+        c = protocol.Connection(
+            s, lambda conn, msg: got.append(msg), server=True)
+        c.start()
+
+    threading.Thread(target=accept, daemon=True).start()
+    conn = protocol.connect(("127.0.0.1", port), lambda c, m: None)
+    conn.enable_coalescing()
+    try:
+        s0 = dict(protocol.WIRE_STATS)
+        for i in range(6):
+            conn.send_lazy({"type": "decref", "object_id": f"d{i}"})
+        deadline = time.time() + 5
+        while len(got) < 6 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(got) == 6
+        # every send_lazy degraded to an immediate single frame
+        assert (protocol.WIRE_STATS["tx_frames"] - s0["tx_frames"]) == 6
+    finally:
+        conn.close()
+        lsock.close()
+        if prev is None:
+            os.environ.pop("RAY_TPU_WIRE_BATCH", None)
+        else:
+            os.environ["RAY_TPU_WIRE_BATCH"] = prev
+        CONFIG.reload()
+
+
 def test_tripwire_catches_by_reference_main_objects():
     """The dangerous case: objects plain pickle would serialize
     'successfully' BY REFERENCE into this process's __main__ — a class

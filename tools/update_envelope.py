@@ -1,0 +1,128 @@
+"""Refresh ENVELOPE.md's machine-generated benchmark block.
+
+Usage:
+    python bench_core.py --json > /tmp/bench.json
+    python tools/update_envelope.py --json /tmp/bench.json
+    # or run the bench in-process:
+    python tools/update_envelope.py --run
+
+Rewrites the block between the ``<!-- bench:latest:begin -->`` /
+``<!-- bench:latest:end -->`` markers in ENVELOPE.md (appending the
+block on first use) with one row per scenario key, including the r6
+frames-per-task column, so every bench refresh lands in the envelope
+doc the same way and future rounds can track the trajectory. The
+hand-curated narrative above the block is never touched.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+BEGIN = "<!-- bench:latest:begin -->"
+END = "<!-- bench:latest:end -->"
+
+# scenario key -> human row label (table order follows this list; keys
+# absent from the JSON are skipped, unknown keys are appended as-is)
+LABELS = [
+    ("tasks_sync_per_s", "tasks, sync round-trip"),
+    ("tasks_batch_per_s", "tasks, batched"),
+    ("actor_calls_sync_per_s", "actor calls, sync"),
+    ("actor_calls_async_per_s", "actor calls, pipelined"),
+    ("put_small_per_s", "put (small objects)"),
+    ("put_gbps", "put throughput (8 MB)"),
+    ("get_gbps", "get throughput (8 MB)"),
+    ("shm_cycle_pooled_gbps", "shm put+free cycle, pooled (8 MB)"),
+    ("shm_cycle_unpooled_gbps", "shm put+free cycle, unpooled (8 MB)"),
+    ("wait_1k_refs", "wait on 1k refs"),
+    ("parked_gets_200", "200 parked gets"),
+    ("drain_2k_unbatched", "2k drain, RAY_TPU_WIRE_BATCH=0"),
+    ("queue_5k_tasks", "5k queued tasks (batched wire)"),
+    ("queue_100k_submit", "100k queued tasks, submit"),
+    ("dag_2hop_execute", "compiled DAG, 2-hop execute"),
+    ("dag_device_hop", "compiled DAG, device hop"),
+]
+
+
+def _fmt_result(rec: dict) -> str:
+    if "per_second" in rec:
+        out = f"{rec['per_second']:,} {rec.get('unit', 'ops')}/s"
+        if "submit_per_second" in rec:
+            out += f" (submit {rec['submit_per_second']:,}/s)"
+        if "pool_speedup" in rec:
+            out += f" (pool speedup {rec['pool_speedup']}x)"
+        if "channel_speedup" in rec:
+            out += f" (channel speedup {rec['channel_speedup']}x)"
+        return out
+    extras = {k: v for k, v in rec.items() if k not in ("n", "unit")}
+    return ", ".join(f"{k}={v}" for k, v in extras.items())
+
+
+def _fmt_frames(rec: dict) -> str:
+    if "frames_per_task" in rec:
+        return str(rec["frames_per_task"])
+    return "—"
+
+
+def render_block(results: dict) -> str:
+    known = [k for k, _ in LABELS]
+    rows = [(label, results[key]) for key, label in LABELS
+            if key in results]
+    rows += [(key, rec) for key, rec in results.items()
+             if key not in known]
+    lines = [BEGIN,
+             "### Latest `bench_core.py` run (machine-generated)",
+             "",
+             "| Scenario | Result | frames/task |",
+             "|---|---|---|"]
+    for label, rec in rows:
+        lines.append(f"| {label} | {_fmt_result(rec)} | "
+                     f"{_fmt_frames(rec)} |")
+    lines.append(END)
+    return "\n".join(lines)
+
+
+def update_envelope(results: dict, path: str) -> None:
+    block = render_block(results)
+    if os.path.exists(path):
+        with open(path) as f:
+            text = f.read()
+    else:
+        text = "# Scalability envelope\n"
+    if BEGIN in text and END in text:
+        head, rest = text.split(BEGIN, 1)
+        _, tail = rest.split(END, 1)
+        text = head + block + tail
+    else:
+        text = text.rstrip("\n") + "\n\n" + block + "\n"
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="update_envelope")
+    p.add_argument("--json", help="bench_core.py --json output file "
+                                  "(default: stdin)")
+    p.add_argument("--run", action="store_true",
+                   help="run bench_core.main() in-process instead")
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ENVELOPE.md"))
+    args = p.parse_args(argv)
+    if args.run:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        import bench_core
+        results = bench_core.main(as_json=False)
+    elif args.json:
+        with open(args.json) as f:
+            results = json.load(f)
+    else:
+        results = json.load(sys.stdin)
+    update_envelope(results, args.out)
+    print(f"updated {args.out} ({len(results)} scenarios)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Headline benchmark: LLM training throughput on one TPU chip.
+
+Prints ONE JSON line: tokens/sec/chip on a ~1B-param Llama-style model
+(bf16, flash-attention Pallas kernel, remat, adamw), plus achieved MFU.
+`vs_baseline` is MFU / 0.35 — the reference publishes no tokens/sec
+number (BASELINE.md: the 35% MFU target is the driver-supplied north
+star), so >=1.0 means the target is met.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# Peak dense bf16 FLOPs/s per chip by TPU generation.
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def _detect_peak() -> float:
+    import os
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in gen:
+            return val
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    if "lite" in kind:  # "TPU v5 lite" = v5e
+        return PEAK_FLOPS["v5e"]
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return PEAK_FLOPS["v4"]
+
+
+def main():
+    import optax
+
+    from ray_tpu.models import Transformer, TransformerConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # Tuned on-chip (tools/bench_sweep.py): 1024-block flash kernels,
+        # no remat (activations fit HBM at this batch), unchunked loss.
+        cfg = TransformerConfig(
+            vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=16, d_ff=5632, max_seq_len=2048, remat=False,
+            dtype="bfloat16", param_dtype="bfloat16", loss_chunk=0,
+            attn_block_q=1024, attn_block_k=1024)
+        batch, seq, steps = 2, 2048, 20
+    else:  # smoke mode off-TPU
+        from ray_tpu.models.config import tiny
+        cfg = tiny()
+        batch, seq, steps = 4, 64, 3
+
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optax.adamw(1e-4)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+
+    def _step(p, s, batch_):
+        loss, g = jax.value_and_grad(model.loss)(p, batch_)
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    # donate params+opt state: avoids double-buffering ~6 GB on-chip
+    train_step = jax.jit(_step, donate_argnums=(0, 1))
+
+    # compile + warmup. float() (device_get) is the sync point:
+    # block_until_ready is unreliable on tunneled TPU platforms.
+    params, opt_state, loss = train_step(params, opt_state,
+                                         {"tokens": tokens})
+    float(loss)
+    params, opt_state, loss = train_step(params, opt_state,
+                                         {"tokens": tokens})
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state,
+                                             {"tokens": tokens})
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tok_per_s = batch * seq * steps / dt
+    flops_per_token = cfg.flops_per_token()
+    mfu = tok_per_s * flops_per_token / _detect_peak()
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(tok_per_s, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "mfu": round(mfu, 4),
+        "params": cfg.num_params(),
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Tasks, actors, objects, placement groups in 30 lines."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo-root import without install
+
+import numpy as np
+
+import ray_tpu
+
+ray_tpu.init(num_cpus=4)
+
+
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def add(self, k):
+        self.n += k
+        return self.n
+
+
+print("tasks:", ray_tpu.get([square.remote(i) for i in range(8)]))
+
+c = Counter.remote()
+print("actor:", ray_tpu.get([c.add.remote(i) for i in range(1, 5)]))
+
+big = ray_tpu.put(np.arange(1_000_000))          # shm-backed object
+print("object sum:", ray_tpu.get(square.remote(2)),
+      int(ray_tpu.get(big).sum()))
+
+from ray_tpu.util.placement_group import placement_group
+pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+print("placement group ready:", pg.wait(timeout_seconds=30))
+
+ray_tpu.shutdown()

@@ -1,0 +1,40 @@
+"""jsonl corpus -> tokenize -> dp-sharded jax batches."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo-root import without install
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rd
+
+ray_tpu.init(num_cpus=4)
+
+d = tempfile.mkdtemp()
+with open(os.path.join(d, "corpus.jsonl"), "w") as f:
+    for i in range(256):
+        f.write(json.dumps({"doc_id": i, "text": f"document {i}"}) + "\n")
+
+
+def tokenize(batch):
+    return {"tokens": np.stack([np.arange(16) + d_
+                                for d_ in batch["doc_id"]]),
+            "doc_id": batch["doc_id"]}
+
+
+ds = rd.read_json(os.path.join(d, "corpus.jsonl"),
+                  rows_per_block=32).map_batches(tokenize)
+print("dataset:", ds, "rows:", ds.count())
+
+for i, batch in enumerate(ds.iterator().iter_jax_batches(
+        batch_size=64, dtypes={"tokens": "int32"})):
+    print(f"batch {i}: tokens {batch['tokens'].shape} "
+          f"{batch['tokens'].dtype}")
+
+ray_tpu.shutdown()

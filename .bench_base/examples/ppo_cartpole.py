@@ -1,0 +1,22 @@
+"""PPO learns CartPole to 450 (the tuned-example learning gate)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo-root import without install
+
+import ray_tpu
+from ray_tpu.rllib.algorithms import PPOConfig
+
+ray_tpu.init(num_cpus=4)
+algo = PPOConfig().environment("CartPole-v1").build()
+for i in range(250):
+    m = algo.train()
+    r = m.get("episode_return_mean", float("nan"))
+    if i % 10 == 0:
+        print(f"iter {i:3d} return {r:7.1f}")
+    if r == r and r >= 450:
+        print(f"solved at iter {i}: {r:.1f}")
+        break
+algo.stop()
+ray_tpu.shutdown()

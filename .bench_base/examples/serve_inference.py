@@ -1,0 +1,35 @@
+"""Model serving: deployments + handle + HTTP ingress."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo-root import without install
+
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(num_cpus=4)
+
+
+@serve.deployment(num_replicas=2)
+class Classifier:
+    def __call__(self, body):
+        text = str(body.get("text", ""))
+        return {"label": "long" if len(text) > 10 else "short",
+                "length": len(text)}
+
+
+handle = serve.run(Classifier.bind(), name="clf")
+print("handle:", ray_tpu.get(handle.remote({"text": "hello world!"})))
+
+port = serve.start_http(port=0)
+req = urllib.request.Request(
+    f"http://127.0.0.1:{port}/clf",
+    data=json.dumps({"text": "hi"}).encode())
+print("http:", json.loads(urllib.request.urlopen(req).read()))
+serve.stop_http()
+serve.shutdown()
+ray_tpu.shutdown()

@@ -1,0 +1,59 @@
+"""2-worker JaxTrainer: tiny-transformer SFT with checkpoints."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo-root import without install
+
+import ray_tpu
+from ray_tpu.train import (CheckpointConfig, JaxConfig, JaxTrainer,
+                           RunConfig, ScalingConfig)
+
+ray_tpu.init(num_cpus=4)
+
+
+def train_loop(config):
+    import jax
+    import numpy as np
+    import optax
+
+    from ray_tpu import train as rt_train
+    from ray_tpu.models import Transformer
+    from ray_tpu.models.config import tiny
+    from ray_tpu.train import Checkpoint
+    from ray_tpu.train.session import make_temp_checkpoint_dir
+
+    cfg = tiny()
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optax.adamw(3e-3)
+    opt_state = opt.init(params)
+    tokens = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(rt_train.get_context().get_world_rank()),
+        (4, 32), 0, cfg.vocab_size))
+
+    @jax.jit
+    def step(p, s):
+        loss, g = jax.value_and_grad(model.loss)(p, {"tokens": tokens})
+        up, s = opt.update(g, s, p)
+        return optax.apply_updates(p, up), s, loss
+
+    for i in range(config["steps"]):
+        params, opt_state, loss = step(params, opt_state)
+        ckpt = None
+        if i == config["steps"] - 1:
+            d = make_temp_checkpoint_dir()
+            ckpt = Checkpoint.from_state(d, {"params": params})
+        rt_train.report({"loss": float(loss), "step": i}, ckpt)
+
+
+result = JaxTrainer(
+    train_loop,
+    train_loop_config={"steps": 5},
+    scaling_config=ScalingConfig(num_workers=2),
+    run_config=RunConfig(name="sft_example",
+                         checkpoint_config=CheckpointConfig(num_to_keep=1)),
+    backend_config=JaxConfig(distributed=False),
+).fit()
+print("final:", result.metrics, "checkpoint:", result.checkpoint)
+ray_tpu.shutdown()

@@ -1,0 +1,34 @@
+"""ASHA lr sweep over trial actors."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo-root import without install
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.tuner import TuneConfig
+
+ray_tpu.init(num_cpus=4)
+
+
+def trainable(config):
+    from ray_tpu import tune as rt_tune
+    x = 1.0
+    for step in range(8):
+        x *= (1.0 - config["lr"])          # toy objective -> 0
+        rt_tune.report({"loss": abs(x), "step": step})
+
+
+grid = tune.Tuner(
+    trainable,
+    param_space={"lr": tune.grid_search([0.9, 0.5, 0.1, 0.01])},
+    tune_config=TuneConfig(
+        metric="loss", mode="min", max_concurrent_trials=2,
+        scheduler=tune.ASHAScheduler(metric="loss", mode="min",
+                                     max_t=8, grace_period=2)),
+).fit()
+best = grid.get_best_result()
+print("best config:", best.metrics["config"], "loss:",
+      best.metrics["loss"])
+ray_tpu.shutdown()

@@ -1,0 +1,31 @@
+"""ray_tpu: a TPU-native distributed computing + ML framework.
+
+Core API parity with the reference (python/ray/__init__.py):
+``init/shutdown/remote/get/put/wait/kill/cancel/get_actor`` plus the ML
+platform subpackages (``train``, ``tune``, ``data``, ``rllib``, ``serve``),
+TPU-first parallelism (``parallel``), Pallas kernels (``ops``) and model
+zoo (``models``). The core deliberately avoids importing jax so worker
+process startup stays cheap; accelerator-touching subpackages import it
+lazily.
+"""
+from ray_tpu._version import version as __version__  # noqa: F401
+from ray_tpu._private import context as _context
+from ray_tpu._private.refs import ObjectRef  # noqa: F401
+from ray_tpu._private.runtime import init, shutdown  # noqa: F401
+from ray_tpu.actor import ActorClass, ActorHandle  # noqa: F401
+from ray_tpu.api import (cancel, available_resources,  # noqa: F401
+                         cluster_resources, get, get_actor, kill, method,
+                         put, remote, wait)
+from ray_tpu import exceptions  # noqa: F401
+
+
+def is_initialized() -> bool:
+    return _context.is_initialized()
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "method", "get", "put",
+    "wait", "kill", "cancel", "get_actor", "cluster_resources",
+    "available_resources", "ObjectRef", "ActorClass", "ActorHandle",
+    "exceptions", "__version__",
+]

@@ -1,0 +1,4 @@
+from ray_tpu._private.accelerators.tpu import (TPUAcceleratorManager,
+                                               detect_num_tpu_chips)
+
+__all__ = ["TPUAcceleratorManager", "detect_num_tpu_chips"]

@@ -1,0 +1,169 @@
+"""Central runtime config registry with env-var override.
+
+Parity: reference src/ray/common/ray_config_def.h (219 RAY_CONFIG
+entries, each overridable via a RAY_<name> env var, materialised into a
+RayConfig singleton) — scaled to this runtime's knob set. Every entry
+is overridable via ``RAY_TPU_<NAME>`` (upper-cased) read at first
+access; ``CONFIG.reload()`` re-reads the environment (tests).
+
+Usage::
+
+    from ray_tpu._private.config import CONFIG
+    timeout = CONFIG.heartbeat_timeout_s
+
+Adding a knob: one ``_define`` line here — call sites never hardcode.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigEntry:
+    name: str
+    default: Any
+    parse: Callable[[str], Any]
+    doc: str
+
+
+_REGISTRY: Dict[str, ConfigEntry] = {}
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _define(name: str, default: Any, doc: str) -> None:
+    parse: Callable[[str], Any]
+    if isinstance(default, bool):
+        parse = _parse_bool
+    elif isinstance(default, int):
+        parse = int
+    elif isinstance(default, float):
+        parse = float
+    else:
+        parse = str
+    _REGISTRY[name] = ConfigEntry(name, default, parse, doc)
+
+
+# ---------------------------------------------------------------- knobs
+_define("heartbeat_timeout_s", 3.0,
+        "Node declared dead after this long without a heartbeat "
+        "(reference gcs_health_check_manager period*threshold).")
+_define("spill_delay_s", 1.0,
+        "Queued-task age before the scheduler offers it back to the "
+        "cluster for spillback to another node.")
+_define("worker_spawn_timeout_s", 60.0,
+        "Worker process must register within this long or its spawn "
+        "slot is reaped.")
+_define("inline_threshold_bytes", 100 * 1024,
+        "Buffers below this size ride inline in the pickle stream; "
+        "larger ones get their own shm segment (reference plasma "
+        "promotion threshold semantics).")
+_define("object_store_memory", 0,
+        "Object store residency cap in bytes; 0 = unbounded. Past the "
+        "cap, LRU unpinned objects spill to disk.")
+_define("node_memory_bytes", 8 * 1024 ** 3,
+        "Schedulable 'memory' resource reported per node.")
+_define("worker_pool_max", 0,
+        "Reusable task-worker pool soft cap; 0 = max(2*CPU, 8). Actor-"
+        "pinned workers are dedicated processes outside the cap.")
+_define("task_event_history", 10_000,
+        "Bounded task-event history length in the controller.")
+_define("remote_inline_max_bytes", 64 * 1024,
+        "Task results at or below this size are forwarded inline from a "
+        "node agent to the head (owner-inline parity, reference "
+        "core_worker.h AllocateReturnObject); larger results stay in "
+        "the agent's store and register a location.")
+_define("auth_token", "",
+        "Shared secret for listener authentication. When set, every "
+        "accepted connection must present it (raw first frame, "
+        "constant-time compare) BEFORE any message is deserialized; "
+        "workers/agents inherit it via the environment. Strongly "
+        "recommended with bind_host=0.0.0.0 — the wire is pickle.")
+_define("bind_host", "127.0.0.1",
+        "Head listener bind host. Set 0.0.0.0 (or a NIC address) to "
+        "accept remote node agents; loopback by default.")
+_define("port", 0,
+        "Head listener port; 0 picks an ephemeral port.")
+_define("lineage_max_resubmits", 3,
+        "Cap on per-task lineage re-executions when a node death "
+        "orphans a still-referenced object (reference task_manager "
+        "ResubmitTask bookkeeping).")
+_define("head_snapshot_path", "",
+        "When set, the head periodically snapshots all controller "
+        "tables (actors, nodes, PGs, KV, lineage, object directory) to "
+        "this file and REHYDRATES from it on restart (reference GCS "
+        "persistence: gcs_init_data.cc + redis_store_client.h). Empty "
+        "disables head fault tolerance.")
+_define("head_snapshot_period_s", 1.0,
+        "Controller snapshot period when head_snapshot_path is set.")
+_define("agent_reconnect_window_s", 60.0,
+        "How long a node agent keeps redialing a lost head before "
+        "giving up and shutting down (reference raylets tolerate GCS "
+        "downtime); 0 restores exit-on-disconnect.")
+_define("store_put_block_s", 10.0,
+        "Create-queueing backpressure (reference plasma "
+        "create_request_queue.cc): when the object store is over "
+        "capacity and nothing is spillable (all bytes pinned by "
+        "in-flight tasks), a put parks up to this long for space to "
+        "free before admitting the object over-cap with a warning. "
+        "0 disables blocking.")
+_define("memory_monitor_threshold", 0.95,
+        "Node memory-usage fraction above which the per-node memory "
+        "monitor kills a task worker to relieve pressure (reference "
+        "raylet memory_monitor + worker_killing_policy.cc). 0 "
+        "disables the monitor.")
+_define("memory_monitor_refresh_s", 1.0,
+        "Memory monitor poll period.")
+_define("worker_pipeline_depth", 2,
+        "Tasks dispatched to one worker before its previous task "
+        "completes (the worker executes FIFO). Depth 2 overlaps the "
+        "completion round-trip with execution — the reference's "
+        "worker-lease pipelining — roughly doubling small-task drain "
+        "throughput. 1 restores strict one-at-a-time dispatch.")
+_define("node_rejoin_grace_s", 20.0,
+        "After a head restart, how long rehydrated nodes have to "
+        "re-register before they are declared dead and their actors/"
+        "objects recovered.")
+
+
+class _Config:
+    """Attribute access resolves registry entries with env override."""
+
+    def __init__(self):
+        self._cache: Dict[str, Any] = {}
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        cache = self.__dict__["_cache"]
+        if name in cache:
+            return cache[name]
+        entry = _REGISTRY.get(name)
+        if entry is None:
+            raise AttributeError(
+                f"unknown config {name!r}; known: {sorted(_REGISTRY)}")
+        env = os.environ.get("RAY_TPU_" + name.upper())
+        value = entry.default if env is None else entry.parse(env)
+        cache[name] = value
+        return value
+
+    def reload(self) -> None:
+        """Drop cached values so env overrides re-apply (tests)."""
+        self.__dict__["_cache"].clear()
+
+    def describe(self) -> Dict[str, Dict[str, Any]]:
+        """All knobs with current value, default, env var name, doc."""
+        return {
+            name: {
+                "value": getattr(self, name),
+                "default": e.default,
+                "env": "RAY_TPU_" + name.upper(),
+                "doc": e.doc,
+            } for name, e in sorted(_REGISTRY.items())}
+
+
+CONFIG = _Config()

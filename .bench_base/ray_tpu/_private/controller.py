@@ -1,0 +1,442 @@
+"""Controller: the centralized control plane (GCS equivalent).
+
+Parity map to the reference GCS (src/ray/gcs/gcs_server/gcs_server.h:221-295):
+- KV / function store   -> GcsInternalKVManager / GcsFunctionManager
+- actor directory       -> GcsActorManager (incl. max_restarts bookkeeping,
+                           gcs_actor_manager.h:89-91)
+- named actors          -> GcsActorManager named-actor index
+- placement groups      -> GcsPlacementGroupManager (bundle reservation)
+- node table            -> GcsNodeManager
+- task events           -> GcsTaskManager (bounded in-memory history)
+- refcounts             -> centralized stand-in for the distributed
+                           reference counter (core_worker/reference_count.cc)
+
+All state is in-memory in the driver process; the multi-node story keeps
+this process as head node (the reference's head-node GCS is the same
+topology). Head fault tolerance: ``snapshot_state()`` serializes every
+table and ``restore_state()`` rehydrates a restarted head from it
+(reference gcs/gcs_server/gcs_init_data.cc loading from
+gcs/store_client/redis_store_client.h storage).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu._private.specs import ActorSpec
+
+# Actor lifecycle states (reference rpc::ActorTableData states).
+PENDING = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+@dataclass
+class ActorRecord:
+    spec: ActorSpec
+    state: str = PENDING
+    worker_id: Optional[str] = None
+    node_id: Optional[str] = None
+    num_restarts: int = 0
+    death_cause: str = ""
+    created_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class NodeTableRecord:
+    """GcsNodeManager node-table entry (gcs_node_manager.h:62)."""
+    node_id: str
+    resources: dict
+    is_head: bool = False
+    alive: bool = True
+    death_cause: str = ""
+    labels: dict = field(default_factory=dict)
+    registered_at: float = field(default_factory=time.time)
+    # last per-node reporter sample (load, memory, worker RSS) carried
+    # on heartbeats — reference dashboard/modules/reporter agent
+    host_stats: dict = field(default_factory=dict)
+
+
+class Controller:
+    def __init__(self, task_event_capacity: Optional[int] = None):
+        if task_event_capacity is None:
+            from ray_tpu._private.config import CONFIG as _CFG
+            task_event_capacity = _CFG.task_event_history
+        from ray_tpu._private.debug_sync import make_lock
+        self._lock = make_lock("controller", reentrant=True)
+        self._kv: dict[tuple[str, str], Any] = {}
+        self._actors: dict[str, ActorRecord] = {}
+        self._named_actors: dict[tuple[str, str], str] = {}
+        self._refcounts: dict[str, int] = {}
+        self._pins: dict[str, int] = collections.defaultdict(int)
+        self._pgs: dict[str, dict] = {}
+        self._nodes: dict[str, NodeTableRecord] = {}
+        # Object directory: object_id -> {node_id} holding a copy
+        # (reference ownership_based_object_directory.cc role; here the
+        # head IS the owner of record for every object).
+        self._locations: dict[str, set[str]] = {}
+        self._location_nbytes: dict[str, int] = {}
+        # Lineage: return object_id -> producing TaskSpec, kept while
+        # the object is referenced so a lost copy can be re-executed
+        # (reference task_manager.h:269 ResubmitTask,
+        # object_recovery_manager.h:41).
+        self._lineage: dict[str, Any] = {}
+        # Nested-ref ownership (reference reference_count.cc contained
+        # refs): enclosing object id -> inner object ids it holds a
+        # count on; released when the enclosing object is deleted.
+        self._contained: dict[str, list[str]] = {}
+        self._task_events: collections.deque = collections.deque(
+            maxlen=task_event_capacity)
+        from ray_tpu._private.pubsub import Publisher
+        self.pubsub = Publisher()
+        self._job_start = time.time()
+
+    # ---- KV (GcsInternalKVManager parity) ----
+    def kv_put(self, key: str, value: Any, namespace: str = "default",
+               overwrite: bool = True) -> bool:
+        with self._lock:
+            k = (namespace, key)
+            if not overwrite and k in self._kv:
+                return False
+            self._kv[k] = value
+            return True
+
+    def kv_get(self, key: str, namespace: str = "default") -> Any:
+        with self._lock:
+            return self._kv.get((namespace, key))
+
+    def kv_del(self, key: str, namespace: str = "default") -> bool:
+        with self._lock:
+            return self._kv.pop((namespace, key), None) is not None
+
+    def kv_exists(self, key: str, namespace: str = "default") -> bool:
+        with self._lock:
+            return (namespace, key) in self._kv
+
+    def kv_keys(self, prefix: str = "", namespace: str = "default") -> list[str]:
+        with self._lock:
+            return [k for (ns, k) in self._kv
+                    if ns == namespace and k.startswith(prefix)]
+
+    # ---- function store ----
+    def put_function(self, func_id: str, data: bytes) -> None:
+        self.kv_put(func_id, data, namespace="_functions", overwrite=False)
+
+    def get_function(self, func_id: str) -> Optional[bytes]:
+        return self.kv_get(func_id, namespace="_functions")
+
+    # ---- refcounts ----
+    def addref(self, object_id: str, n: int = 1) -> None:
+        with self._lock:
+            self._refcounts[object_id] = self._refcounts.get(object_id, 0) + n
+
+    def decref(self, object_id: str) -> bool:
+        """Returns True when the object is now unreferenced and unpinned."""
+        with self._lock:
+            c = self._refcounts.get(object_id, 0) - 1
+            if c > 0:
+                self._refcounts[object_id] = c
+                return False
+            self._refcounts.pop(object_id, None)
+            return self._pins[object_id] == 0
+
+    def pin(self, object_id: str) -> None:
+        with self._lock:
+            self._pins[object_id] += 1
+
+    def unpin(self, object_id: str) -> bool:
+        """Returns True when the object is now unreferenced and unpinned."""
+        with self._lock:
+            self._pins[object_id] = max(0, self._pins[object_id] - 1)
+            return (self._pins[object_id] == 0
+                    and self._refcounts.get(object_id, 0) == 0)
+
+    def refcount(self, object_id: str) -> int:
+        with self._lock:
+            return self._refcounts.get(object_id, 0)
+
+    def pinned_ids(self) -> list[str]:
+        """Objects pinned by in-flight work — the store's spill policy
+        must not touch these (they may be mid-transfer as task args)."""
+        with self._lock:
+            return [oid for oid, n in self._pins.items() if n > 0]
+
+    def unreferenced(self, object_id: str) -> bool:
+        with self._lock:
+            return (self._refcounts.get(object_id, 0) == 0
+                    and self._pins[object_id] == 0)
+
+    # ---- object directory (ownership_based_object_directory parity) ----
+    def add_location(self, object_id: str, node_id: str,
+                     nbytes: int = 0) -> None:
+        with self._lock:
+            self._locations.setdefault(object_id, set()).add(node_id)
+            if nbytes:
+                self._location_nbytes[object_id] = nbytes
+
+    def remove_location(self, object_id: str,
+                        node_id: Optional[str] = None) -> None:
+        with self._lock:
+            if node_id is None:
+                self._locations.pop(object_id, None)
+                self._location_nbytes.pop(object_id, None)
+                return
+            s = self._locations.get(object_id)
+            if s is not None:
+                s.discard(node_id)
+                if not s:
+                    self._locations.pop(object_id, None)
+                    self._location_nbytes.pop(object_id, None)
+
+    def locations(self, object_id: str) -> list[str]:
+        with self._lock:
+            return list(self._locations.get(object_id, ()))
+
+    def has_location(self, object_id: str) -> bool:
+        with self._lock:
+            return bool(self._locations.get(object_id))
+
+    def purge_node_locations(self, node_id: str) -> list[str]:
+        """Drop `node_id` from every directory entry; returns object ids
+        that now have NO copy anywhere (lineage-recovery candidates)."""
+        orphaned: list[str] = []
+        with self._lock:
+            for oid in list(self._locations):
+                s = self._locations[oid]
+                if node_id in s:
+                    s.discard(node_id)
+                    if not s:
+                        self._locations.pop(oid, None)
+                        self._location_nbytes.pop(oid, None)
+                        orphaned.append(oid)
+        return orphaned
+
+    # ---- nested-ref ownership ----
+    def register_contained(self, object_id: str,
+                           ids: list[str]) -> list[str]:
+        """The sealed object `object_id` pickled refs to `ids` inside
+        it: hold a count on each until it is deleted. A reseal with
+        DIFFERENT contents (lineage resubmission creates fresh inner
+        ids) refreshes the registration; the previously-held ids are
+        RETURNED and the caller must decref them through the full
+        deletion path."""
+        new = list(ids)
+        with self._lock:
+            old = self._contained.get(object_id)
+            if old == new or (old is None and not new):
+                return []
+            if new:
+                self._contained[object_id] = new
+                for cid in new:
+                    self._refcounts[cid] = self._refcounts.get(cid, 0) + 1
+            else:
+                self._contained.pop(object_id, None)
+            return list(old or ())
+
+    def pop_contained(self, object_id: str) -> list[str]:
+        with self._lock:
+            return self._contained.pop(object_id, [])
+
+    # ---- lineage (ResubmitTask parity) ----
+    def record_lineage(self, spec: Any) -> None:
+        with self._lock:
+            for oid in getattr(spec, "return_ids", ()):
+                self._lineage[oid] = spec
+
+    def lineage_for(self, object_id: str) -> Any:
+        with self._lock:
+            return self._lineage.get(object_id)
+
+    def drop_lineage(self, object_id: str) -> None:
+        with self._lock:
+            self._lineage.pop(object_id, None)
+
+    # ---- actors ----
+    def register_actor(self, spec: ActorSpec) -> ActorRecord:
+        with self._lock:
+            if spec.name is not None:
+                key = (spec.namespace, spec.name)
+                if key in self._named_actors:
+                    raise ValueError(
+                        f"Actor name {spec.name!r} already taken in "
+                        f"namespace {spec.namespace!r}")
+                self._named_actors[key] = spec.actor_id
+            rec = ActorRecord(spec=spec)
+            self._actors[spec.actor_id] = rec
+            return rec
+
+    def get_actor(self, actor_id: str) -> Optional[ActorRecord]:
+        with self._lock:
+            return self._actors.get(actor_id)
+
+    def get_named_actor(self, name: str,
+                        namespace: str = "default") -> Optional[str]:
+        with self._lock:
+            return self._named_actors.get((namespace, name))
+
+    def set_actor_state(self, actor_id: str, state: str,
+                        worker_id: Optional[str] = None,
+                        death_cause: str = "",
+                        node_id: Optional[str] = None) -> None:
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            if rec is None:
+                return
+            rec.state = state
+            if worker_id is not None:
+                rec.worker_id = worker_id
+            if node_id is not None:
+                rec.node_id = node_id
+            if death_cause:
+                rec.death_cause = death_cause
+            if state == DEAD and rec.spec.name is not None:
+                self._named_actors.pop(
+                    (rec.spec.namespace, rec.spec.name), None)
+        from ray_tpu._private.pubsub import ACTOR_CHANNEL
+        self.pubsub.publish(ACTOR_CHANNEL, {
+            "actor_id": actor_id, "state": state,
+            "death_cause": death_cause})
+
+    def list_actors(self) -> list[dict]:
+        with self._lock:
+            return [{
+                "actor_id": aid, "state": r.state, "name": r.spec.name,
+                "class_id": r.spec.class_id, "worker_id": r.worker_id,
+                "num_restarts": r.num_restarts,
+                "max_restarts": r.spec.max_restarts,
+                "death_cause": r.death_cause,
+            } for aid, r in self._actors.items()]
+
+    # ---- placement groups (view pushed by the ClusterTaskManager) ----
+    def register_pg_view(self, entry: dict) -> None:
+        with self._lock:
+            self._pgs[entry["placement_group_id"]] = dict(entry)
+
+    def list_pgs(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._pgs.values()]
+
+    # ---- node table (GcsNodeManager parity) ----
+    def publish_node_event(self, node_id: str, state: str,
+                           cause: str = "") -> None:
+        from ray_tpu._private.pubsub import NODE_CHANNEL
+        self.pubsub.publish(NODE_CHANNEL, {
+            "node_id": node_id, "state": state, "cause": cause})
+
+    def register_node(self, node_id: str, resources: dict,
+                      is_head: bool = False,
+                      labels: Optional[dict] = None) -> None:
+        with self._lock:
+            self._nodes[node_id] = NodeTableRecord(
+                node_id=node_id, resources=dict(resources),
+                is_head=is_head, labels=dict(labels or {}))
+
+    def set_node_state(self, node_id: str, alive: bool,
+                       cause: str = "") -> None:
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is not None:
+                rec.alive = alive
+                if cause:
+                    rec.death_cause = cause
+
+    def update_host_stats(self, node_id: str, stats: dict) -> None:
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is not None:
+                rec.host_stats = dict(stats)
+
+    def list_nodes(self) -> list[dict]:
+        with self._lock:
+            return [{
+                "node_id": r.node_id, "alive": r.alive,
+                "is_head": r.is_head, "resources": dict(r.resources),
+                "death_cause": r.death_cause, "labels": dict(r.labels),
+                "host_stats": dict(r.host_stats),
+            } for r in self._nodes.values()]
+
+    def actors_on_node(self, node_id: str) -> list[str]:
+        """Non-dead actors whose last known placement is `node_id`."""
+        with self._lock:
+            return [aid for aid, r in self._actors.items()
+                    if r.node_id == node_id and r.state != DEAD]
+
+    # ---- persistence (GCS storage parity) ----
+    _SNAPSHOT_TABLES = ("_kv", "_actors", "_named_actors", "_refcounts",
+                        "_pins", "_pgs", "_nodes", "_locations",
+                        "_location_nbytes", "_lineage", "_contained")
+
+    def snapshot_state(self) -> bytes:
+        """Snapshot every table into one blob (reference GCS tables are
+        flushed to the storage backend). Only the shallow table copies
+        happen under the lock; the pickle — the expensive part — runs
+        outside so the periodic snapshot never stalls the control
+        plane."""
+        import pickle
+
+        import cloudpickle
+        with self._lock:
+            state = {name: dict(getattr(self, name))
+                     for name in self._SNAPSHOT_TABLES}
+            # location values are sets mutated in place — copy them, or
+            # the out-of-lock pickle races concurrent add/discard
+            state["_locations"] = {k: set(v)
+                                   for k, v in state["_locations"].items()}
+            state["_task_events"] = list(self._task_events)
+        # cloudpickle, not stdlib pickle: lineage/KV hold raw user task
+        # args (lambdas, closures) that the wire layer supports — a
+        # snapshot that crashes on them silently disables head FT
+        return cloudpickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def restore_state(self, blob: bytes) -> None:
+        """Rehydrate from a snapshot (reference gcs_init_data.cc). Node
+        records for OLD head processes are dropped — the restarted head
+        registers itself fresh; agent records are kept so the cluster
+        can await their re-registration."""
+        import pickle
+        state = pickle.loads(blob)
+        with self._lock:
+            current = dict(self._nodes)          # the new head's record(s)
+            for name in self._SNAPSHOT_TABLES:
+                setattr(self, name, state.get(name, {}))
+            self._pins = collections.defaultdict(
+                int, state["_pins"])             # keep defaulting behavior
+            self._nodes = {nid: r for nid, r in self._nodes.items()
+                           if not r.is_head}
+            self._nodes.update(current)
+            self._task_events.extend(state.get("_task_events", ()))
+
+    # ---- task events (GcsTaskManager parity) ----
+    def record_task_event(self, task_id: str, name: str, state: str,
+                          worker_id: str = "", error: str = "") -> None:
+        with self._lock:
+            self._task_events.append({
+                "task_id": task_id, "name": name, "state": state,
+                "worker_id": worker_id, "error": error, "ts": time.time(),
+            })
+
+    def record_task_events(self, events: list[dict]) -> None:
+        """Batched ingest from worker-side event buffers (reference
+        GcsTaskManager AddTaskEventData): events carry their own
+        worker-side ts/duration_s."""
+        with self._lock:
+            self._task_events.extend(events)
+
+    def list_task_events(self, limit: int = 1000) -> list[dict]:
+        with self._lock:
+            out = list(self._task_events)
+        return out[-limit:]
+
+    def summarize_tasks(self) -> dict:
+        with self._lock:
+            latest: dict[str, dict] = {}
+            for ev in self._task_events:
+                latest[ev["task_id"]] = ev
+        counts: dict[str, int] = collections.defaultdict(int)
+        for ev in latest.values():
+            counts[ev["state"]] += 1
+        return dict(counts)

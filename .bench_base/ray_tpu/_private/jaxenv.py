@@ -1,0 +1,25 @@
+"""JAX platform pinning for worker processes.
+
+A site hook in this environment re-registers experimental TPU platforms
+and rewrites `jax_platforms` at import time, overriding the
+JAX_PLATFORMS env var a parent process fanned out to its workers (the
+driver pins CPU in tests so the single TPU chip isn't fought over).
+Framework actors that initialize JAX call `pin_platform_from_env()`
+first, restoring the env var's authority before any backend spins up.
+"""
+from __future__ import annotations
+
+import os
+
+
+def pin_platform_from_env() -> None:
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", platforms)
+    except Exception:
+        # backend already initialized (config is then immutable) or jax
+        # missing — either way the caller's import proceeds as-is.
+        pass

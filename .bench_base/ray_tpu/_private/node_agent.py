@@ -1,0 +1,735 @@
+"""Standalone node agent: joins a remote head over TCP.
+
+The raylet-equivalent process (reference src/ray/raylet/main.cc): it
+registers its resources with the head (reference
+gcs/gcs_server/gcs_node_manager.h:62 HandleRegisterNode), runs the real
+per-node ``Scheduler`` + worker pool locally, owns a local shm object
+store, and serves chunked object pulls so a worker on another host can
+read objects produced here (reference object_manager/object_manager.cc).
+
+Topology:
+- one control connection agent -> head (registration, heartbeats,
+  routed specs, relayed worker control-plane traffic, task-done events);
+- a local TCP listener for (a) this node's worker subprocesses and
+  (b) object pulls from the head or peer agents;
+- on-demand data connections to peer agents for cross-host gets.
+
+Division of labor with the head: placement, actor bookkeeping,
+refcounts, the object *directory*, and waiter parking are head-side;
+dispatch, the resource ledger, worker lifecycles, and object *bytes*
+are agent-side. Small task results are forwarded inline to the head
+(owner-inline parity, reference core_worker.h AllocateReturnObject);
+large ones stay local and register a location.
+
+Run: ``python -m ray_tpu._private.node_agent --head HOST:PORT
+[--num-cpus N] [--num-tpus N] [--resources JSON] [--bind HOST]
+[--advertise HOST]``
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+from ray_tpu._private import protocol
+from ray_tpu._private.config import CONFIG as _CFG
+from ray_tpu._private.object_store import (LocalStore, StoredObject,
+                                           unlink_segment)
+from ray_tpu._private.object_transfer import (PullServer, materialize,
+                                              pull_object)
+from ray_tpu._private.scheduler import Scheduler
+from ray_tpu._private.specs import ActorSpec
+
+import logging
+
+log = logging.getLogger(__name__)
+
+HEARTBEAT_PERIOD_S = 0.5
+
+
+class _AgentFacade:
+    """The tiny runtime interface Scheduler drives; every callback
+    becomes a NODE_EVENT to the head."""
+
+    def __init__(self, agent: "NodeAgent"):
+        self._agent = agent
+
+    def on_task_dispatched(self, spec, worker_id: str) -> None:
+        self._agent.send_event("task_dispatched", key=spec.task_id,
+                               name=spec.name, worker_id=worker_id)
+
+    def on_actor_dispatched(self, spec, worker_id: str) -> None:
+        self._agent.send_event("actor_dispatched",
+                               key="actor:" + spec.actor_id,
+                               actor_id=spec.actor_id, worker_id=worker_id)
+
+    def on_unplaceable(self, spec, reason: str) -> None:
+        self._agent.send_event("unplaceable", spec=spec, reason=reason)
+
+
+class NodeAgent:
+    def __init__(self, head_addr: tuple[str, int],
+                 resources: dict[str, float],
+                 labels: Optional[dict] = None,
+                 max_workers: Optional[int] = None,
+                 bind_host: str = "0.0.0.0",
+                 advertise_host: Optional[str] = None,
+                 node_id: Optional[str] = None):
+        self.head_addr = head_addr
+        self.store = LocalStore()
+        self._stop = threading.Event()
+        self._fetch_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="rtpu-agent-fetch")
+        self._pull_server = PullServer(self.store,
+                                       executor=self._fetch_pool)
+        # peer agent data connections, keyed by (host, port)
+        self._peers: dict[tuple[str, int], protocol.Connection] = {}
+        self._peer_lock = threading.Lock()
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((bind_host, 0))
+        self._listener.listen(128)
+        port = self._listener.getsockname()[1]
+
+        # Scheduler BEFORE registration: the instant the head learns of
+        # this node it may route specs here, and the connection reader
+        # must have a scheduler to hand them to. The agent mints its own
+        # node id for the same reason.
+        import uuid as _uuid
+        self.node_id = node_id or ("node_" + _uuid.uuid4().hex[:8])
+        self.scheduler = Scheduler(
+            _AgentFacade(self), dict(resources),
+            ("127.0.0.1", port),   # workers are host-local: loopback
+            max_workers, node_id=self.node_id, cluster=None)
+        self.scheduler.start()
+
+        # head-reconnect state (reference: raylets tolerate GCS downtime
+        # and re-register on GCS restart)
+        self._reconnect_lock = threading.Lock()
+        self._reconnecting = False
+        self._pending_relays: list = []          # (conn, msg) to replay
+        # state-bearing fire-and-forget messages (task completions,
+        # object locations, worker deaths) that failed during a head
+        # outage — replayed on rejoin so results produced while the head
+        # was down are not silently lost
+        import collections as _collections
+        self._pending_sends: _collections.deque = _collections.deque(
+            maxlen=10_000)
+        self._dropped_sends = 0
+        self._labels = dict(labels or {})
+        self._max_workers = max_workers
+        self._resources = dict(resources)
+
+        # initial dial retries briefly: agents are routinely started
+        # before (or concurrently with) the head (`ray start` order
+        # independence)
+        dial_deadline = time.monotonic() + max(
+            10.0, _CFG.agent_reconnect_window_s)
+        while True:
+            try:
+                self.head = protocol.connect(
+                    head_addr, self._handle_head_msg,
+                    self._on_head_closed, name="head")
+                break
+            except OSError:
+                if time.monotonic() > dial_deadline:
+                    raise
+                time.sleep(0.3)
+        if advertise_host is None:
+            # The address peers should dial = the local address of our
+            # outbound connection to the head (gethostbyname(hostname)
+            # returns 127.0.1.1 on stock Debian /etc/hosts — useless to
+            # a remote peer).
+            advertise_host = self.head._sock.getsockname()[0]
+        self.advertise_addr = (advertise_host, port)
+        rep = self.head.request(
+            {"type": protocol.NODE_REGISTER, "resources": resources,
+             "labels": dict(labels or {}), "node_id": self.node_id,
+             "advertise_addr": self.advertise_addr,
+             "max_workers": max_workers}, timeout=30.0)
+        assert rep.get("node_id") == self.node_id
+
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="rtpu-agent-accept", daemon=True)
+        self._accept_thread.start()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="rtpu-agent-hb", daemon=True)
+        self._hb_thread.start()
+
+    # ------------------------------------------------------ lifecycles
+    def _on_head_closed(self, conn) -> None:
+        if self._stop.is_set():
+            return
+        window = _CFG.agent_reconnect_window_s
+        if window <= 0:
+            # Orphaned agent: the head is the only control plane — exit.
+            sys.stderr.write("ray_tpu node_agent: head connection lost; "
+                             "shutting down\n")
+            self.shutdown()
+            return
+        with self._reconnect_lock:
+            if self._reconnecting:
+                return
+            self._reconnecting = True
+        threading.Thread(target=self._reconnect_loop, args=(window,),
+                         name="rtpu-agent-reconnect", daemon=True).start()
+
+    def _reconnect_loop(self, window: float) -> None:
+        """Redial the head with backoff until it answers or the window
+        expires. On success: re-register with the SAME node id plus a
+        rejoin report (live actors, held objects) so a restarted head's
+        rehydrated tables re-attach to this node's surviving state."""
+        sys.stderr.write(f"ray_tpu node_agent {self.node_id}: head "
+                         f"connection lost; reconnecting for up to "
+                         f"{window:.0f}s\n")
+        deadline = time.monotonic() + window
+        backoff = 0.25
+        while not self._stop.is_set():
+            if time.monotonic() > deadline:
+                sys.stderr.write("ray_tpu node_agent: head did not come "
+                                 "back; shutting down\n")
+                self.shutdown()
+                return
+            self._stop.wait(backoff)
+            backoff = min(backoff * 1.6, 2.0)
+            try:
+                conn = protocol.connect(self.head_addr,
+                                        self._handle_head_msg,
+                                        self._on_head_closed, name="head")
+            except OSError:
+                continue
+            # Swap BEFORE registering: the head may route work here the
+            # instant it processes the register, and completions must go
+            # out on the new connection, not the dead one.
+            self.head = conn
+            try:
+                rep = conn.request(
+                    {"type": protocol.NODE_REGISTER,
+                     "resources": self._resources,
+                     "labels": self._labels, "node_id": self.node_id,
+                     "advertise_addr": self.advertise_addr,
+                     "max_workers": self._max_workers,
+                     "rejoin": True,
+                     "live_actors": self.scheduler.live_actors(),
+                     "objects": self.store.held_objects()},
+                    timeout=30.0)
+                if rep.get("node_id") != self.node_id:
+                    raise RuntimeError("rejoin refused")
+            except BaseException:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                continue
+            # Flush buffered state messages BEFORE opening the direct-
+            # send path (_reconnecting=False): a fresh DECREF overtaking
+            # a buffered ADDREF would let a refcount dip to zero under a
+            # live borrow.
+            flush_failed = False
+            flushed = 0
+            while True:
+                with self._reconnect_lock:
+                    if not self._pending_sends:
+                        self._reconnecting = False
+                        relays, self._pending_relays = (
+                            self._pending_relays, [])
+                        break
+                    batch = list(self._pending_sends)
+                    self._pending_sends.clear()
+                sent = 0
+                try:
+                    for m in batch:
+                        conn.send(m)
+                        sent += 1
+                except protocol.ConnectionClosed:
+                    # head bounced again mid-flush: keep the unsent tail
+                    # (order-preserving) and redial — still reconnecting
+                    tail = batch[sent:]
+                    with self._reconnect_lock:
+                        space = (self._pending_sends.maxlen
+                                 - len(self._pending_sends))
+                        overflow = len(tail) - space
+                        if overflow > 0:
+                            # evict the NEWEST buffered messages (they
+                            # sort after the tail anyway) — loudly, like
+                            # _append_pending_send
+                            self._dropped_sends += overflow
+                            sys.stderr.write(
+                                f"ray_tpu node_agent {self.node_id}: "
+                                f"head-outage buffer overflow during "
+                                f"re-flush; dropped {overflow} newest "
+                                f"state message(s)\n")
+                            for _ in range(min(
+                                    overflow,
+                                    len(self._pending_sends))):
+                                self._pending_sends.pop()
+                        self._pending_sends.extendleft(reversed(tail))
+                    flush_failed = True
+                    break
+                flushed += sent
+            if flush_failed:
+                continue
+            sys.stderr.write(f"ray_tpu node_agent {self.node_id}: "
+                             f"rejoined head ({flushed} events + "
+                             f"{len(relays)} requests replayed)\n")
+            for wconn, msg in relays:
+                if not wconn.closed:
+                    self._relay_to_head(wconn, msg)
+            return
+
+    def _buffer_relay(self, conn, msg: dict, depth: int = 0) -> bool:
+        """Queue a worker request for replay after the head comes back;
+        False when reconnection is off/over (caller drops the relay).
+        If the reconnect already finished (the failure came from the OLD
+        connection's futures), retry once on the new connection; a
+        second failure buffers unconditionally — retrying again would
+        recurse unboundedly against a flapping head."""
+        if _CFG.agent_reconnect_window_s <= 0 or self._stop.is_set():
+            return False
+        with self._reconnect_lock:
+            if self._reconnecting or depth >= 1:
+                if len(self._pending_relays) >= 10_000:
+                    return False
+                self._pending_relays.append((conn, msg))
+                return True
+        self._relay_to_head(conn, msg, _retry_depth=depth + 1)
+        return True
+
+    def shutdown(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.scheduler.shutdown()
+        self.store.shutdown()
+        from ray_tpu._private.specs import SESSION_TAG_INHERITED
+        if not SESSION_TAG_INHERITED:
+            # standalone agent (own session tag -> sole owner of its
+            # segments on this host): reap orphans from killed workers.
+            # An agent co-located with a head inherits the head's tag
+            # and leaves the sweep to the head's shutdown.
+            from ray_tpu._private.object_store import (
+                sweep_session_segments)
+            sweep_session_segments()
+
+    def wait_forever(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(0.2)
+
+    # ------------------------------------------------------- heartbeat
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.head.send({
+                    "type": protocol.NODE_HEARTBEAT,
+                    "node_id": self.node_id,
+                    **self.scheduler.heartbeat_snapshot(),
+                })
+            except protocol.ConnectionClosed:
+                # head outage: keep the thread alive — self.head is
+                # swapped for a fresh connection on successful rejoin
+                pass
+            except Exception:
+                # never let a transient snapshot/serialize error kill the
+                # heartbeat thread — a silent exit here reads as node
+                # death at the head
+                log.exception("heartbeat send failed; retrying")
+            self._stop.wait(HEARTBEAT_PERIOD_S)
+
+    def _send_to_head(self, msg: dict) -> None:
+        """Fire-and-forget send that buffers during a head outage (the
+        reconnect flush replays it) instead of dropping state. The
+        reconnecting check comes BEFORE the direct send: once the new
+        connection is live but the buffer has not drained, a direct send
+        would overtake buffered messages (a fresh DECREF beating a
+        buffered ADDREF lets a refcount dip to zero under a live
+        borrow)."""
+        for _attempt in range(2):
+            if _CFG.agent_reconnect_window_s > 0:
+                with self._reconnect_lock:
+                    if self._reconnecting:
+                        self._append_pending_send(msg)
+                        return
+            try:
+                self.head.send(msg)
+                return
+            except protocol.ConnectionClosed:
+                if (_CFG.agent_reconnect_window_s <= 0
+                        or self._stop.is_set()):
+                    return
+                # loop: either the outage was just detected (branch
+                # above buffers next pass) or the reconnect finished
+                # between our read of self.head and the failed send —
+                # retry once on the fresh connection
+        with self._reconnect_lock:
+            self._append_pending_send(msg)
+
+    def _append_pending_send(self, msg: dict) -> None:
+        """Append under _reconnect_lock; a full buffer evicts the
+        OLDEST message — make that loss loud, it can strand a caller."""
+        if len(self._pending_sends) == self._pending_sends.maxlen:
+            self._dropped_sends += 1
+            if self._dropped_sends == 1 or self._dropped_sends % 1000 == 0:
+                sys.stderr.write(
+                    f"ray_tpu node_agent {self.node_id}: head-outage "
+                    f"buffer full; dropped {self._dropped_sends} oldest "
+                    f"state message(s) — task completions/refcounts may "
+                    f"be lost\n")
+        self._pending_sends.append(msg)
+
+    def send_event(self, kind: str, **fields) -> None:
+        self._send_to_head({"type": protocol.NODE_EVENT, "kind": kind,
+                            "node_id": self.node_id, **fields})
+
+    # ----------------------------------------------- head-sent messages
+    def _handle_head_msg(self, conn: protocol.Connection,
+                         msg: dict) -> None:
+        mtype = msg["type"]
+        if mtype == protocol.NODE_ENQUEUE:
+            self.scheduler.enqueue(msg["spec"])
+        elif mtype == protocol.NODE_CANCEL_PENDING:
+            spec = self.scheduler.cancel_pending(msg["task_id"])
+            conn.reply(msg, found=spec is not None)
+        elif mtype == protocol.NODE_CANCEL_RUNNING:
+            self.scheduler.cancel_running(msg["worker_id"], msg["task_id"])
+        elif mtype == protocol.NODE_KILL_WORKER:
+            self.scheduler.kill_worker(msg["worker_id"])
+        elif mtype == protocol.NODE_SEND_ACTOR_TASK:
+            ok = self.scheduler.send_actor_task(msg["worker_id"],
+                                                msg["spec"])
+            if not ok:
+                self.send_event("actor_task_undeliverable",
+                                actor_id=msg["spec"].actor_id,
+                                spec=msg["spec"])
+        elif mtype == protocol.NODE_RESERVE_BUNDLE:
+            ok = self.scheduler.reserve_bundle(
+                msg["pg_id"], msg["index"], msg["resources"])
+            conn.reply(msg, ok=ok)
+        elif mtype == protocol.NODE_RELEASE_BUNDLE:
+            self.scheduler.release_bundle(msg["pg_id"], msg["index"])
+        elif mtype == protocol.NODE_DELETE_OBJECT:
+            self.store.delete(msg["object_id"])
+        elif mtype == protocol.PULL_OBJECT:
+            self._pull_server.handle_pull(conn, msg)
+        elif mtype == protocol.PULL_CHUNK:
+            self._pull_server.handle_chunk(conn, msg)
+        elif mtype == protocol.NODE_SHUTDOWN:
+            self.shutdown()
+        elif mtype == protocol.PING:
+            conn.reply(msg, ok=True)
+
+    # ------------------------------------------------ local connections
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            conn = protocol.Connection(sock, self._handle_local_msg,
+                                       self._on_local_closed,
+                                       name="agent-local", server=True)
+            conn.start()
+
+    def _on_local_closed(self, conn: protocol.Connection) -> None:
+        wid = conn.meta.get("worker_id")
+        if wid is None or self._stop.is_set():
+            return
+        tasks, actor_id = self.scheduler.on_worker_lost(wid)
+        if tasks:
+            # the dead worker may have sealed result shm on THIS host
+            # without delivering TASK_DONE — reap locally (the head's
+            # reap only covers its own /dev/shm)
+            from ray_tpu._private.object_store import reap_object_segments
+            for task in tasks:
+                for oid in task.return_ids:
+                    reap_object_segments(oid)
+        self.send_event("worker_lost", worker_id=wid, tasks=tasks,
+                        actor_id=actor_id)
+
+    def _handle_local_msg(self, conn: protocol.Connection,
+                          msg: dict) -> None:
+        """Messages from this host's workers (and peer pullers)."""
+        mtype = msg["type"]
+        if mtype == protocol.REGISTER:
+            self.scheduler.on_worker_registered(msg["worker_id"], conn)
+        elif mtype == protocol.TASK_DONE:
+            self._on_task_done(conn, msg)
+        elif mtype == protocol.GET_OBJECT:
+            self._on_get_object(conn, msg)
+        elif mtype == protocol.PUT_OBJECT:
+            stored: StoredObject = msg["stored"]
+            self.store.put_stored(stored)
+            self.send_event("object_at", object_id=stored.object_id,
+                            nbytes=stored.nbytes, addref=True,
+                            contained=list(stored.contained_ids))
+            conn.reply(msg, ok=True,
+                       pressure=self.store.over_capacity())
+        elif mtype == protocol.PULL_OBJECT:
+            self._pull_server.handle_pull(conn, msg)
+        elif mtype == protocol.PULL_CHUNK:
+            self._pull_server.handle_chunk(conn, msg)
+        elif mtype in (protocol.WAIT, protocol.SUBMIT,
+                       protocol.SUBMIT_ACTOR, protocol.SUBMIT_ACTOR_TASK,
+                       protocol.KV_OP, protocol.STATE_OP):
+            self._relay_to_head(conn, msg)
+        elif mtype in (protocol.DECREF, protocol.ADDREF):
+            self._send_to_head(dict(msg))
+        elif mtype == protocol.PING:
+            conn.reply(msg, ok=True)
+
+    def _relay_to_head(self, conn: protocol.Connection, msg: dict,
+                       _retry_depth: int = 0) -> None:
+        """Forward a request to the head; pipe the reply back. The
+        worker's rid is restored on the way back (the head sees our
+        fresh rid)."""
+        worker_rid = msg.get("rid")
+        is_wait = msg["type"] == protocol.WAIT
+        wid = conn.meta.get("worker_id") if is_wait else None
+        if wid:
+            # a blocked waiter releases its resources (the agent owns
+            # the ledger; the head owns the parking)
+            self.scheduler.worker_blocked(wid)
+        try:
+            fut = self.head.request_async(dict(msg))
+        except protocol.ConnectionClosed:
+            if wid:
+                self.scheduler.worker_unblocked(wid)
+            # head outage: park the request for replay after rejoin
+            # (reference raylets queue GCS RPCs across GCS restarts)
+            self._buffer_relay(conn, msg, depth=_retry_depth)
+            return
+
+        def on_reply(fut) -> None:      # runs on head-conn reader thread
+            try:
+                rep = fut.result(timeout=0)
+            except protocol.ConnectionClosed:
+                if wid:
+                    self.scheduler.worker_unblocked(wid)
+                if not self._buffer_relay(conn, msg, depth=_retry_depth):
+                    try:
+                        conn.reply({"rid": worker_rid}, timeout=True)
+                    except protocol.ConnectionClosed:
+                        pass
+                return
+            except BaseException:
+                rep = {}
+            if wid:
+                self.scheduler.worker_unblocked(wid)
+            out = {k: v for k, v in rep.items()
+                   if k not in ("rid", "type")}
+            try:
+                conn.reply({"rid": worker_rid}, **out)
+            except protocol.ConnectionClosed:
+                pass
+
+        fut.add_done_callback(on_reply)
+
+    # -------------------------------------------------- task completion
+    def _on_task_done(self, conn: protocol.Connection, msg: dict) -> None:
+        worker_id = conn.meta.get("worker_id", "")
+        results: list[StoredObject] = msg.get("results", [])
+        inline: list[StoredObject] = []
+        located: list[tuple[str, int]] = []
+        for stored in results:
+            if stored.nbytes <= _CFG.remote_inline_max_bytes \
+                    or stored.is_error:
+                inline.append(materialize(stored))
+                # inline copies are head-owned; drop local segments
+                for name in stored.shm_names:
+                    unlink_segment(name)
+            else:
+                self.store.put_stored(stored)
+                located.append((stored.object_id, stored.nbytes,
+                                list(stored.contained_ids)))
+        # release the ledger before telling the head (the head may
+        # immediately route the next task here)
+        if msg.get("is_actor_create"):
+            self.scheduler.actor_ready(worker_id)
+        elif msg.get("is_actor_task"):
+            pass                       # actor keeps its resources
+        else:
+            self.scheduler.task_finished(worker_id, msg.get("task_id"))
+        ctrl = {k: v for k, v in msg.items()
+                if k not in ("results", "rid", "type")}
+        self._send_to_head({"type": protocol.NODE_TASK_DONE,
+                            "node_id": self.node_id,
+                            "worker_id": worker_id, "inline": inline,
+                            "located": located, **ctrl})
+
+    # ------------------------------------------------------ object gets
+    def _on_get_object(self, conn: protocol.Connection, msg: dict) -> None:
+        oid = msg["object_id"]
+        stored = self.store.get_stored(oid, timeout=0, restore=False)
+        if stored is not None:
+            conn.reply(msg, stored=stored)
+            return
+        wid = conn.meta.get("worker_id")
+        if wid:
+            self.scheduler.worker_blocked(wid)
+        self._fetch_pool.submit(self._fetch_and_reply, conn, msg, oid, wid)
+
+    def _fetch_and_reply(self, conn, msg, oid: str,
+                         wid: Optional[str]) -> None:
+        try:
+            stored = self._fetch(oid, msg.get("timeout"))
+            if stored is not None:
+                conn.reply(msg, stored=stored)
+            else:
+                conn.reply(msg, stored=None, timeout=True)
+        except protocol.ConnectionClosed:
+            pass
+        finally:
+            if wid:
+                self.scheduler.worker_unblocked(wid)
+
+    def _fetch(self, oid: str,
+               timeout: Optional[float]) -> Optional[StoredObject]:
+        """Local store (incl. spill restore), else head lookup, else
+        peer pull. The head lookup BLOCKS head-side until the object
+        exists somewhere or the timeout passes — the agent never polls."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            stored = self.store.get_stored(oid, timeout=0)
+            if stored is not None:
+                return stored
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            try:
+                rep = self.head.request(
+                    {"type": protocol.OBJECT_LOOKUP, "object_id": oid,
+                     "timeout": remaining},
+                    timeout=None if remaining is None else remaining + 10)
+            except (protocol.ConnectionClosed, TimeoutError):
+                return None
+            if rep.get("stored") is not None:
+                return rep["stored"]
+            if rep.get("head_pull"):
+                # big head-resident object: chunked pull over the
+                # existing control connection (no extra dial needed)
+                try:
+                    return pull_object(self.head, oid, timeout=remaining)
+                except (protocol.ConnectionClosed, TimeoutError):
+                    return None
+            loc = rep.get("location")
+            if loc is None:
+                return None              # head-side timeout
+            host, port = loc["host"], loc["port"]
+            if (host, port) == tuple(self.advertise_addr):
+                # our own (deleted-in-flight) copy: loop re-checks
+                if deadline is not None and time.monotonic() > deadline:
+                    return None
+                time.sleep(0.05)
+                continue
+            stored = self._pull_from_peer((host, port), oid)
+            if stored is not None:
+                self.store.put_stored(stored)
+                # replica registration: future readers may pull from us,
+                # and the head's delete fan-out will reach this copy
+                self.send_event("object_at", object_id=oid,
+                                nbytes=stored.nbytes, addref=False)
+                return stored
+            # holder lost it (died / evicted): drop the stale location
+            # and retry until our deadline
+            self.send_event("location_gone", object_id=oid,
+                            holder=loc.get("node_id"))
+            if deadline is not None and time.monotonic() > deadline:
+                return None
+            time.sleep(0.1)
+
+    def _pull_from_peer(self, addr: tuple[str, int],
+                        oid: str) -> Optional[StoredObject]:
+        conn = self._peer_conn(addr)
+        if conn is None:
+            return None
+        try:
+            return pull_object(conn, oid)
+        except (protocol.ConnectionClosed, TimeoutError):
+            with self._peer_lock:
+                self._peers.pop(addr, None)
+            return None
+
+    def _peer_conn(self, addr) -> Optional[protocol.Connection]:
+        with self._peer_lock:
+            conn = self._peers.get(addr)
+            if conn is not None and not conn.closed:
+                return conn
+        try:
+            conn = protocol.connect(tuple(addr), lambda c, m: None,
+                                    name=f"peer-{addr[0]}:{addr[1]}")
+        except OSError:
+            return None
+        with self._peer_lock:
+            # two fetch threads may have dialed concurrently: keep the
+            # winner already in the cache, close the loser
+            existing = self._peers.get(tuple(addr))
+            if existing is not None and not existing.closed:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                return existing
+            self._peers[tuple(addr)] = conn
+        return conn
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    p = argparse.ArgumentParser(prog="ray_tpu node agent")
+    p.add_argument("--head", required=True,
+                   help="head address HOST:PORT (from ray_tpu.init on "
+                        "the driver host)")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--resources", type=str, default=None,
+                   help="extra resources as JSON, e.g. '{\"accel\": 4}'")
+    p.add_argument("--labels", type=str, default=None)
+    p.add_argument("--max-workers", type=int, default=None)
+    p.add_argument("--bind", type=str, default="0.0.0.0")
+    p.add_argument("--advertise", type=str, default=None,
+                   help="host peers should dial for object pulls "
+                        "(default: autodetect; loopback when the head "
+                        "is loopback)")
+    p.add_argument("--node-id", type=str, default=None,
+                   help="explicit node id (tests; default: generated)")
+    args = p.parse_args(argv)
+
+    host, port = args.head.rsplit(":", 1)
+    from ray_tpu._private.runtime import detect_num_tpu_chips
+    num_cpus = (args.num_cpus if args.num_cpus is not None
+                else float(max(os.cpu_count() or 1, 4)))
+    num_tpus = (args.num_tpus if args.num_tpus is not None
+                else float(detect_num_tpu_chips()))
+    resources = {"CPU": float(num_cpus)}
+    if num_tpus:
+        resources["TPU"] = float(num_tpus)
+    resources["memory"] = float(_CFG.node_memory_bytes)
+    if args.resources:
+        resources.update({k: float(v)
+                          for k, v in json.loads(args.resources).items()})
+    agent = NodeAgent(
+        (host, int(port)), resources,
+        labels=json.loads(args.labels) if args.labels else None,
+        max_workers=args.max_workers, bind_host=args.bind,
+        advertise_host=args.advertise, node_id=args.node_id)
+    sys.stderr.write(f"ray_tpu node_agent {agent.node_id} joined "
+                     f"{args.head} (listening on "
+                     f"{agent.advertise_addr[0]}:"
+                     f"{agent.advertise_addr[1]})\n")
+    try:
+        agent.wait_forever()
+    except KeyboardInterrupt:
+        agent.shutdown()
+
+
+if __name__ == "__main__":
+    main()

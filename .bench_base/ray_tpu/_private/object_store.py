@@ -1,0 +1,613 @@
+"""Object plane: serialization + shared-memory object store.
+
+Replaces the reference's two-tier object plane (in-process memory store,
+reference src/ray/core_worker/store_provider/memory_store/memory_store.h:43,
+and the plasma shm arena, reference src/ray/object_manager/plasma/) with:
+
+- ``serialize``/``deserialize`` built on pickle protocol 5 with
+  ``buffer_callback``: large contiguous buffers (numpy / jax host arrays)
+  are carved out-of-band so cross-process transfer is zero-copy through
+  POSIX shared memory, the same property plasma's fd-passing provides
+  (reference plasma/fling.cc) without a bespoke arena: the kernel shm
+  object *is* the arena and the eviction unit.
+- ``LocalStore``: the driver-resident authoritative store. Small payloads
+  live inline; each large buffer lives in its own named shm segment,
+  unlinked when the distributed refcount hits zero (refcounting lives in
+  the controller, reference core_worker/reference_count.cc analogue).
+
+Lifetime design: a segment exists *by name* in the kernel from creation
+until ``shm_unlink``; no process needs to hold a handle to keep it alive.
+Creators therefore write, then immediately close + unregister from the
+resource tracker. Readers map via raw ``mmap`` (not SharedMemory, which
+would leak an fd per attach); the mapping is freed automatically when the
+last deserialized array view is garbage collected. Unlink-while-mapped is
+safe POSIX: existing mappings survive, the name disappears.
+"""
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import sys
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Optional
+
+import _posixshmem  # CPython's shm syscall wrapper (used by SharedMemory)
+import cloudpickle
+
+# Buffers below this many bytes ride inline in the pickled payload; larger
+# ones are carved into shm segments. Mirrors the reference's inline-small
+# -return threshold semantics (task returns under ~100KiB go to the owner's
+# memory store; reference core_worker.h AllocateReturnObject).
+from ray_tpu._private.config import CONFIG as _CFG
+
+
+def _local_tag() -> str:
+    """Segment names carry the PRODUCING process tree's session tag
+    (not the id-issuer's): a task submitted by a remote driver but
+    executed here seals segments on THIS host, and this host's
+    tag-prefixed sweep must find them."""
+    from ray_tpu._private.specs import SESSION_TAG
+    return SESSION_TAG
+
+
+def new_object_id() -> str:
+    from ray_tpu._private.specs import SESSION_TAG
+    return SESSION_TAG + uuid.uuid4().hex[:14]
+
+
+@dataclass
+class StoredObject:
+    """Serialized object: inline payload + optional out-of-band shm buffers."""
+    object_id: str
+    payload: bytes                      # pickle5 stream (buffers external)
+    inline_buffers: list[bytes] = field(default_factory=list)
+    shm_names: list[str] = field(default_factory=list)
+    shm_sizes: list[int] = field(default_factory=list)
+    buffer_order: list[str] = field(default_factory=list)  # "i" inline / "s" shm
+    is_error: bool = False              # payload deserializes to an exception
+    # object ids of refs pickled INSIDE this value: the controller holds
+    # a count on each until this object is deleted (nested-ref ownership,
+    # reference reference_count.cc)
+    contained_ids: list[str] = field(default_factory=list)
+
+    @property
+    def nbytes(self) -> int:
+        return (len(self.payload) + sum(len(b) for b in self.inline_buffers)
+                + sum(self.shm_sizes))
+
+
+def _create_segment(name: str, data: memoryview) -> None:
+    """Create + fill a named segment, then release all process-local
+    resources; the segment persists by name until shm_unlink."""
+    try:
+        shm = shared_memory.SharedMemory(name=name, create=True,
+                                         size=len(data))
+    except FileExistsError:
+        # Stale segment from a killed process re-running the same task
+        # (lineage resubmission re-uses the object id, and same-host
+        # node agents share /dev/shm). The name encodes the producing
+        # task, so reclaiming is safe.
+        unlink_segment(name)
+        shm = shared_memory.SharedMemory(name=name, create=True,
+                                         size=len(data))
+    shm.buf[:len(data)] = data
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
+    shm.close()
+
+
+def _map_segment(name: str, size: int) -> memoryview:
+    """Map an existing segment read-write; the fd is closed immediately so
+    nothing leaks — the mmap lives as long as views into it do."""
+    fd = _posixshmem.shm_open("/" + name, os.O_RDWR, mode=0o600)
+    try:
+        mm = mmap.mmap(fd, size)
+    finally:
+        os.close(fd)
+    return memoryview(mm)[:size]
+
+
+def reap_object_segments(object_id: str, max_buffers: int = 64) -> int:
+    """Unlink shm segments a dead producer may have created for
+    `object_id` before its TASK_DONE reached us (worker killed between
+    serialize and send). Buffer indices may have gaps (small buffers
+    store inline), so scan /dev/shm for the prefix rather than probing
+    sequentially. Returns the number reaped."""
+    reaped = 0
+    prefix = f"rtpu_{_local_tag()}_{object_id}_"
+    try:
+        names = [n for n in os.listdir("/dev/shm")
+                 if n.startswith(prefix)]
+    except OSError:
+        # no listable shm dir (non-Linux): fall back to index probing
+        # over the full range, tolerating gaps
+        names = [f"rtpu_{_local_tag()}_{object_id}_{i}"
+                 for i in range(max_buffers)]
+    for name in names:
+        try:
+            _posixshmem.shm_unlink("/" + name)
+            reaped += 1
+        except OSError:
+            pass
+    return reaped
+
+
+def sweep_session_segments() -> int:
+    """Unlink every shm segment created under THIS process tree's
+    session tag (ids embed it, so segment names start with
+    rtpu_<tag>). Safe only once all of the session's producers and
+    consumers are stopped — called from Runtime/NodeAgent shutdown."""
+    from ray_tpu._private.specs import SESSION_TAG
+    # the trailing separator matters: tag "abcd" must never match a
+    # concurrent session's "abcd12..." segments (every segment name is
+    # rtpu_<producer-tag>_<rest>)
+    prefix = f"rtpu_{SESSION_TAG}_"
+    reaped = 0
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return 0
+    for name in names:
+        if name.startswith(prefix):
+            try:
+                _posixshmem.shm_unlink("/" + name)
+                reaped += 1
+            except OSError:
+                pass
+    return reaped
+
+
+def unlink_segment(name: str) -> None:
+    try:
+        _posixshmem.shm_unlink("/" + name)
+    except FileNotFoundError:
+        pass
+    except OSError:
+        pass
+
+
+def serialize(value: Any, object_id: Optional[str] = None,
+              create_shm: bool = True) -> StoredObject:
+    object_id = object_id or new_object_id()
+    raw_buffers: list[pickle.PickleBuffer] = []
+    from ray_tpu._private.refs import _capture
+    _capture.ids = contained = []
+    try:
+        payload = cloudpickle.dumps(value, protocol=5,
+                                    buffer_callback=raw_buffers.append)
+    finally:
+        _capture.ids = None
+    inline: list[bytes] = []
+    shm_names: list[str] = []
+    shm_sizes: list[int] = []
+    order: list[str] = []
+    for i, pb in enumerate(raw_buffers):
+        mv = pb.raw()
+        if len(mv) < _CFG.inline_threshold_bytes or not create_shm:
+            inline.append(mv.tobytes())
+            order.append("i")
+        else:
+            name = f"rtpu_{_local_tag()}_{object_id}_{i}"
+            _create_segment(name, mv)
+            shm_names.append(name)
+            shm_sizes.append(len(mv))
+            order.append("s")
+    is_error = isinstance(value, BaseException)
+    return StoredObject(object_id, payload, inline, shm_names, shm_sizes,
+                        order, is_error, contained_ids=contained)
+
+
+def deserialize(obj: StoredObject) -> Any:
+    """Reconstruct the value. shm-backed buffers become zero-copy views
+    whose underlying mappings are freed when the views are collected."""
+    buffers: list[Any] = []
+    ii = si = 0
+    for kind in obj.buffer_order:
+        if kind == "i":
+            buffers.append(obj.inline_buffers[ii]); ii += 1
+        else:
+            buffers.append(_map_segment(obj.shm_names[si],
+                                        obj.shm_sizes[si])); si += 1
+    return pickle.loads(obj.payload, buffers=buffers)
+
+
+@dataclass
+class _SpilledObject:
+    object_id: str
+    path: str
+    nbytes: int
+
+
+class LocalStore:
+    """Driver-resident object store: refcount-driven deletion, plus a
+    capacity cap with LRU spill-to-disk of unpinned objects.
+
+    Parity: reference plasma eviction
+    (object_manager/plasma/eviction_policy.cc LRU) + raylet spilling
+    (raylet/local_object_manager.cc). A `put` that pushes residency past
+    `capacity_bytes` spills least-recently-used unpinned objects to
+    `spill_dir` (shm segments are materialised into the spill file and
+    unlinked); a later `get` restores transparently.
+    """
+
+    def __init__(self, capacity_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 pinned_fn=None):
+        import collections
+        import tempfile
+        if capacity_bytes is None:
+            capacity_bytes = _CFG.object_store_memory or None
+        self.capacity_bytes = capacity_bytes
+        self._spill_dir = spill_dir or os.path.join(
+            tempfile.gettempdir(), f"rtpu_spill_{os.getpid()}")
+        self._pinned_fn = pinned_fn or (lambda: ())
+        self._objects: "collections.OrderedDict[str, StoredObject]" = (
+            collections.OrderedDict())
+        self._spilled: dict[str, _SpilledObject] = {}
+        # last hand-out time per object: the spill policy avoids objects
+        # a reader may be about to map (get_stored returns shm names the
+        # caller maps OUTSIDE the lock; see _pick_victims_locked)
+        self._touched_at: dict[str, float] = {}
+        self._spilling: set[str] = set()        # popped, disk write in flight
+        self._spill_cancelled: set[str] = set()  # deleted mid-spill
+        self._restoring: set[str] = set()        # spill-file read in flight
+        self._restore_cancelled: set[str] = set()  # deleted mid-restore
+        self._bytes = 0
+        self._spilled_bytes_total = 0
+        self._restored_bytes_total = 0
+        from ray_tpu._private.debug_sync import make_lock
+        self._lock = make_lock("object_store")
+        self._cv = threading.Condition(self._lock)
+        # Seal hook: called AFTER an object lands (outside the lock)
+        # with its id — the runtime's waiter registry resolves blocked
+        # gets/waits on it (event-driven, no parked threads).
+        self.on_seal = None
+
+    # ------------------------------------------------------------- put
+    def put_stored(self, obj: StoredObject, block: bool = False) -> None:
+        """Admit a sealed object. ``block=True`` applies create-queueing
+        backpressure when the store is over cap and fully pinned — ONLY
+        safe on producer-owned threads (driver put); connection reader
+        threads must pass False (blocking them stalls the very messages
+        whose processing releases pins) and instead forward the
+        ``over_capacity()`` hint to the producer."""
+        stale: list[str] = []
+        with self._cv:
+            old = self._objects.pop(obj.object_id, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+                # re-stored id (task retry): reclaim segments the new
+                # object doesn't reuse, or they outlive the process
+                stale = [n for n in old.shm_names
+                         if n not in set(obj.shm_names)]
+            self._objects[obj.object_id] = obj
+            self._bytes += obj.nbytes
+            self._touched_at[obj.object_id] = time.monotonic()
+            victims = self._pick_victims_locked()
+            self._cv.notify_all()
+        for name in stale:
+            unlink_segment(name)
+        self._write_spills(victims)
+        # Seal BEFORE any backpressure wait: consumers blocked on this
+        # object must resolve (their tasks finishing is what releases
+        # the pins that free space — delaying the seal would deadlock
+        # the very backpressure loop).
+        if self.on_seal is not None:
+            self.on_seal(obj.object_id)
+        if block:
+            self._put_backpressure()
+
+    def over_capacity(self) -> bool:
+        """Still over cap after the spill pass — i.e. the resident
+        overage is pinned. Producers use this as a throttle hint."""
+        with self._lock:
+            return (self.capacity_bytes is not None
+                    and self._bytes > self.capacity_bytes)
+
+    def _put_backpressure(self) -> None:
+        """Create-queueing parity (reference plasma
+        create_request_queue.cc): when the store is over capacity and
+        nothing is spillable — every resident byte pinned by in-flight
+        work — park the PRODUCER until space frees (deletes, unpins
+        making spill possible) or the budget runs out, then admit
+        over-cap with a loud warning instead of failing."""
+        if self.capacity_bytes is None:
+            return
+        block_s = _CFG.store_put_block_s
+        if block_s <= 0:
+            return
+        deadline = time.monotonic() + block_s
+        warned_wait = False
+        while True:
+            with self._cv:
+                if self._bytes <= self.capacity_bytes:
+                    return
+                victims = self._pick_victims_locked()
+                if not victims:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        sys.stderr.write(
+                            f"ray_tpu: object store over capacity "
+                            f"({self._bytes} > {self.capacity_bytes} "
+                            f"bytes) with all bytes pinned by in-flight "
+                            f"work after {block_s:.0f}s of "
+                            f"backpressure; admitting over-cap\n")
+                        return
+                    if not warned_wait:
+                        warned_wait = True
+                        sys.stderr.write(
+                            "ray_tpu: object store full and fully "
+                            "pinned; applying put backpressure\n")
+                    self._cv.wait(timeout=min(left, 0.2))
+                    continue
+            self._write_spills(victims)     # outside the lock
+
+    def put(self, value: Any, object_id: Optional[str] = None,
+            block: bool = False) -> str:
+        # block defaults False: internal callers (error seals, recovery
+        # paths) run on connection reader threads where backpressure
+        # would stall the very messages that release pins. Producer-
+        # owned threads opt in (Runtime.put).
+        obj = serialize(value, object_id)
+        self.put_stored(obj, block=block)
+        return obj.object_id
+
+    # ----------------------------------------------------------- spill
+    def _pick_victims_locked(self) -> list[tuple[str, StoredObject]]:
+        """Pop LRU spill victims from residency (lock held) WITHOUT
+        doing I/O — the caller writes them to disk after releasing the
+        lock (`_write_spills`), so a slow disk never stalls the whole
+        object plane. Mid-spill objects are invisible to get/wait until
+        recorded; readers simply block on the condvar until then."""
+        if self.capacity_bytes is None or self._bytes <= self.capacity_bytes:
+            return []
+        pinned = set(self._pinned_fn())
+        now = time.monotonic()
+        victims: list[tuple[str, StoredObject]] = []
+
+        def take(oid: str) -> None:
+            obj = self._objects.pop(oid)
+            self._bytes -= obj.nbytes
+            self._spilling.add(oid)
+            victims.append((oid, obj))
+
+        # LRU order = OrderedDict insertion/touch order. Objects handed
+        # out in the last few seconds are skipped: a reader may still be
+        # mapping their shm segments outside the lock (get/deserialize
+        # race) — the retry path in the runtime covers the remainder.
+        deferred: list[str] = []
+        for oid in list(self._objects):
+            if self._bytes <= self.capacity_bytes:
+                break
+            if oid in pinned:
+                continue
+            if now - self._touched_at.get(oid, 0.0) < 5.0:
+                deferred.append(oid)
+                continue
+            take(oid)
+        # still over: last resort, take recently-touched (but not
+        # pinned) victims rather than blow past the cap unboundedly
+        for oid in deferred:
+            if self._bytes <= self.capacity_bytes:
+                break
+            take(oid)
+        return victims
+
+    def _write_spills(self, victims: list[tuple[str, StoredObject]]) -> None:
+        """Disk I/O phase of spilling (NO store lock held)."""
+        if not victims:
+            return
+        os.makedirs(self._spill_dir, exist_ok=True)
+        for oid, obj in victims:
+            path = os.path.join(self._spill_dir, oid)
+            buffers = []
+            ii = si = 0
+            for kind in obj.buffer_order:
+                if kind == "i":
+                    buffers.append(obj.inline_buffers[ii]); ii += 1
+                else:
+                    mv = _map_segment(obj.shm_names[si], obj.shm_sizes[si])
+                    buffers.append(mv.tobytes())
+                    del mv
+                    si += 1
+            with open(path, "wb") as f:
+                pickle.dump({"payload": obj.payload, "buffers": buffers,
+                             "is_error": obj.is_error,
+                             "contained": obj.contained_ids}, f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            for name in obj.shm_names:
+                unlink_segment(name)
+            with self._cv:
+                self._spilling.discard(oid)
+                if oid in self._spill_cancelled:
+                    # deleted while we were writing: drop everything
+                    self._spill_cancelled.discard(oid)
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                else:
+                    self._spilled[oid] = _SpilledObject(oid, path,
+                                                        obj.nbytes)
+                    self._spilled_bytes_total += obj.nbytes
+                self._cv.notify_all()
+
+    def _restore(self, oid: str,
+                 timeout: Optional[float] = None) -> Optional[StoredObject]:
+        """Two-phase restore mirroring the spill write: claim the
+        spill record under the lock, READ THE FILE OUTSIDE IT (a large
+        restore must not stall the whole object plane), re-admit under
+        the lock. Concurrent getters of the same oid wait on the
+        condvar via the _restoring marker. `timeout` bounds how long a
+        losing racer waits for the winner's re-admission (0 = don't
+        block: the non-blocking-probe contract of get_stored)."""
+        with self._cv:
+            rec = self._spilled.pop(oid, None)
+            if rec is None:
+                # Someone else claimed the spill record. If their disk
+                # read is still in flight the object is in neither map
+                # yet — wait for re-admission instead of reporting a
+                # spurious miss to the loser of the race.
+                if oid in self._restoring and timeout != 0:
+                    self._cv.wait_for(
+                        lambda: oid in self._objects
+                        or oid not in self._restoring,
+                        timeout=timeout)
+                return self._objects.get(oid)
+            self._restoring.add(oid)
+        try:
+            with open(rec.path, "rb") as f:
+                blob = pickle.load(f)
+            os.unlink(rec.path)
+        except BaseException:
+            with self._cv:
+                self._restoring.discard(oid)
+                self._spilled[oid] = rec        # put the claim back
+                self._cv.notify_all()
+            raise
+        # Rebuild: buffers go back inline (they re-spill if pressure
+        # persists; re-carving shm here would thrash under scans).
+        obj = StoredObject(oid, blob["payload"],
+                           inline_buffers=list(blob["buffers"]),
+                           buffer_order=["i"] * len(blob["buffers"]),
+                           is_error=blob["is_error"],
+                           contained_ids=list(blob.get("contained", ())))
+        with self._cv:
+            self._restoring.discard(oid)
+            if oid in self._restore_cancelled:   # deleted mid-restore
+                self._restore_cancelled.discard(oid)
+                self._cv.notify_all()
+                return None
+            self._objects[oid] = obj
+            self._bytes += obj.nbytes
+            self._restored_bytes_total += obj.nbytes
+            victims = self._pick_victims_locked()
+            self._cv.notify_all()
+        self._write_spills(victims)
+        # Re-admission is a seal: wake registry waiters that parked in
+        # the gap before this restore claimed the spill record.
+        if self.on_seal is not None:
+            self.on_seal(oid)
+        return obj
+
+    # ------------------------------------------------------------- get
+    def held_objects(self) -> list[tuple[str, int]]:
+        """(object_id, nbytes) for every resident or spilled object —
+        reported to the head on rejoin so the rehydrated object
+        directory learns this node's copies."""
+        with self._lock:
+            out = [(oid, o.nbytes) for oid, o in self._objects.items()]
+            out.extend((oid, s.nbytes) for oid, s in self._spilled.items()
+                       if oid not in self._objects)
+            return out
+
+    def contains(self, object_id: str) -> bool:
+        with self._lock:
+            return (object_id in self._objects
+                    or object_id in self._spilled
+                    or object_id in self._spilling
+                    or object_id in self._restoring)
+
+    def get_stored(self, object_id: str,
+                   timeout: Optional[float] = None,
+                   restore: bool = True) -> Optional[StoredObject]:
+        """restore=False is a residency-only probe: spilled objects
+        report a miss instead of triggering a synchronous disk read —
+        event-driven callers route restores to a worker pool."""
+        with self._cv:
+            def present():
+                return (object_id in self._objects
+                        or object_id in self._spilled)
+            if timeout != 0:
+                self._cv.wait_for(present, timeout=timeout)
+            # timeout == 0 is a NON-BLOCKING probe: a mid-flight
+            # spill/restore simply reports miss; the caller's blocking
+            # path (waiter thread) picks it up once the record lands.
+            obj = self._objects.get(object_id)
+            if obj is not None:
+                self._objects.move_to_end(object_id)   # LRU touch
+                self._touched_at[object_id] = time.monotonic()
+                return obj
+            if object_id not in self._spilled:
+                if object_id in self._restoring and timeout != 0:
+                    # another thread is reading the spill file: wait for
+                    # its re-admission instead of returning a miss
+                    self._cv.wait_for(
+                        lambda: object_id in self._objects,
+                        timeout=timeout)
+                    obj = self._objects.get(object_id)
+                    if obj is not None:
+                        self._touched_at[object_id] = time.monotonic()
+                    return obj
+                return None
+            if not restore:
+                return None
+        obj = self._restore(object_id, timeout=timeout)
+        if obj is not None:
+            with self._lock:
+                self._touched_at[object_id] = time.monotonic()
+        return obj
+
+    def wait_any(self, object_ids: list[str], num_returns: int,
+                 timeout: Optional[float]) -> list[str]:
+        """Block until >= num_returns of object_ids are local; return ready ids."""
+        with self._cv:
+            def ready():
+                return [o for o in object_ids
+                        if o in self._objects or o in self._spilled
+                        or o in self._spilling or o in self._restoring]
+            self._cv.wait_for(lambda: len(ready()) >= num_returns,
+                              timeout=timeout)
+            return ready()
+
+    def delete(self, object_id: str) -> None:
+        with self._lock:
+            obj = self._objects.pop(object_id, None)
+            if obj is not None:
+                self._bytes -= obj.nbytes
+            rec = self._spilled.pop(object_id, None)
+            self._touched_at.pop(object_id, None)
+            if object_id in self._spilling:
+                # mid-flight spill: the writer drops the file + segments
+                # when it finishes (see _write_spills)
+                self._spill_cancelled.add(object_id)
+            if object_id in self._restoring:
+                self._restore_cancelled.add(object_id)
+        if obj is not None:
+            for name in obj.shm_names:
+                unlink_segment(name)
+        if rec is not None:
+            try:
+                os.unlink(rec.path)
+            except OSError:
+                pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_objects": len(self._objects) + len(self._spilled),
+                "bytes": self._bytes,
+                "num_spilled": len(self._spilled),
+                "spilled_bytes": sum(r.nbytes
+                                     for r in self._spilled.values()),
+                "spilled_bytes_total": self._spilled_bytes_total,
+                "restored_bytes_total": self._restored_bytes_total,
+                "capacity_bytes": self.capacity_bytes,
+            }
+
+    def shutdown(self) -> None:
+        with self._lock:
+            ids = list(self._objects) + list(self._spilled)
+        for oid in ids:
+            self.delete(oid)
+        try:
+            os.rmdir(self._spill_dir)
+        except OSError:
+            pass

@@ -1,0 +1,166 @@
+"""Cross-host object transfer: chunked pull protocol.
+
+The reference moves objects node-to-node with a chunked push/pull plane
+(reference src/ray/object_manager/object_manager.cc, pull_manager.cc,
+object_buffer_pool.cc chunking). Here the equivalent is a pull-only
+protocol riding the framed-message channel:
+
+    PULL_OBJECT {object_id}            -> {found, pull_id, nchunks, size}
+    PULL_CHUNK  {pull_id, index}       -> {data: bytes}   (x nchunks)
+
+The holder serializes the StoredObject — materializing any POSIX-shm
+segments into inline bytes, since shm names are host-local — and serves
+it in fixed-size chunks so one giant object never occupies a connection
+for a single monolithic frame (and the puller can bound memory).
+Sessions expire after a TTL to survive pullers that die mid-pull.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import threading
+import time
+import uuid
+from typing import Optional
+
+from ray_tpu._private import protocol
+from ray_tpu._private.object_store import StoredObject, _map_segment
+
+CHUNK_BYTES = 4 * 1024 * 1024
+_SESSION_TTL_S = 120.0
+
+
+def materialize(obj: StoredObject) -> StoredObject:
+    """Copy of `obj` with every shm-backed buffer pulled inline — the
+    only form that can cross a host boundary."""
+    if not obj.shm_names:
+        return obj
+    inline: list[bytes] = []
+    ii = si = 0
+    order: list[str] = []
+    for kind in obj.buffer_order:
+        if kind == "i":
+            inline.append(obj.inline_buffers[ii]); ii += 1
+        else:
+            mv = _map_segment(obj.shm_names[si], obj.shm_sizes[si])
+            inline.append(mv.tobytes())
+            del mv
+            si += 1
+        order.append("i")
+    return StoredObject(obj.object_id, obj.payload, inline, [], [],
+                        order, obj.is_error,
+                        contained_ids=list(obj.contained_ids))
+
+
+def _encode(obj: StoredObject) -> bytes:
+    return pickle.dumps(materialize(obj), protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _decode(data: bytes) -> StoredObject:
+    return pickle.loads(data)
+
+
+class PullServer:
+    """Serves PULL_OBJECT / PULL_CHUNK against a LocalStore. Mixed into
+    any endpoint that holds objects (head runtime, node agent).
+
+    `executor` (when given) takes the slow path — spill restore from
+    disk + blob encode — off the connection reader thread, so a
+    multi-GB restore can never stall heartbeat processing on a shared
+    control connection."""
+
+    def __init__(self, store, executor=None):
+        self._store = store
+        self._executor = executor
+        self._sessions: dict[str, tuple[bytes, float]] = {}
+        self._slock = threading.Lock()
+
+    def handle_pull(self, conn: protocol.Connection, msg: dict) -> None:
+        """Runs on the connection reader thread: answer only the cheap
+        not-found case inline; ALL serving (the _encode of a possibly
+        multi-GB object, and any spill restore) goes to the executor so
+        the reader thread never stalls heartbeats/control traffic."""
+        oid = msg["object_id"]
+        stored = self._store.get_stored(oid, timeout=0, restore=False)
+        if stored is None and not self._store.contains(oid):
+            stored = self._store.get_stored(oid, timeout=0)
+            if stored is None:
+                conn.reply(msg, found=False)
+                return
+        if self._executor is not None:
+            self._executor.submit(self._pull_slow, conn, msg, oid)
+        elif stored is not None:
+            self._serve(conn, msg, stored)
+        else:
+            self._pull_slow(conn, msg, oid)
+
+    def _pull_slow(self, conn: protocol.Connection, msg: dict,
+                   oid: str) -> None:
+        try:
+            stored = self._store.get_stored(oid, timeout=10)
+            if stored is None:
+                conn.reply(msg, found=False)
+            else:
+                self._serve(conn, msg, stored)
+        except protocol.ConnectionClosed:
+            pass
+
+    def _serve(self, conn: protocol.Connection, msg: dict,
+               stored) -> None:
+        blob = _encode(stored)
+        pull_id = uuid.uuid4().hex[:12]
+        now = time.monotonic()
+        with self._slock:
+            self._sessions[pull_id] = (blob, now)
+            # TTL sweep inline (sessions are few; no timer thread)
+            dead = [k for k, (_, t) in self._sessions.items()
+                    if now - t > _SESSION_TTL_S]
+            for k in dead:
+                self._sessions.pop(k, None)
+        nchunks = max(1, (len(blob) + CHUNK_BYTES - 1) // CHUNK_BYTES)
+        conn.reply(msg, found=True, pull_id=pull_id, nchunks=nchunks,
+                   size=len(blob))
+
+    def handle_chunk(self, conn: protocol.Connection, msg: dict) -> None:
+        pull_id, index = msg["pull_id"], msg["index"]
+        with self._slock:
+            entry = self._sessions.get(pull_id)
+            if entry is not None:
+                blob = entry[0]
+                self._sessions[pull_id] = (blob, time.monotonic())
+        if entry is None:
+            conn.reply(msg, data=None)
+            return
+        start = index * CHUNK_BYTES
+        data = blob[start:start + CHUNK_BYTES]
+        last = start + CHUNK_BYTES >= len(blob)
+        if last:
+            with self._slock:
+                self._sessions.pop(pull_id, None)
+        conn.reply(msg, data=data)
+
+
+def pull_object(conn: protocol.Connection, object_id: str,
+                timeout: Optional[float] = 60.0) -> Optional[StoredObject]:
+    """Client side: chunked fetch of one object over `conn`."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+
+    def remaining() -> Optional[float]:
+        if deadline is None:
+            return None
+        return max(0.1, deadline - time.monotonic())
+
+    meta = conn.request({"type": protocol.PULL_OBJECT,
+                         "object_id": object_id}, timeout=remaining())
+    if not meta.get("found"):
+        return None
+    parts: list[bytes] = []
+    for i in range(meta["nchunks"]):
+        rep = conn.request({"type": protocol.PULL_CHUNK,
+                            "pull_id": meta["pull_id"], "index": i},
+                           timeout=remaining())
+        data = rep.get("data")
+        if data is None:
+            return None                  # session expired / holder lost it
+        parts.append(data)
+    return _decode(b"".join(parts))

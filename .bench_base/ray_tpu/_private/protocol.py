@@ -1,0 +1,333 @@
+"""Wire protocol for the ray_tpu runtime.
+
+Design: a single full-duplex, length-prefixed-frame protocol over TCP
+(localhost) or later unix sockets. Either endpoint may send *requests*
+(carry a fresh ``rid``) and *replies* (echo the ``rid``). A ``Connection``
+owns a reader thread that routes replies to waiting futures and hands
+requests to a handler callback, so both sides can issue RPCs concurrently
+(a worker blocked in a nested ``get()`` keeps receiving pushed tasks).
+
+This replaces the reference's per-service gRPC stack (reference
+src/ray/rpc/: gcs_server/, node_manager/, worker/) with one multiplexed
+channel per process pair — appropriate because our control plane is
+centralized in the driver process for the single-node runtime, and the
+bulk data plane is shared memory, not the socket.
+
+Frame bodies are versioned protobuf Envelopes (`ray_tpu/protos/
+wire.proto` via `_private/wire.py`): control data is schema'd and
+language-neutral; Python-only payloads ride an explicit `pickled`
+bytes leaf. A peer with an incompatible wire MAJOR version is refused
+at the first frame, before any pickled leaf is decoded.
+"""
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+from typing import Any, Callable, Optional
+
+from ray_tpu._private.wire import WireVersionError, dumps, loads
+
+_LEN = struct.Struct("<Q")
+
+# Message types (flat namespace; direction noted).
+REGISTER = "register"            # worker -> driver
+TASK = "task"                    # driver -> worker: run a normal task
+ACTOR_CREATE = "actor_create"    # driver -> worker: instantiate actor
+ACTOR_TASK = "actor_task"        # driver -> worker: run actor method
+TASK_DONE = "task_done"          # worker -> driver (reply to TASK/ACTOR_*)
+GET_OBJECT = "get_object"        # worker -> driver
+PUT_OBJECT = "put_object"        # worker -> driver
+WAIT = "wait"                    # worker -> driver
+SUBMIT = "submit"                # worker -> driver: nested task submission
+SUBMIT_ACTOR = "submit_actor"    # worker -> driver: nested actor creation
+SUBMIT_ACTOR_TASK = "submit_actor_task"  # worker -> driver
+KV_OP = "kv_op"                  # worker -> driver: internal KV get/put/del
+DECREF = "decref"                # worker -> driver: ref-count release
+ADDREF = "addref"                # worker -> driver
+SHUTDOWN = "shutdown"            # driver -> worker
+CANCEL_TASK = "cancel_task"      # driver -> worker: interrupt a running task
+UNQUEUE_TASK = "unqueue_task"    # driver -> worker: drop a pipelined task
+                                 #   that has not started (reply ok)
+PING = "ping"                    # either
+REPLY = "reply"                  # either (generic reply)
+STATE_OP = "state_op"            # worker -> driver: state/metrics queries
+
+# ---- multi-host: node agent <-> head (reference raylet <-> GCS,
+# gcs_node_manager.h:62 HandleRegisterNode; ray_syncer.h:88 resource
+# gossip; object_manager.cc node-to-node transfer) ----
+NODE_REGISTER = "node_register"        # agent -> head (reply: node_id)
+NODE_HEARTBEAT = "node_heartbeat"      # agent -> head: resource view
+NODE_ENQUEUE = "node_enqueue"          # head -> agent: spec to queue
+NODE_CANCEL_PENDING = "node_cancel_pending"  # head -> agent (reply found)
+NODE_CANCEL_RUNNING = "node_cancel_running"  # head -> agent
+NODE_KILL_WORKER = "node_kill_worker"  # head -> agent
+NODE_SEND_ACTOR_TASK = "node_send_actor_task"  # head -> agent (reply ok)
+NODE_RESERVE_BUNDLE = "node_reserve_bundle"    # head -> agent (reply ok)
+NODE_RELEASE_BUNDLE = "node_release_bundle"    # head -> agent
+NODE_EVENT = "node_event"              # agent -> head: dispatch/lost/
+                                       #   object_at location registers/...
+NODE_TASK_DONE = "node_task_done"      # agent -> head: control + results
+NODE_DELETE_OBJECT = "node_delete_object"      # head -> agent
+NODE_SHUTDOWN = "node_shutdown"        # head -> agent
+OBJECT_LOOKUP = "object_lookup"        # agent -> head (reply: stored |
+                                       #   location | timeout)
+PULL_OBJECT = "pull_object"            # any -> holder (reply: pull meta)
+PULL_CHUNK = "pull_chunk"              # any -> holder (reply: data)
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+def _auth_token() -> Optional[bytes]:
+    """Shared listener secret (RAY_TPU_AUTH_TOKEN). When set, every
+    accepted connection must present it in a RAW first frame, verified
+    with a constant-time compare BEFORE any frame is unpickled — the
+    wire is pickle, so an unauthenticated peer would otherwise get
+    arbitrary code execution (reference scopes this via gRPC + tokened
+    client/job servers, python/ray/util/client/server/)."""
+    from ray_tpu._private.config import CONFIG
+    tok = CONFIG.auth_token
+    return tok.encode() if tok else None
+
+
+class Connection:
+    """Full-duplex framed-message channel with request/reply correlation."""
+
+    def __init__(self, sock: socket.socket,
+                 handler: Callable[["Connection", dict], None],
+                 on_close: Optional[Callable[["Connection"], None]] = None,
+                 name: str = "", server: bool = False):
+        self._sock = sock
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Bound sends only (recv stays blocking: connections idle for
+        # minutes legitimately): waiter-registry replies run inline on
+        # sealing threads, so a wedged peer (full TCP buffer) must
+        # surface as a ConnectionClosed after this budget instead of
+        # hanging the sender forever — peer-death recovery then runs.
+        try:
+            self._sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                struct.pack("ll", 30, 0))
+        except OSError:
+            pass
+        self._handler = handler
+        self._on_close = on_close
+        self.name = name
+        self._send_lock = threading.Lock()
+        self._rid_counter = itertools.count(1)
+        self._pending: dict[int, _Future] = {}
+        self._pending_lock = threading.Lock()
+        self._closed = threading.Event()
+        self._server = server
+        self.meta: dict = {}  # endpoint-attached metadata (worker id, etc.)
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"ray-tpu-conn-{name}", daemon=True)
+
+    def start(self) -> None:
+        self._reader.start()
+
+    def send_auth(self) -> None:
+        """Client side: present the shared secret as the raw first
+        frame (no-op when auth is disabled)."""
+        token = _auth_token()
+        if token is None:
+            return
+        with self._send_lock:
+            try:
+                self._sock.sendall(_LEN.pack(len(token)) + token)
+            except OSError as e:
+                self.close()
+                raise ConnectionClosed(str(e)) from e
+
+    def _check_auth(self) -> bool:
+        """Server side (reader thread): verify the raw first frame
+        before ANY unpickling. Closes and returns False on mismatch."""
+        token = _auth_token()
+        if token is None:
+            return True
+        try:
+            # hard deadline: a peer that connects and sends nothing
+            # must not pin this thread + fd forever (slowloris)
+            self._sock.settimeout(10.0)
+            header = self._read_exact(_LEN.size)
+            (length,) = _LEN.unpack(header)
+            if length > 4096:           # token frames are tiny
+                raise ConnectionClosed("oversized auth frame")
+            presented = self._read_exact(length)
+            self._sock.settimeout(None)
+        except (ConnectionClosed, OSError):
+            self.close()        # malformed/short/slow: drop the socket
+            return False
+        import hmac
+        if not hmac.compare_digest(presented, token):
+            import sys as _sys
+            _sys.stderr.write(
+                f"ray_tpu: rejected unauthenticated connection "
+                f"({self.name})\n")
+            self.close()
+            return False
+        return True
+
+    # ---- sending ----
+    def send(self, msg: dict) -> None:
+        data = dumps(msg)
+        header = _LEN.pack(len(data))
+        with self._send_lock:
+            try:
+                self._sock.sendall(header + data)
+            except OSError as e:
+                # A failed sendall may have written a PARTIAL frame
+                # (e.g. the SO_SNDTIMEO budget expired mid-write); the
+                # stream is desynced, so the connection must die — a
+                # later send would be parsed as garbage by the peer.
+                self.close()
+                raise ConnectionClosed(str(e)) from e
+
+    def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
+        """Send a request and block for the matching reply."""
+        fut = self.request_async(msg)
+        return fut.result(timeout)
+
+    def request_async(self, msg: dict) -> "_Future":
+        rid = next(self._rid_counter)
+        msg["rid"] = rid
+        fut = _Future()
+        with self._pending_lock:
+            self._pending[rid] = fut
+        try:
+            self.send(msg)
+        except ConnectionClosed:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise
+        return fut
+
+    def reply(self, request_msg: dict, **fields) -> None:
+        self.send({"type": REPLY, "rid": request_msg["rid"], **fields})
+
+    # ---- receiving ----
+    def _read_exact(self, n: int) -> bytes:
+        chunks = []
+        remaining = n
+        while remaining:
+            chunk = self._sock.recv(min(remaining, 1 << 20))
+            if not chunk:
+                raise ConnectionClosed("peer closed")
+            chunks.append(chunk)
+            remaining -= len(chunk)
+        return b"".join(chunks)
+
+    def _read_loop(self) -> None:
+        try:
+            if self._server and not self._check_auth():
+                return
+            while True:
+                header = self._read_exact(_LEN.size)
+                (length,) = _LEN.unpack(header)
+                msg = loads(self._read_exact(length))
+                if msg.get("type") == REPLY:
+                    with self._pending_lock:
+                        fut = self._pending.pop(msg["rid"], None)
+                    if fut is not None:
+                        fut.set(msg)
+                else:
+                    self._handler(self, msg)
+        except (ConnectionClosed, OSError):
+            pass
+        except WireVersionError as e:
+            import sys as _sys
+            _sys.stderr.write(
+                f"ray_tpu: refusing connection ({self.name}): {e}\n")
+        except Exception:  # handler bug; don't kill silently
+            import traceback
+            traceback.print_exc()
+        finally:
+            self.close()     # reader exit = stream dead; release the fd
+            self._closed.set()
+            with self._pending_lock:
+                pending, self._pending = self._pending, {}
+            for fut in pending.values():
+                fut.set_error(ConnectionClosed("connection lost"))
+            if self._on_close is not None:
+                try:
+                    self._on_close(self)
+                except Exception:
+                    pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _Future:
+    """Minimal thread-safe future for reply correlation."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+        self._callbacks: list[Callable[["_Future"], None]] = []
+        self._cb_lock = threading.Lock()
+
+    def add_done_callback(self, fn: Callable[["_Future"], None]) -> None:
+        """Run `fn(self)` when the reply lands (on the reader thread) —
+        relays pipe replies onward without parking a thread. Runs
+        immediately if already done."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _fire(self) -> None:
+        with self._cb_lock:
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            try:
+                fn(self)
+            except Exception:
+                pass
+
+    def set(self, value: Any) -> None:
+        self._value = value
+        self._event.set()
+        self._fire()
+
+    def set_error(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+        self._fire()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("rpc timed out")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+def connect(addr: tuple[str, int],
+            handler: Callable[[Connection, dict], None],
+            on_close: Optional[Callable[[Connection], None]] = None,
+            name: str = "") -> Connection:
+    sock = socket.create_connection(addr)
+    conn = Connection(sock, handler, on_close, name=name)
+    conn.send_auth()             # no-op unless RAY_TPU_AUTH_TOKEN is set
+    conn.start()
+    return conn

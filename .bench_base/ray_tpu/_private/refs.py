@@ -1,0 +1,174 @@
+"""ObjectRef: the distributed future handle.
+
+Parity: reference python/ray/_raylet.pyx ObjectRef + C++ reference counting
+(src/ray/core_worker/reference_count.cc). The protocol is centralized (the
+head's controller owns all refcounts) with a real borrower protocol
+(reference reference_count.h:64,115-117 borrower registration +
+WaitForRefRemoved):
+
+- Deserializing a ref ANYWHERE registers a borrow (ADDREF) and the
+  borrowing process sends a deferred DECREF when its copy is collected —
+  so an actor may store a ref it received inside an argument past the
+  carrying task and the object stays alive until the actor drops it.
+- The submit-time pin covers the window before the borrow registers:
+  the executing worker's ADDREF and the task's TASK_DONE (which releases
+  the pin) travel the same FIFO connection, so the count can never dip
+  to zero between them.
+- Objects CONTAINING refs (a put() of a list of refs, a task returning
+  refs) register containment at seal time: the enclosing object holds a
+  count on each inner ref, released when the enclosing object is
+  deleted (reference reference_count.cc nested-ref ownership).
+
+Known conservatism: a borrowing worker that is SIGKILLed never sends its
+deferred DECREF, so its borrows leak until session shutdown (the
+reference reclaims these via per-borrower death cleanup).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional
+
+from ray_tpu._private import context as _context
+
+# Serialize-time containment capture: object_store.serialize() installs a
+# collector here; ObjectRef.__reduce__ records every ref pickled into the
+# enclosing object so the store can register containment at seal.
+_capture = threading.local()
+
+# Deferred decrefs: __del__ may fire during GC at ANY allocation point —
+# including while the current thread holds a non-reentrant lock that the
+# decref's deletion path needs (store lock, connection send lock), a
+# guaranteed self-deadlock. So __del__ only appends the id here; a
+# dedicated flusher thread performs the actual decref (the reference
+# defers destructor work to the core worker's io service the same way).
+_deferred: collections.deque = collections.deque()
+_flush_wake = threading.Event()
+_flusher_started = False
+_flusher_lock = threading.Lock()
+
+
+def _ensure_flusher() -> None:
+    global _flusher_started
+    if _flusher_started:
+        return
+    with _flusher_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+    threading.Thread(target=_flush_loop, name="rtpu-decref",
+                     daemon=True).start()
+
+
+def _flush_loop() -> None:
+    while True:
+        try:
+            oid = _deferred.popleft()
+        except IndexError:
+            _flush_wake.wait(0.2)
+            _flush_wake.clear()
+            continue
+        ctx = _context.maybe_ctx()
+        if ctx is None:
+            continue
+        try:
+            ctx.decref(oid)
+        except Exception:
+            pass
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owned", "__weakref__")
+
+    def __init__(self, object_id: str, owned: bool = True):
+        self._id = object_id
+        self._owned = owned
+
+    @property
+    def object_id(self) -> str:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self._id})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __hash__(self) -> int:
+        return hash(self._id)
+
+    def __reduce__(self):
+        ids = getattr(_capture, "ids", None)
+        if ids is not None:
+            ids.append(self._id)
+        return (_reconstruct_borrowed, (self._id,))
+
+    def __del__(self):
+        if self._owned and _context.maybe_ctx() is not None:
+            # never decref synchronously from a destructor (see
+            # _deferred above); deque.append is GC-reentrancy-safe
+            _deferred.append(self._id)
+            _flush_wake.set()
+            _ensure_flusher()
+
+    # `await ref` support inside async actors.
+    def __await__(self):
+        def _get():
+            ctx = _context.get_ctx()
+            return ctx.get_objects([self._id], timeout=None)[0]
+        yield
+        return _get()
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+        import threading
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        ref = self
+
+        def _run():
+            ctx = _context.get_ctx()
+            try:
+                fut.set_result(ctx.get_objects([ref._id], timeout=None)[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+        threading.Thread(target=_run, daemon=True).start()
+        return fut
+
+
+def _reconstruct_borrowed(object_id: str) -> ObjectRef:
+    """Deserialization endpoint: register a borrow with the owner (the
+    head) so the ref counts while this process holds it; the ref's
+    __del__ sends the matching deferred decref. Falls back to a
+    non-counting ref in processes without a runtime context (e.g. a
+    relaying node agent)."""
+    ctx = _context.maybe_ctx()
+    if ctx is not None:
+        try:
+            ctx.addref(object_id)
+            return ObjectRef(object_id, owned=True)
+        except Exception:
+            pass
+    return ObjectRef(object_id, owned=False)
+
+
+class ActorID:
+    __slots__ = ("_id",)
+
+    def __init__(self, actor_id: str):
+        self._id = actor_id
+
+    def hex(self) -> str:
+        return self._id
+
+    def __repr__(self) -> str:
+        return f"ActorID({self._id})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ActorID) and other._id == self._id
+
+    def __hash__(self) -> int:
+        return hash(self._id)

@@ -1,0 +1,267 @@
+"""Head-side handle for a remote node agent.
+
+Duck-types the per-node ``Scheduler`` interface the ClusterTaskManager
+and Runtime drive (enqueue / cancel / bundles / resource views /
+actor-task push), but the real scheduler + worker pool live in the
+remote ``node_agent`` process; this proxy forwards over the agent's
+control connection and mirrors routed work so the head can recover it
+if the agent dies (reference: the GCS's per-node bookkeeping in
+gcs_node_manager.h:62 + gcs_actor_manager, which re-places work when a
+raylet is lost).
+
+Resource views (avail / pending demand) come from agent heartbeats —
+the RaySyncer role (reference common/ray_syncer/ray_syncer.h:88):
+scheduling reads a slightly stale snapshot, and the authoritative
+check happens agent-side at dispatch.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from ray_tpu._private import protocol
+from ray_tpu._private.specs import ActorSpec, ActorTaskSpec, TaskSpec
+
+_RPC_TIMEOUT = 30.0
+
+
+class RemoteNodeHandle:
+    def __init__(self, node_id: str, conn: protocol.Connection,
+                 resources: dict[str, float],
+                 advertise_addr: tuple[str, int]):
+        self.node_id = node_id
+        self.conn = conn
+        self.advertise_addr = advertise_addr
+        self.total = dict(resources)
+        self.avail = dict(resources)
+        self._pending_demand: dict[str, float] = {}
+        self._pending_shapes: list[dict[str, float]] = []
+        self._idle = True
+        self._lock = threading.Lock()
+        # Mirror of work routed to this agent, keyed by task_id /
+        # "actor:<id>"; value = (spec, dispatched: bool). drain_for_death
+        # recovers from this when the agent vanishes.
+        self._work: dict[str, tuple[Any, bool]] = {}
+        # worker_id -> actor_id (or None) as reported by dispatch events.
+        self._workers: dict[str, Optional[str]] = {}
+        self._dead = False
+
+    # ------------------------------------------------------- heartbeat
+    def on_heartbeat(self, msg: dict) -> None:
+        with self._lock:
+            self.avail = dict(msg.get("avail", self.avail))
+            self.total = dict(msg.get("total", self.total))
+            self._pending_demand = dict(msg.get("pending_demand", {}))
+            self._pending_shapes = list(msg.get("pending_shapes", []))
+            self._idle = bool(msg.get("is_idle", False))
+            self._last_workers = list(msg.get("workers", []))
+
+    def workers_snapshot(self) -> list:
+        """Worker table rows as of the last heartbeat."""
+        with self._lock:
+            return list(getattr(self, "_last_workers", []))
+
+    # ------------------------------------------- scheduler duck-typing
+    @staticmethod
+    def need_of(spec) -> dict[str, float]:
+        from ray_tpu._private.scheduler import Scheduler
+        return Scheduler.need_of(spec)
+
+    def effective_avail(self) -> dict[str, float]:
+        with self._lock:
+            eff = dict(self.avail)
+            for k, v in self._pending_demand.items():
+                eff[k] = eff.get(k, 0.0) - v
+            return eff
+
+    def pending_shapes(self) -> list[dict[str, float]]:
+        with self._lock:
+            return list(self._pending_shapes)
+
+    def utilization(self) -> float:
+        eff = self.effective_avail()
+        u = 0.0
+        for k, tot in self.total.items():
+            if tot > 0:
+                u = max(u, 1.0 - eff.get(k, 0.0) / tot)
+        return u
+
+    def is_idle(self) -> bool:
+        with self._lock:
+            return self._idle and not self._work
+
+    def owns_worker(self, worker_id: str) -> bool:
+        with self._lock:
+            return worker_id in self._workers
+
+    def worker_for_actor(self, actor_id: str) -> Optional[str]:
+        with self._lock:
+            for wid, aid in self._workers.items():
+                if aid == actor_id:
+                    return wid
+        return None
+
+    # ------------------------------------------------------- submission
+    def _key(self, spec) -> str:
+        if isinstance(spec, ActorSpec):
+            return "actor:" + spec.actor_id
+        return spec.task_id
+
+    def enqueue(self, spec) -> None:
+        with self._lock:
+            self._work[self._key(spec)] = (spec, False)
+        self._send({"type": protocol.NODE_ENQUEUE, "spec": spec})
+
+    enqueue_front = enqueue
+
+    def cancel_pending(self, task_id: str) -> Optional[TaskSpec]:
+        with self._lock:
+            entry = self._work.get(task_id)
+        if entry is None or entry[1]:
+            return None                    # unknown or already running
+        try:
+            rep = self.conn.request({"type": protocol.NODE_CANCEL_PENDING,
+                                     "task_id": task_id},
+                                    timeout=_RPC_TIMEOUT)
+        except (protocol.ConnectionClosed, TimeoutError):
+            return None
+        if rep.get("found"):
+            with self._lock:
+                entry = self._work.pop(task_id, None)
+            return entry[0] if entry else None
+        return None
+
+    def worker_running_task(self, task_id: str):
+        with self._lock:
+            entry = self._work.get(task_id)
+            if entry is None or not entry[1]:
+                return None
+            spec = entry[0]
+            wid = getattr(spec, "_worker_id", None)
+        return (wid, spec) if wid is not None else None
+
+    def cancel_running(self, worker_id: str, task_id: str) -> bool:
+        return self._send({"type": protocol.NODE_CANCEL_RUNNING,
+                           "worker_id": worker_id, "task_id": task_id})
+
+    def kill_worker(self, worker_id: str) -> None:
+        self._send({"type": protocol.NODE_KILL_WORKER,
+                    "worker_id": worker_id})
+
+    def send_actor_task(self, actor_worker_id: str,
+                        spec: ActorTaskSpec) -> bool:
+        """Fire-and-forget push (NO blocking reply: this is called from
+        the agent connection's own reader thread when an actor goes
+        ALIVE, and a request would deadlock against ourselves). If the
+        agent can't deliver (worker gone) it sends an
+        actor_task_undeliverable event and the head requeues."""
+        return self._send({"type": protocol.NODE_SEND_ACTOR_TASK,
+                           "worker_id": actor_worker_id, "spec": spec})
+
+    # -------------------------------------------------------- bundles
+    def reserve_bundle(self, pg_id: str, index: int,
+                       resources: dict[str, float]) -> bool:
+        try:
+            rep = self.conn.request(
+                {"type": protocol.NODE_RESERVE_BUNDLE, "pg_id": pg_id,
+                 "index": index, "resources": resources},
+                timeout=_RPC_TIMEOUT)
+        except (protocol.ConnectionClosed, TimeoutError):
+            return False
+        if rep.get("ok"):
+            # keep the cached view honest until the next heartbeat
+            with self._lock:
+                for k, v in resources.items():
+                    self.avail[k] = self.avail.get(k, 0.0) - v
+            return True
+        return False
+
+    def release_bundle(self, pg_id: str, index: int) -> None:
+        self._send({"type": protocol.NODE_RELEASE_BUNDLE,
+                    "pg_id": pg_id, "index": index})
+
+    # ------------------------------------------------- event ingestion
+    def on_dispatched(self, key: str, worker_id: str,
+                      actor_id: Optional[str] = None) -> None:
+        with self._lock:
+            entry = self._work.get(key)
+            if entry is not None:
+                spec = entry[0]
+                try:
+                    spec._worker_id = worker_id
+                except AttributeError:
+                    pass
+                self._work[key] = (spec, True)
+            self._workers[worker_id] = actor_id
+
+    def on_finished(self, key: str):
+        """Remove + return the mirrored spec (None if unknown)."""
+        with self._lock:
+            entry = self._work.pop(key, None)
+        return entry[0] if entry else None
+
+    def track_live_actor(self, actor_id: str, spec) -> None:
+        """Keep an ALIVE actor in the mirror so drain_for_death can
+        restart it if this agent dies."""
+        with self._lock:
+            self._work["actor:" + actor_id] = (spec, True)
+
+    def on_worker_lost(self, worker_id: str) -> None:
+        with self._lock:
+            self._workers.pop(worker_id, None)
+
+    # -------------------------------------------------------- lifecycle
+    def start(self) -> None:                     # NodeRecord protocol
+        pass
+
+    def drain_for_death(self):
+        """(queued specs, running TaskSpecs, actor ids) from the mirror."""
+        with self._lock:
+            self._dead = True
+            work = list(self._work.values())
+            self._work.clear()
+            self._workers.clear()
+        queued = [s for s, dispatched in work if not dispatched]
+        running = [s for s, dispatched in work
+                   if dispatched and isinstance(s, TaskSpec)]
+        actor_ids = [s.actor_id for s, dispatched in work
+                     if dispatched and isinstance(s, ActorSpec)]
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        return queued, running, actor_ids
+
+    def die_silently(self) -> None:
+        """Test hook parity: drop the control connection without drain
+        (the health monitor must notice)."""
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+    def shutdown(self) -> None:
+        self._send({"type": protocol.NODE_SHUTDOWN})
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "node_id": self.node_id, "remote": True,
+                "total_resources": dict(self.total),
+                "available_resources": dict(self.avail),
+                "num_pending_tasks": len(self._pending_shapes),
+                "mirrored_work": len(self._work),
+            }
+
+    # --------------------------------------------------------- helpers
+    def _send(self, msg: dict) -> bool:
+        try:
+            self.conn.send(msg)
+            return True
+        except protocol.ConnectionClosed:
+            return False

@@ -1,0 +1,1390 @@
+"""Driver-side runtime: the core-worker + head-node composition.
+
+This process plays three reference roles at once (single-node topology):
+- the driver's core worker (reference src/ray/core_worker/core_worker.cc:
+  SubmitTask:2166, CreateActor:2243, Put:1246, Get:1551),
+- the GCS head (tables live in ``Controller``),
+- the raylet (dispatch lives in ``Scheduler``).
+
+Multi-process reality is preserved where it matters — user tasks and actors
+always run in separate worker processes wired over the socket protocol, and
+bulk data rides shared memory — so the concurrency/failure semantics match
+the reference even though control-plane hops are function calls.
+"""
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+from ray_tpu._private import context as _context
+from ray_tpu._private import protocol
+from ray_tpu._private.controller import (ALIVE, DEAD, PENDING, RESTARTING,
+                                         Controller)
+from ray_tpu._private.object_store import LocalStore, StoredObject, deserialize
+from ray_tpu._private.refs import ObjectRef
+from ray_tpu._private.scheduler import Scheduler
+from ray_tpu._private.specs import ActorSpec, ActorTaskSpec, TaskSpec
+from ray_tpu.exceptions import (ActorDiedError, ActorError, GetTimeoutError,
+                                TaskCancelledError, TaskError,
+                                WorkerDiedError)
+
+
+def detect_num_tpu_chips() -> int:
+    """TPU chip detection, reference python/ray/_private/accelerators/tpu.py:98-117
+    (probes /dev/accel* then /dev/vfio), with an env override."""
+    env = os.environ.get("RAY_TPU_CHIPS")
+    if env is not None:
+        return int(env)
+    accel = glob.glob("/dev/accel*")
+    if accel:
+        return len(accel)
+    vfio = glob.glob("/dev/vfio/[0-9]*")
+    if vfio:
+        return len(vfio)
+    return 0
+
+
+def _summarize_by_state(rows: list) -> dict:
+    out: dict[str, int] = {}
+    for r in rows:
+        out[r.get("state", "?")] = out.get(r.get("state", "?"), 0) + 1
+    return out
+
+
+class _ActorState:
+    """Driver-side actor-task routing state (actor_task_submitter.cc parity:
+    per-actor ordered queue while the actor is pending/restarting, inflight
+    tracking for failure handling)."""
+
+    def __init__(self):
+        self.queued: list[ActorTaskSpec] = []
+        self.inflight: dict[str, ActorTaskSpec] = {}
+        self.lock = threading.Lock()
+
+
+class Runtime(_context.BaseContext):
+    is_driver = True
+
+    def __init__(self, num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[dict] = None,
+                 max_workers: Optional[int] = None,
+                 namespace: str = "default",
+                 bind_host: Optional[str] = None,
+                 port: Optional[int] = None,
+                 labels: Optional[dict] = None):
+        self.namespace = namespace
+        self._started_at = time.time()
+        self._head_labels = {k: str(v) for k, v in (labels or {}).items()}
+        self.controller = Controller()
+        # capacity via RAY_TPU_OBJECT_STORE_MEMORY (bytes); spill policy
+        # must never touch objects pinned by in-flight tasks.
+        self.store = LocalStore(pinned_fn=self.controller.pinned_ids)
+        from concurrent.futures import ThreadPoolExecutor
+        from ray_tpu._private.object_transfer import PullServer
+        from ray_tpu._private.waiters import WaiterRegistry
+        # Blocked worker gets/waits park here (no thread each); the
+        # store's seal hook resolves them. "Present" means a local copy
+        # OR a known remote location (multi-host). Spill restores and
+        # remote pulls run on a small pool so disk reads / network
+        # fetches never block connection reader threads.
+        self.waiters = WaiterRegistry(
+            lambda oid: (self.store.contains(oid)
+                         or self.controller.has_location(oid)))
+        self.store.on_seal = self.waiters.notify
+        self._restore_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="rtpu-restore")
+        self._pull_server = PullServer(self.store,
+                                       executor=self._restore_pool)
+        self._shutdown = False
+        self._actor_states: dict[str, _ActorState] = {}
+        self._actor_lock = threading.Lock()
+
+        if num_cpus is None:
+            num_cpus = float(max(os.cpu_count() or 1, 4))
+        if num_tpus is None:
+            num_tpus = float(detect_num_tpu_chips())
+        node_res = {"CPU": float(num_cpus)}
+        if num_tpus:
+            node_res["TPU"] = float(num_tpus)
+        from ray_tpu._private.config import CONFIG as _CFG
+        node_res["memory"] = float(
+            os.environ.get("RAY_TPU_NODE_MEMORY")    # legacy name
+            or _CFG.node_memory_bytes)
+        if resources:
+            node_res.update({k: float(v) for k, v in resources.items()})
+
+        from ray_tpu._private.config import CONFIG as _CFG2
+        bind = bind_host or _CFG2.bind_host
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((bind, int(port or _CFG2.port)))
+        self._listener.listen(128)
+        self.address = self._listener.getsockname()
+
+        from ray_tpu._private.cluster import ClusterTaskManager
+        self.cluster = ClusterTaskManager(self)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ray-tpu-accept", daemon=True)
+        self._accept_thread.start()
+        head = self.cluster.add_node(node_res, max_workers=max_workers,
+                                     is_head=True,
+                                     labels=self._head_labels)
+        self.head_node_id = head.node_id
+        self._init_head_persistence()
+
+    # ================= head fault tolerance =================
+    def _init_head_persistence(self) -> None:
+        """Reference GCS persistence (gcs_server_main.cc:26-33 storage
+        backend + gcs_init_data.cc rehydration): when
+        RAY_TPU_HEAD_SNAPSHOT_PATH is set, restore controller tables
+        from the snapshot if one exists, then snapshot periodically."""
+        from ray_tpu._private.config import CONFIG as _CFG
+        self._snapshot_path = _CFG.head_snapshot_path or None
+        if self._snapshot_path is None:
+            return
+        if os.path.exists(self._snapshot_path):
+            try:
+                self._rehydrate(self._snapshot_path)
+            except Exception:
+                log.exception("head snapshot restore failed; "
+                              "starting with empty tables")
+        self._snapshot_thread = threading.Thread(
+            target=self._snapshot_loop, name="rtpu-head-snapshot",
+            daemon=True)
+        self._snapshot_thread.start()
+
+    def _snapshot_loop(self) -> None:
+        from ray_tpu._private.config import CONFIG as _CFG
+        period = max(0.1, _CFG.head_snapshot_period_s)
+        while not self._shutdown:
+            time.sleep(period)
+            try:
+                self.snapshot_now()
+            except Exception:
+                log.exception("head snapshot failed")
+
+    def snapshot_now(self) -> None:
+        """Atomic controller snapshot to disk (tmp + rename)."""
+        if self._snapshot_path is None or self._shutdown:
+            return
+        blob = self.controller.snapshot_state()
+        tmp = self._snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._snapshot_path)
+
+    def _rehydrate(self, path: str) -> None:
+        """Restore controller tables, then reconcile: agents recorded
+        alive get a rejoin grace window; actors whose node died with the
+        old head (head-local workers, unknown nodes) are restarted
+        through the normal recovery machinery."""
+        from ray_tpu._private.config import CONFIG as _CFG
+        with open(path, "rb") as f:
+            blob = f.read()
+        self.controller.restore_state(blob)
+        rejoining: set[str] = set()
+        for n in self.controller.list_nodes():
+            if n["is_head"] or not n["alive"]:
+                continue
+            rejoining.add(n["node_id"])
+            self.cluster.expect_rejoin(n["node_id"],
+                                       _CFG.node_rejoin_grace_s)
+        self.cluster.restore_pgs(self.controller.list_pgs())
+        for info in self.controller.list_actors():
+            rec = self.controller.get_actor(info["actor_id"])
+            if rec is None or rec.state == DEAD:
+                continue
+            if rec.node_id in rejoining:
+                continue            # its worker may still be alive there
+            # worker died with the old head: normal restart bookkeeping
+            rec.worker_id = None
+            self._recover_actor(rec.spec.actor_id)
+        log.info("head rehydrated from %s: %d actors, %d nodes pending "
+                 "rejoin", path, len(self.controller.list_actors()),
+                 len(rejoining))
+
+    def _process_rejoin(self, rec, msg: dict) -> None:
+        """An agent re-registered after a head restart (or reconnect):
+        re-attach its live actors and re-learn its object copies."""
+        proxy = rec.scheduler
+        node_id = rec.node_id
+        for oid, nbytes in msg.get("objects", ()):
+            self.controller.add_location(oid, node_id, nbytes)
+            self.waiters.notify(oid)
+        reported = dict(msg.get("live_actors", {}))
+        for actor_id, worker_id in reported.items():
+            arec = self.controller.get_actor(actor_id)
+            if arec is None or arec.state == DEAD:
+                continue
+            if arec.node_id != node_id:
+                # already recovered elsewhere while this agent was away
+                # (transient disconnect): the agent's copy is stale —
+                # kill it, or two instances of one actor run forever
+                proxy.kill_worker(worker_id)
+                continue
+            proxy.on_dispatched("actor:" + actor_id, worker_id,
+                                actor_id=actor_id)
+            proxy.track_live_actor(actor_id, arec.spec)
+            self.controller.set_actor_state(actor_id, ALIVE,
+                                            worker_id=worker_id,
+                                            node_id=node_id)
+            self._flush_actor_queue(actor_id)
+        # actors the tables place on this node but the agent did NOT
+        # report: their workers died while no head was watching —
+        # recover them or their callers hang forever
+        for actor_id in self.controller.actors_on_node(node_id):
+            if actor_id not in reported:
+                self._recover_actor(actor_id)
+
+    @property
+    def scheduler(self):
+        """The head node's scheduler (single-node compatibility view)."""
+        rec = self.cluster.get_node(self.head_node_id)
+        return rec.scheduler if rec else None
+
+    def _scheduler_for_worker(self, worker_id: str):
+        return self.cluster.scheduler_for_worker(worker_id)
+
+    # ================= connection plumbing =================
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            conn = protocol.Connection(sock, self._handle_msg,
+                                       self._on_conn_closed, name="driver",
+                                       server=True)
+            conn.start()
+
+    def _on_conn_closed(self, conn: protocol.Connection) -> None:
+        if self._shutdown:
+            return
+        nid = conn.meta.get("node_id")
+        if nid is not None:
+            # an agent's control connection dropped: node death — unless
+            # the agent already re-registered on a NEW connection (the
+            # old conn's close callback can arrive after the rejoin)
+            rec = self.cluster.get_node(nid)
+            if rec is not None and getattr(rec.scheduler, "conn",
+                                           None) is not conn:
+                return
+            self.cluster._on_node_death(nid, cause="agent disconnected")
+            return
+        wid = conn.meta.get("worker_id")
+        if wid is None:
+            return
+        sched = self._scheduler_for_worker(wid)
+        if sched is None:
+            return
+        tasks, actor_id = sched.on_worker_lost(wid)
+        for task in tasks:
+            self._recover_task(task)
+        if actor_id is not None:
+            self._recover_actor(actor_id)
+
+    # ================= failure recovery =================
+    def _recover_task(self, spec: TaskSpec) -> None:
+        """Reference parity: task retries on worker failure
+        (task_manager.cc retry bookkeeping; max_retries option)."""
+        if getattr(spec, "cancelled", False):
+            self._store_error(spec.return_ids, TaskError(
+                TaskCancelledError(spec.task_id), task_name=spec.name))
+            self._unpin(spec.pinned_refs)
+            self.controller.record_task_event(
+                spec.task_id, spec.name, "CANCELLED")
+            return
+        if spec.retries_used < spec.max_retries:
+            spec.retries_used += 1
+            self.controller.record_task_event(
+                spec.task_id, spec.name, "RETRYING")
+            self.cluster.submit(spec)
+        else:
+            err = TaskError(WorkerDiedError(
+                f"worker died running task {spec.name or spec.task_id}"),
+                task_name=spec.name)
+            self._store_error(spec.return_ids, err)
+            self._unpin(spec.pinned_refs)
+            self.controller.record_task_event(
+                spec.task_id, spec.name, "FAILED", error="worker died")
+
+    def _recover_actor(self, actor_id: str) -> None:
+        """GcsActorManager restart-on-failure parity
+        (gcs_actor_manager.h:89-91 max_restarts bookkeeping)."""
+        rec = self.controller.get_actor(actor_id)
+        if rec is None or rec.state == DEAD:
+            return
+        st = self._actor_state(actor_id)
+        with st.lock:
+            inflight = list(st.inflight.values())
+            st.inflight.clear()
+        can_restart = (rec.spec.max_restarts < 0
+                       or rec.num_restarts < rec.spec.max_restarts)
+        if can_restart:
+            rec.num_restarts += 1
+            self.controller.set_actor_state(actor_id, RESTARTING)
+            retried = []
+            for t in inflight:           # preserve submission order
+                if t.retries_used < t.max_retries:
+                    t.retries_used += 1
+                    retried.append(t)
+                else:
+                    self._store_error(t.return_ids, TaskError(
+                        ActorError(actor_id, "actor restarting; task lost"),
+                        task_name=t.name))
+            with st.lock:
+                st.queued[:0] = retried
+            self.cluster.submit(rec.spec)
+        else:
+            self.controller.set_actor_state(actor_id, DEAD,
+                                            death_cause="worker died")
+            with st.lock:
+                dead_tasks = inflight + st.queued
+                st.queued = []
+            for t in dead_tasks:
+                self._store_error(t.return_ids, TaskError(
+                    ActorDiedError(actor_id, f"Actor {actor_id} is dead"),
+                    task_name=t.name))
+
+    def _store_error(self, return_ids: list[str], err: BaseException) -> None:
+        from ray_tpu._private.object_store import reap_object_segments
+        for oid in return_ids:
+            # a killed worker may have sealed result buffers for these
+            # ids without delivering TASK_DONE; reap them or they leak
+            # until host reboot (shm persists past process death)
+            reap_object_segments(oid)
+            self.store.put(err, object_id=oid)
+
+    def on_unplaceable(self, spec, reason: str) -> None:
+        """Cluster callback: a spec can never be placed (e.g. hard node
+        affinity to a dead node). Fail fast rather than hang."""
+        from ray_tpu._private.specs import ActorSpec as _ActorSpec
+        if isinstance(spec, _ActorSpec):
+            self.controller.set_actor_state(spec.actor_id, DEAD,
+                                            death_cause=reason)
+            st = self._actor_state(spec.actor_id)
+            with st.lock:
+                dead = st.queued + list(st.inflight.values())
+                st.queued = []
+                st.inflight.clear()
+            for t in dead:
+                self._store_error(t.return_ids, TaskError(
+                    ActorDiedError(spec.actor_id, reason),
+                    task_name=t.name))
+            return
+        self._store_error(spec.return_ids, TaskError(
+            WorkerDiedError(f"task unplaceable: {reason}"),
+            task_name=spec.name))
+        self._unpin(spec.pinned_refs)
+        self.controller.record_task_event(spec.task_id, spec.name,
+                                          "FAILED", error=reason)
+
+    def _unpin(self, object_ids: list[str]) -> None:
+        for oid in object_ids:
+            if self.controller.unpin(oid):
+                self._delete_everywhere(oid)
+
+    def _seal_contained(self, object_id: str, ids: list[str]) -> None:
+        """Register nested-ref containment for a sealed object; inner
+        refs released by a refresh (lineage reseal with fresh ids) go
+        through the full deletion path."""
+        for cid in self.controller.register_contained(object_id, ids):
+            self.decref(cid)
+
+    # ================= scheduler callbacks =================
+    def on_task_dispatched(self, spec: TaskSpec, worker_id: str) -> None:
+        self.controller.record_task_event(
+            spec.task_id, spec.name, "RUNNING", worker_id=worker_id)
+
+    def on_actor_dispatched(self, spec: ActorSpec, worker_id: str) -> None:
+        sched = self._scheduler_for_worker(worker_id)
+        self.controller.set_actor_state(
+            spec.actor_id, PENDING, worker_id=worker_id,
+            node_id=getattr(sched, "node_id", None))
+
+    # ================= message handlers =================
+    def _handle_msg(self, conn: protocol.Connection, msg: dict) -> None:
+        mtype = msg["type"]
+        if mtype == protocol.REGISTER:
+            sched = self._scheduler_for_worker(msg["worker_id"])
+            if sched is not None:
+                sched.on_worker_registered(msg["worker_id"], conn)
+            else:
+                conn.close()              # worker from a dead/old node
+        elif mtype == protocol.TASK_DONE:
+            self._on_task_done(conn, msg)
+        elif mtype == protocol.GET_OBJECT:
+            self._on_get_object(conn, msg)
+        elif mtype == protocol.WAIT:
+            self._on_wait(conn, msg)
+        elif mtype == protocol.PUT_OBJECT:
+            stored: StoredObject = msg["stored"]
+            self._seal_contained(stored.object_id, stored.contained_ids)
+            self.store.put_stored(stored)
+            self.controller.addref(stored.object_id)
+            # producer-side backpressure hint: the WORKER throttles its
+            # own puts (blocking this reader thread would stall the
+            # completions that release pins)
+            conn.reply(msg, ok=True,
+                       pressure=self.store.over_capacity())
+        elif mtype == protocol.SUBMIT:
+            spec: TaskSpec = msg["spec"]
+            if msg.get("func_bytes") is not None:
+                self.controller.put_function(spec.func_id, msg["func_bytes"])
+            self.submit_spec(spec)
+            conn.reply(msg, ok=True)
+        elif mtype == protocol.SUBMIT_ACTOR:
+            aspec: ActorSpec = msg["spec"]
+            if msg.get("class_bytes") is not None:
+                self.controller.put_function(aspec.class_id,
+                                             msg["class_bytes"])
+            self.create_actor_from_spec(aspec)
+            conn.reply(msg, ok=True)
+        elif mtype == protocol.SUBMIT_ACTOR_TASK:
+            self.submit_actor_task_spec(msg["actor_id"], msg["spec"])
+            conn.reply(msg, ok=True)
+        elif mtype == protocol.KV_OP:
+            conn.reply(msg, value=self._kv_dispatch(msg))
+        elif mtype == protocol.DECREF:
+            self.decref(msg["object_id"])
+        elif mtype == protocol.ADDREF:
+            self.controller.addref(msg["object_id"])
+        elif mtype == protocol.STATE_OP:
+            from ray_tpu._private.pubsub import StaleCursorError
+            kwargs = msg.get("kwargs", {})
+            try:
+                if (msg["op"] == "pubsub_poll"
+                        and kwargs.get("timeout")):
+                    # long-poll parks in the publisher's waiter list and
+                    # replies on publish/expiry — NEVER blocks this
+                    # reader thread (it carries the subscriber's other
+                    # traffic)
+                    def _reply(msgs, cursor, conn=conn, msg=msg):
+                        try:
+                            conn.reply(msg, value=(msgs, cursor))
+                        except protocol.ConnectionClosed:
+                            pass
+                    self.controller.pubsub.add_waiter(
+                        kwargs["channel"], kwargs.get("cursor", 0),
+                        float(kwargs["timeout"]), _reply)
+                else:
+                    conn.reply(msg, value=self.state_op(
+                        msg["op"], **kwargs))
+            except StaleCursorError as e:
+                # one contract across transports: the client-side
+                # state_op re-raises this as StaleCursorError(resync=N)
+                conn.reply(msg, value=None, stale=True,
+                           resync=getattr(e, "resync", 0),
+                           detail=str(e))
+        elif mtype == protocol.NODE_REGISTER:
+            rec = self.cluster.add_remote_node(
+                conn, msg["resources"], labels=msg.get("labels"),
+                advertise_addr=tuple(msg["advertise_addr"]),
+                node_id=msg.get("node_id"))
+            conn.meta["node_id"] = rec.node_id
+            if msg.get("rejoin"):
+                self._process_rejoin(rec, msg)
+            conn.reply(msg, node_id=rec.node_id)
+        elif mtype == protocol.NODE_HEARTBEAT:
+            nid = msg["node_id"]
+            self.cluster.heartbeat(nid)
+            rec = self.cluster.get_node(nid)
+            if rec is not None:
+                rec.scheduler.on_heartbeat(msg)
+            if "host_stats" in msg:
+                self.controller.update_host_stats(nid, msg["host_stats"])
+        elif mtype == protocol.NODE_EVENT:
+            self._on_node_event(conn, msg)
+        elif mtype == protocol.NODE_TASK_DONE:
+            self._on_node_task_done(conn, msg)
+        elif mtype == protocol.OBJECT_LOOKUP:
+            self._on_object_lookup(conn, msg)
+        elif mtype == protocol.PULL_OBJECT:
+            self._pull_server.handle_pull(conn, msg)
+        elif mtype == protocol.PULL_CHUNK:
+            self._pull_server.handle_chunk(conn, msg)
+        elif mtype == protocol.PING:
+            conn.reply(msg, ok=True)
+
+    def _on_task_done(self, conn: protocol.Connection, msg: dict) -> None:
+        results: list[StoredObject] = msg.get("results", [])
+        for stored in results:
+            self._seal_contained(stored.object_id, stored.contained_ids)
+            self.store.put_stored(stored)
+            # Fire-and-forget results whose refs were already dropped must
+            # be evicted here, or they accumulate until shutdown.
+            if self.controller.unreferenced(stored.object_id):
+                self._delete_everywhere(stored.object_id)
+        worker_id = conn.meta.get("worker_id", "")
+        wsched = self._scheduler_for_worker(worker_id)
+        if msg.get("is_actor_create"):
+            actor_id = msg["actor_id"]
+            if wsched is not None:
+                wsched.actor_ready(worker_id)
+            if msg.get("error"):
+                rec = self.controller.get_actor(actor_id)
+                if rec is not None:
+                    rec.spec.max_restarts = 0  # init failure is terminal
+                self.controller.set_actor_state(
+                    actor_id, DEAD, death_cause="creation failed")
+                st = self._actor_state(actor_id)
+                with st.lock:
+                    dead = st.queued
+                    st.queued = []
+                cause = msg.get("error_repr", "actor __init__ raised")
+                for t in dead:
+                    self._store_error(t.return_ids, TaskError(
+                        ActorDiedError(actor_id, cause), task_name=t.name))
+            else:
+                self.controller.set_actor_state(
+                    actor_id, ALIVE, worker_id=worker_id,
+                    node_id=getattr(wsched, "node_id", None))
+                self._flush_actor_queue(actor_id)
+            return
+        task_id = msg["task_id"]
+        if msg.get("is_actor_task"):
+            st = self._actor_states.get(msg.get("actor_id", ""))
+            if st is not None:
+                with st.lock:
+                    spec = st.inflight.pop(task_id, None)
+                if spec is not None:
+                    self._unpin(spec.pinned_refs)
+            state = "FAILED" if msg.get("error") else "FINISHED"
+            self.controller.record_task_event(task_id, msg.get("name", ""),
+                                              state, worker_id=worker_id)
+            return
+        spec = (wsched.task_finished(worker_id, task_id)
+                if wsched is not None else None)
+        if spec is not None:
+            self._unpin(spec.pinned_refs)
+            state = "FAILED" if msg.get("error") else "FINISHED"
+            self.controller.record_task_event(spec.task_id, spec.name, state,
+                                              worker_id=worker_id)
+
+    # ================= node-agent message handlers =================
+    def _proxy_for(self, node_id: str):
+        rec = self.cluster.get_node(node_id)
+        return rec.scheduler if rec is not None else None
+
+    def _on_node_event(self, conn: protocol.Connection, msg: dict) -> None:
+        kind = msg["kind"]
+        proxy = self._proxy_for(msg["node_id"])
+        if kind == "task_dispatched":
+            if proxy is not None:
+                proxy.on_dispatched(msg["key"], msg["worker_id"])
+            self.controller.record_task_event(
+                msg["key"], msg.get("name", ""), "RUNNING",
+                worker_id=msg["worker_id"])
+        elif kind == "actor_dispatched":
+            if proxy is not None:
+                proxy.on_dispatched(msg["key"], msg["worker_id"],
+                                    actor_id=msg["actor_id"])
+            self.controller.set_actor_state(msg["actor_id"], PENDING,
+                                            worker_id=msg["worker_id"],
+                                            node_id=msg["node_id"])
+        elif kind == "worker_lost":
+            if proxy is not None:
+                proxy.on_worker_lost(msg["worker_id"])
+            for task in msg.get("tasks", ()):
+                if proxy is not None:
+                    proxy.on_finished(task.task_id)
+                self._recover_task(task)
+            actor_id = msg.get("actor_id")
+            if actor_id is not None:
+                if proxy is not None:
+                    proxy.on_finished("actor:" + actor_id)
+                self._recover_actor(actor_id)
+        elif kind == "unplaceable":
+            if proxy is not None:
+                proxy.on_finished(proxy._key(msg["spec"]))
+            self.on_unplaceable(msg["spec"], msg["reason"])
+        elif kind == "object_at":
+            self._seal_contained(msg["object_id"],
+                                 msg.get("contained", []))
+            if msg.get("addref"):
+                self.controller.addref(msg["object_id"])
+            self.controller.add_location(msg["object_id"], msg["node_id"],
+                                         msg.get("nbytes", 0))
+            self.waiters.notify(msg["object_id"])
+        elif kind == "location_gone":
+            holder = msg.get("holder")
+            if holder:
+                self.controller.remove_location(msg["object_id"], holder)
+        elif kind == "actor_task_undeliverable":
+            # the agent couldn't hand the pushed task to its worker
+            # (worker died in the gap): requeue unless recovery already
+            # claimed it (mirrors the local send-failure path)
+            spec = msg["spec"]
+            st = self._actor_state(msg["actor_id"])
+            with st.lock:
+                if st.inflight.pop(spec.task_id, None) is not None:
+                    st.queued.append(spec)
+
+    def _on_node_task_done(self, conn: protocol.Connection,
+                           msg: dict) -> None:
+        """NODE_TASK_DONE: the control half of a remote TASK_DONE. Bulk
+        results either arrived inline (small / errors) or stayed in the
+        agent's store with a location registered here."""
+        node_id = msg["node_id"]
+        proxy = self._proxy_for(node_id)
+        for stored in msg.get("inline", []):
+            self._seal_contained(stored.object_id, stored.contained_ids)
+            self.store.put_stored(stored)
+            if self.controller.unreferenced(stored.object_id):
+                self._delete_everywhere(stored.object_id)
+        for oid, nbytes, contained in msg.get("located", []):
+            self._seal_contained(oid, contained)
+            self.controller.add_location(oid, node_id, nbytes)
+            self.waiters.notify(oid)
+        worker_id = msg.get("worker_id", "")
+        if msg.get("is_actor_create"):
+            actor_id = msg["actor_id"]
+            if proxy is not None:
+                proxy.on_finished("actor:" + actor_id)
+                # keep the actor's mirror entry: restarts need the spec
+                rec0 = self.controller.get_actor(actor_id)
+                if rec0 is not None and not msg.get("error"):
+                    proxy.track_live_actor(actor_id, rec0.spec)
+            if msg.get("error"):
+                rec = self.controller.get_actor(actor_id)
+                if rec is not None:
+                    rec.spec.max_restarts = 0
+                self.controller.set_actor_state(
+                    actor_id, DEAD, death_cause="creation failed")
+                st = self._actor_state(actor_id)
+                with st.lock:
+                    dead = st.queued
+                    st.queued = []
+                cause = msg.get("error_repr", "actor __init__ raised")
+                for t in dead:
+                    self._store_error(t.return_ids, TaskError(
+                        ActorDiedError(actor_id, cause), task_name=t.name))
+            else:
+                self.controller.set_actor_state(actor_id, ALIVE,
+                                                worker_id=worker_id,
+                                                node_id=node_id)
+                self._flush_actor_queue(actor_id)
+            return
+        task_id = msg["task_id"]
+        if msg.get("is_actor_task"):
+            st = self._actor_states.get(msg.get("actor_id", ""))
+            if st is not None:
+                with st.lock:
+                    spec = st.inflight.pop(task_id, None)
+                if spec is not None:
+                    self._unpin(spec.pinned_refs)
+            state = "FAILED" if msg.get("error") else "FINISHED"
+            self.controller.record_task_event(task_id, msg.get("name", ""),
+                                              state, worker_id=worker_id)
+            return
+        spec = proxy.on_finished(task_id) if proxy is not None else None
+        if spec is not None:
+            self._unpin(spec.pinned_refs)
+            state = "FAILED" if msg.get("error") else "FINISHED"
+            self.controller.record_task_event(spec.task_id, spec.name,
+                                              state, worker_id=worker_id)
+
+    def _on_object_lookup(self, conn: protocol.Connection,
+                          msg: dict) -> None:
+        """An agent asks where an object lives; parks here until it
+        exists anywhere (the head owns waiter parking cluster-wide)."""
+        oid = msg["object_id"]
+
+        def answer(w=None, timed_out: bool = False) -> None:
+            try:
+                if timed_out:
+                    conn.reply(msg, stored=None, location=None)
+                    return
+                stored = self.store.get_stored(oid, timeout=0,
+                                               restore=False)
+                if stored is None and self.store.contains(oid):
+                    # spilled head-side: restore off-thread, then serve
+                    self._restore_pool.submit(self._lookup_restore_reply,
+                                              conn, msg, oid)
+                    return
+                if stored is not None:
+                    from ray_tpu._private.config import CONFIG as _C
+                    from ray_tpu._private.object_transfer import materialize
+                    if stored.nbytes <= _C.remote_inline_max_bytes:
+                        conn.reply(msg, stored=materialize(stored))
+                    else:
+                        conn.reply(msg, stored=None, head_pull=True)
+                    return
+                locs = self.controller.locations(oid)
+                alive = {n.node_id: n for n in self.cluster.alive_nodes()}
+                for nid in locs:
+                    rec = alive.get(nid)
+                    addr = getattr(rec.scheduler, "advertise_addr",
+                                   None) if rec else None
+                    if addr is not None:
+                        conn.reply(msg, stored=None,
+                                   location={"host": addr[0],
+                                             "port": addr[1],
+                                             "node_id": nid})
+                        return
+                conn.reply(msg, stored=None, location=None)
+            except protocol.ConnectionClosed:
+                pass
+
+        if (self.store.contains(oid)
+                or self.controller.has_location(oid)):
+            answer()
+            return
+        self.waiters.add_get(oid, lambda w, to: answer(w, to),
+                             msg.get("timeout"))
+
+    def _lookup_restore_reply(self, conn, msg, oid: str) -> None:
+        from ray_tpu._private.config import CONFIG as _C
+        from ray_tpu._private.object_transfer import materialize
+        try:
+            stored = self.store.get_stored(oid, timeout=30)
+            if stored is None:
+                conn.reply(msg, stored=None, location=None)
+            elif stored.nbytes <= _C.remote_inline_max_bytes:
+                conn.reply(msg, stored=materialize(stored))
+            else:
+                conn.reply(msg, stored=None, head_pull=True)
+        except protocol.ConnectionClosed:
+            pass
+
+    def _on_get_object(self, conn: protocol.Connection, msg: dict) -> None:
+        """Event-driven get: a fast residency probe on the reader
+        thread; on miss the request parks in the waiter registry (no
+        thread) and the put_stored seal hook resolves it. Spilled
+        objects restore on a small worker pool so the disk read never
+        runs on a connection reader thread."""
+        oid = msg["object_id"]
+        stored = self.store.get_stored(oid, timeout=0, restore=False)
+        if stored is not None:
+            conn.reply(msg, stored=stored)
+            return
+        timeout = msg.get("timeout")
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        wid = conn.meta.get("worker_id")
+        wsched = self._scheduler_for_worker(wid) if wid else None
+        if self.store.contains(oid) or self.controller.has_location(oid):
+            self._restore_pool.submit(
+                self._blocking_get_reply, conn, msg, oid, deadline,
+                wsched, wid)
+            return
+        self._park_get(conn, msg, oid, deadline, wsched, wid)
+
+    def _park_get(self, conn, msg, oid, deadline: Optional[float],
+                  wsched, wid) -> None:
+        """Park a get in the waiter registry until the object seals
+        locally or a location registers; resolution routes any actual
+        disk/network work back to the restore pool."""
+        if wsched is not None:
+            wsched.worker_blocked(wid)
+        remaining = (None if deadline is None
+                     else max(0.0, deadline - time.monotonic()))
+
+        def reply(w, timed_out: bool) -> None:
+            try:
+                if timed_out:
+                    conn.reply(msg, stored=None, timeout=True)
+                    return
+                got = self.store.get_stored(oid, timeout=0, restore=False)
+                if got is not None:
+                    conn.reply(msg, stored=got)
+                elif (self.store.contains(oid)
+                      or self.controller.has_location(oid)):
+                    # spilled or remote: remaining budget only
+                    self._restore_pool.submit(
+                        self._blocking_get_reply, conn, msg, oid,
+                        deadline, wsched, wid)
+                else:
+                    # sealed then evicted in the gap: genuine miss
+                    conn.reply(msg, stored=None, timeout=True)
+            except protocol.ConnectionClosed:
+                pass
+
+        self.waiters.add_get(
+            oid, reply, remaining,
+            on_done=((lambda: wsched.worker_unblocked(wid))
+                     if wsched is not None else None))
+
+    def _blocking_get_reply(self, conn, msg, oid,
+                            deadline: Optional[float],
+                            wsched=None, wid=None) -> None:
+        """Restore/pull-pool path: does only work that is actionable NOW
+        (spill restore, remote pull). If the object becomes truly absent
+        — stale location dropped, nothing local — the request goes BACK
+        to the waiter registry instead of parking a pool thread: the
+        2-thread pool must never be consumed by indefinite waits. The
+        worker stays marked blocked while we do actual work here
+        (oversubscription parity with the old thread-per-get path)."""
+        if wsched is not None:
+            wsched.worker_blocked(wid)
+        try:
+            while True:
+                got = self.store.get_stored(oid, timeout=0)
+                if got is not None:
+                    conn.reply(msg, stored=got)
+                    return
+                if self.controller.has_location(oid):
+                    got = self._pull_remote(oid)
+                    if got is not None:
+                        conn.reply(msg, stored=got)
+                        return
+                    continue            # stale location dropped; re-check
+                if (deadline is not None
+                        and time.monotonic() >= deadline):
+                    conn.reply(msg, stored=None, timeout=True)
+                    return
+                # nothing actionable: hand back to the registry
+                self._park_get(conn, msg, oid, deadline, wsched, wid)
+                return
+        except protocol.ConnectionClosed:
+            pass
+        finally:
+            if wsched is not None:
+                wsched.worker_unblocked(wid)
+
+    # ================= cross-host object fetch =================
+    def _get_stored_anywhere(self, oid: str,
+                             timeout: Optional[float]) -> Optional[
+                                 StoredObject]:
+        """Blocking fetch that spans the cluster: local store (incl.
+        spill restore), else chunked pull from whichever alive agent
+        holds a copy (reference pull_manager.cc role). Stale locations
+        (holder died/evicted) are dropped and the wait resumes, which
+        gives lineage resubmission time to regenerate the object."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            stored = self.store.get_stored(oid, timeout=0)
+            if stored is not None:
+                return stored
+            if self.controller.has_location(oid):
+                stored = self._pull_remote(oid)
+                if stored is not None:
+                    return stored
+                continue                 # stale location dropped; retry
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                return None
+            ev = threading.Event()
+            self.waiters.add_get(oid, lambda w, to: ev.set(), remaining)
+            ev.wait(None if remaining is None else remaining + 1)
+            if deadline is not None and time.monotonic() > deadline:
+                # one last probe: the seal may have raced the deadline
+                stored = self.store.get_stored(oid, timeout=0)
+                if stored is not None:
+                    return stored
+                if not self.controller.has_location(oid):
+                    return None
+
+    def _pull_remote(self, oid: str) -> Optional[StoredObject]:
+        """Pull one object from any alive agent holding it; caches the
+        bytes in the head store (LRU/spill governs them from there).
+        Returns None after dropping every stale location."""
+        from ray_tpu._private.object_transfer import pull_object
+        for nid in self.controller.locations(oid):
+            rec = self.cluster.get_node(nid)
+            if rec is None or not rec.alive:
+                self.controller.remove_location(oid, nid)
+                continue
+            conn = getattr(rec.scheduler, "conn", None)
+            if conn is None:       # local in-process node: nothing to pull
+                self.controller.remove_location(oid, nid)
+                continue
+            try:
+                stored = pull_object(conn, oid)
+            except (protocol.ConnectionClosed, TimeoutError):
+                stored = None
+            if stored is not None:
+                self.store.put_stored(stored)
+                return stored
+            self.controller.remove_location(oid, nid)
+        return None
+
+    def _delete_everywhere(self, oid: str) -> None:
+        """Deletion fan-out: local store + every agent holding a copy.
+        Releases the counts this object held on refs pickled inside it
+        (nested-ref ownership), cascading deletes as counts hit zero."""
+        self.store.delete(oid)
+        for cid in self.controller.pop_contained(oid):
+            self.decref(cid)
+        locs = self.controller.locations(oid)
+        for nid in locs:
+            rec = self.cluster.get_node(nid)
+            conn = getattr(rec.scheduler, "conn", None) if rec else None
+            if conn is not None:
+                try:
+                    conn.send({"type": protocol.NODE_DELETE_OBJECT,
+                               "object_id": oid})
+                except protocol.ConnectionClosed:
+                    pass
+        if locs:
+            self.controller.remove_location(oid)
+        self.controller.drop_lineage(oid)
+
+    def on_node_objects_lost(self, node_id: str) -> None:
+        """Lineage reconstruction (reference task_manager.h:269
+        ResubmitTask + object_recovery_manager.h:41): objects whose ONLY
+        copy died with `node_id` and are still referenced get their
+        producing task resubmitted. Single-level: if the resubmitted
+        task's own args were also lost, their gets re-enter this path
+        when their holders' deaths are processed."""
+        from ray_tpu._private.config import CONFIG as _C
+        orphaned = self.controller.purge_node_locations(node_id)
+        resubmitted: set[str] = set()
+        for oid in orphaned:
+            if self.controller.unreferenced(oid):
+                self.controller.drop_lineage(oid)
+                continue
+            spec = self.controller.lineage_for(oid)
+            if spec is None or spec.task_id in resubmitted:
+                continue
+            n = getattr(spec, "lineage_resubmits", 0)
+            if n >= _C.lineage_max_resubmits:
+                continue
+            spec.lineage_resubmits = n + 1
+            resubmitted.add(spec.task_id)
+            self.controller.record_task_event(
+                spec.task_id, spec.name, "RESUBMITTED",
+                error=f"lost output {oid} on {node_id}")
+            for pid in spec.pinned_refs:
+                self.controller.pin(pid)
+            self.cluster.submit(spec)
+
+    def _on_wait(self, conn: protocol.Connection, msg: dict) -> None:
+        ids, num_returns = msg["object_ids"], msg["num_returns"]
+        ready_now = [o for o in ids if self.store.contains(o)]
+        if len(ready_now) >= num_returns:
+            conn.reply(msg, ready=ready_now[:num_returns])
+            return
+        wid = conn.meta.get("worker_id")
+        wsched = self._scheduler_for_worker(wid) if wid else None
+        if wsched is not None:
+            wsched.worker_blocked(wid)
+
+        def reply(w, ready: list[str]) -> None:
+            try:
+                conn.reply(msg, ready=ready[:num_returns])
+            except protocol.ConnectionClosed:
+                pass
+
+        self.waiters.add_wait(
+            ids, num_returns, reply, msg.get("timeout"),
+            on_done=((lambda: wsched.worker_unblocked(wid))
+                     if wsched is not None else None))
+
+    def _kv_dispatch(self, msg: dict) -> Any:
+        op = msg["op"]
+        ns = msg.get("namespace", "default")
+        key = msg.get("key", "")
+        if op == "get":
+            return self.controller.kv_get(key, ns)
+        if op == "put":
+            return self.controller.kv_put(key, msg.get("value"), ns,
+                                          msg.get("overwrite", True))
+        if op == "del":
+            return self.controller.kv_del(key, ns)
+        if op == "exists":
+            return self.controller.kv_exists(key, ns)
+        if op == "keys":
+            return self.controller.kv_keys(key, ns)
+        if op == "func_get":
+            return self.controller.get_function(key)
+        raise ValueError(f"unknown kv op {op}")
+
+    # ================= BaseContext API (driver) =================
+    def put(self, value: Any) -> ObjectRef:
+        from ray_tpu._private.object_store import serialize
+        stored = serialize(value)
+        self._seal_contained(stored.object_id, stored.contained_ids)
+        # driver thread: safe to apply create-queueing backpressure
+        self.store.put_stored(stored, block=True)
+        self.controller.addref(stored.object_id)
+        return ObjectRef(stored.object_id)
+
+    def get_objects(self, object_ids: list[str],
+                    timeout: Optional[float]) -> list[Any]:
+        deadline = None if timeout is None else time.time() + timeout
+        out = []
+        for oid in object_ids:
+            remaining = None if deadline is None else max(
+                0.0, deadline - time.time())
+            stored = self._get_stored_anywhere(oid, remaining)
+            if stored is None:
+                raise GetTimeoutError(
+                    f"get() timed out waiting for {oid}")
+            try:
+                value = deserialize(stored)
+            except FileNotFoundError:
+                # The spill policy unlinked this object's shm between
+                # get_stored and the map (rare: touch-grace usually
+                # prevents it). The data lives in the spill file —
+                # re-fetch; the restore comes back with inline buffers.
+                stored = self._get_stored_anywhere(oid, remaining)
+                if stored is None:
+                    raise GetTimeoutError(
+                        f"get() timed out waiting for {oid}")
+                value = deserialize(stored)
+            if stored.is_error:
+                raise value
+            out.append(value)
+        return out
+
+    def wait(self, object_ids: list[str], num_returns: int,
+             timeout: Optional[float]) -> tuple[list[str], list[str]]:
+        """Registry-based wait spanning local residency AND remote
+        locations. Contract: at most num_returns ready, input order."""
+        result: list[list[str]] = []
+        ev = threading.Event()
+
+        def reply(w, ready: list[str]) -> None:
+            result.append(ready)
+            ev.set()
+
+        self.waiters.add_wait(object_ids, num_returns, reply, timeout)
+        ev.wait(None if timeout is None else timeout + 5)
+        ready_list = (result[0] if result else [])[:num_returns]
+        taken = set(ready_list)
+        not_ready = [o for o in object_ids if o not in taken]
+        return ready_list, not_ready
+
+    def addref(self, object_id: str) -> None:
+        self.controller.addref(object_id)
+
+    def decref(self, object_id: str) -> None:
+        if self._shutdown:
+            return
+        if self.controller.decref(object_id):
+            self._delete_everywhere(object_id)
+
+    def submit_spec(self, spec: TaskSpec) -> list[str]:
+        for oid in spec.pinned_refs:
+            self.controller.pin(oid)
+        self.controller.record_lineage(spec)
+        self.controller.record_task_event(spec.task_id, spec.name, "PENDING")
+        self.cluster.submit(spec)
+        return spec.return_ids
+
+    submit_task = submit_spec
+
+    def register_function(self, func_id: str, data: bytes) -> None:
+        self.controller.put_function(func_id, data)
+
+    # ---- actors ----
+    def _actor_state(self, actor_id: str) -> _ActorState:
+        with self._actor_lock:
+            st = self._actor_states.get(actor_id)
+            if st is None:
+                st = self._actor_states[actor_id] = _ActorState()
+            return st
+
+    def create_actor_from_spec(self, spec: ActorSpec) -> str:
+        self.controller.register_actor(spec)
+        self._actor_state(spec.actor_id)
+        self.cluster.submit(spec)
+        return spec.actor_id
+
+    create_actor = create_actor_from_spec
+
+    def submit_actor_task_spec(self, actor_id: str,
+                               spec: ActorTaskSpec) -> list[str]:
+        for oid in spec.pinned_refs:
+            self.controller.pin(oid)
+        rec = self.controller.get_actor(actor_id)
+        if rec is None:
+            self._store_error(spec.return_ids, TaskError(
+                ActorError(actor_id, "unknown actor"), task_name=spec.name))
+            return spec.return_ids
+        st = self._actor_state(actor_id)
+        with st.lock:
+            if rec.state == DEAD:
+                self._store_error(spec.return_ids, TaskError(
+                    ActorDiedError(actor_id,
+                                   f"Actor {actor_id} is dead: "
+                                   f"{rec.death_cause}"),
+                    task_name=spec.name))
+                return spec.return_ids
+            if rec.state != ALIVE or rec.worker_id is None:
+                st.queued.append(spec)
+                return spec.return_ids
+            st.inflight[spec.task_id] = spec
+            target = rec.worker_id
+        if not self._send_actor_task(target, spec):
+            with st.lock:
+                # Requeue only if a concurrent _recover_actor didn't already
+                # claim it from inflight (else it would run twice).
+                if st.inflight.pop(spec.task_id, None) is not None:
+                    st.queued.append(spec)
+        return spec.return_ids
+
+    submit_actor_task = submit_actor_task_spec
+
+    def _send_actor_task(self, worker_id: str, spec: ActorTaskSpec) -> bool:
+        sched = self._scheduler_for_worker(worker_id)
+        if sched is None:
+            return False
+        return sched.send_actor_task(worker_id, spec)
+
+    def _flush_actor_queue(self, actor_id: str) -> None:
+        rec = self.controller.get_actor(actor_id)
+        if rec is None or rec.state != ALIVE:
+            return
+        st = self._actor_state(actor_id)
+        while True:
+            with st.lock:
+                if not st.queued:
+                    return
+                spec = st.queued.pop(0)
+                st.inflight[spec.task_id] = spec
+                target = rec.worker_id
+            if not self._send_actor_task(target, spec):
+                with st.lock:
+                    st.inflight.pop(spec.task_id, None)
+                    st.queued.insert(0, spec)
+                return
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        rec = self.controller.get_actor(actor_id)
+        if rec is None:
+            return
+        if no_restart:
+            rec.spec.max_restarts = 0
+        wid = rec.worker_id
+        if wid is not None:
+            sched = self._scheduler_for_worker(wid)
+            if sched is not None:
+                sched.kill_worker(wid)
+
+    def cancel_task(self, object_id: str, force: bool = False) -> None:
+        """Cancel a task by its return ref (reference core_worker
+        CancelTask): queued tasks are removed; RUNNING tasks get
+        TaskCancelledError raised in their executor thread, or their
+        worker killed outright with force=True. Either way the task is
+        marked non-retriable first so worker-death recovery doesn't
+        resurrect it."""
+        # Return ids are "<task_id>r<i>" and task ids are hex, so 'r' splits.
+        task_id = object_id.split("r", 1)[0]
+        for node in self.cluster.alive_nodes():
+            spec = node.scheduler.cancel_pending(task_id)
+            if spec is not None:
+                err = TaskCancelledError(task_id)
+                self._store_error(spec.return_ids, TaskError(
+                    err, task_name=spec.name))
+                self._unpin(spec.pinned_refs)
+                self.controller.record_task_event(task_id, spec.name,
+                                                  "CANCELLED")
+                return
+        # parked as infeasible (autoscaler may be provisioning)?
+        spec = self.cluster.cancel_parked(task_id)
+        if spec is not None:
+            self._store_error(spec.return_ids, TaskError(
+                TaskCancelledError(task_id), task_name=spec.name))
+            self._unpin(spec.pinned_refs)
+            self.controller.record_task_event(task_id, spec.name,
+                                              "CANCELLED")
+            return
+        # not queued: running somewhere?
+        for node in self.cluster.alive_nodes():
+            hit = node.scheduler.worker_running_task(task_id)
+            if hit is None:
+                continue
+            worker_id, spec = hit
+            spec.cancelled = True        # no retry on worker death
+            self.controller.record_task_event(task_id, spec.name,
+                                              "CANCELLING")
+            if force:
+                node.scheduler.kill_worker(worker_id)
+            else:
+                node.scheduler.cancel_running(worker_id, task_id)
+            return
+
+    def get_actor_handle(self, name: str, namespace: str = "default"):
+        actor_id = self.controller.get_named_actor(name, namespace)
+        if actor_id is None:
+            raise ValueError(f"No actor named {name!r} in namespace "
+                             f"{namespace!r}")
+        rec = self.controller.get_actor(actor_id)
+        from ray_tpu.actor import ActorHandle
+        import pickle as _p
+        cls = _p.loads(self.controller.get_function(rec.spec.class_id))
+        return ActorHandle._from_class(actor_id, cls,
+                                       rec.spec.max_task_retries)
+
+    # ---- state / introspection ----
+    def kv_op(self, op: str, key: str, value: Any = None,
+              namespace: str = "default", **kw) -> Any:
+        """Driver-side KV access (workers reach the same store over the
+        KV_OP wire message)."""
+        return self._kv_dispatch({"op": op, "key": key, "value": value,
+                                  "namespace": namespace, **kw})
+
+    def state_op(self, op: str, **kwargs) -> Any:
+        if op == "list_actors":
+            return self.controller.list_actors()
+        if op == "list_tasks":
+            return self.controller.list_task_events(
+                kwargs.get("limit", 1000))
+        if op == "summarize_tasks":
+            return self.controller.summarize_tasks()
+        if op == "list_placement_groups":
+            return self.cluster.pg_table()
+        if op == "list_nodes":
+            # the head doesn't heartbeat to itself: sample it live
+            self.controller.update_host_stats(
+                self.head_node_id, self.scheduler.host_stats())
+            return self.controller.list_nodes()
+        if op == "list_workers":
+            out = []
+            for n in self.cluster.alive_nodes():
+                for row in n.scheduler.workers_snapshot():
+                    out.append({"node_id": n.node_id, **row})
+            return out
+        if op == "usage_stats":
+            nodes = self.controller.list_nodes()
+            return {
+                "uptime_s": round(time.time() - self._started_at, 1),
+                "nodes_alive": sum(1 for n in nodes if n["alive"]),
+                "nodes_dead": sum(1 for n in nodes if not n["alive"]),
+                "total_resources": self.cluster.total_resources(),
+                "available_resources":
+                    self.cluster.available_resources(),
+                "workers": sum(len(n.scheduler.workers_snapshot())
+                               for n in self.cluster.alive_nodes()),
+                "tasks": self.controller.summarize_tasks(),
+                "actors": _summarize_by_state(
+                    self.controller.list_actors()),
+                "object_store": self.store.stats(),
+            }
+        if op == "cluster_resources":
+            return self.cluster.total_resources()
+        if op == "available_resources":
+            return self.cluster.available_resources()
+        if op == "scheduler_stats":
+            return self.scheduler.stats()
+        if op == "cluster_stats":
+            return self.cluster.stats()
+        if op == "object_store_stats":
+            return self.store.stats()
+        if op == "waiter_stats":
+            return self.waiters.stats()
+        if op == "pubsub_poll":
+            return self.controller.pubsub.poll(
+                kwargs["channel"], kwargs.get("cursor", 0),
+                kwargs.get("timeout"))
+        if op == "pubsub_publish":
+            return self.controller.pubsub.publish(
+                kwargs["channel"], kwargs["message"])
+        if op == "record_task_events":
+            self.controller.record_task_events(kwargs["events"])
+            return True
+        if op == "cancel_task":
+            self.cancel_task(kwargs["object_id"],
+                             kwargs.get("force", False))
+            return True
+        if op == "kill_actor":
+            self.kill_actor(kwargs["actor_id"],
+                            kwargs.get("no_restart", True))
+            return True
+        raise ValueError(f"unknown state op {op}")
+
+    def node_resources(self) -> dict:
+        return dict(self.scheduler.total)
+
+    # ---- lifecycle ----
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        # each step is independent: a wedged component must not block
+        # the ones after it (especially the final shm sweep)
+        for step in (self.cluster.shutdown, self.waiters.shutdown,
+                     self.controller.pubsub.close,
+                     lambda: self._restore_pool.shutdown(wait=False),
+                     self._listener.close, self.store.shutdown,
+                     self._sweep_orphan_segments):
+            try:
+                step()
+            except Exception:
+                log.exception("shutdown step failed")
+
+    def _sweep_orphan_segments(self) -> None:
+        """Final backstop against shm leaks: every worker/agent this
+        runtime spawned is stopped by now, so any segment tagged with
+        OUR session that the store didn't reclaim is an orphan from a
+        killed producer (the per-death reap covers the common paths;
+        this catches the rest). Only the session-tag OWNER sweeps: a
+        driver started inside a job/worker of a parent session inherits
+        the tag, and sweeping there would delete the parent's live
+        segments."""
+        from ray_tpu._private.specs import SESSION_TAG_INHERITED
+        if SESSION_TAG_INHERITED:
+            return
+        from ray_tpu._private.object_store import sweep_session_segments
+        sweep_session_segments()
+
+
+# ================= module-level init/shutdown =================
+def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
+         resources: Optional[dict] = None, max_workers: Optional[int] = None,
+         namespace: str = "default",
+         ignore_reinit_error: bool = False,
+         bind_host: Optional[str] = None,
+         port: Optional[int] = None,
+         address: Optional[str] = None,
+         labels: Optional[dict] = None) -> Any:
+    """Start the head runtime. With bind_host="0.0.0.0" (or env
+    RAY_TPU_BIND_HOST) the listener accepts remote node agents:
+    `python -m ray_tpu._private.node_agent --head <host>:<port>` joins
+    this cluster over TCP; rt.address carries the (host, port) to hand
+    to agents. With address="host:port" this process instead CONNECTS
+    to an existing head as a remote driver (the Ray Client analogue,
+    ray_tpu.util.client)."""
+    existing = _context.maybe_ctx()
+    if existing is not None:
+        if ignore_reinit_error:
+            return existing  # type: ignore[return-value]
+        if existing.is_driver:
+            raise RuntimeError("ray_tpu.init() called twice; pass "
+                               "ignore_reinit_error=True to allow this.")
+        return existing  # inside a worker: init is a no-op, like ray.init
+    if address is not None:
+        incompatible = {k: v for k, v in {
+            "num_cpus": num_cpus, "num_tpus": num_tpus,
+            "resources": resources, "max_workers": max_workers,
+            "bind_host": bind_host, "port": port,
+            "labels": labels}.items()
+            if v is not None}
+        if namespace != "default":
+            incompatible["namespace"] = namespace
+        if incompatible:
+            raise ValueError(
+                f"init(address=...) connects to an EXISTING head; "
+                f"{sorted(incompatible)} only apply when starting one")
+        from ray_tpu.util.client import connect
+        return connect(address)
+    rt = Runtime(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+                 max_workers=max_workers, namespace=namespace,
+                 bind_host=bind_host, port=port, labels=labels)
+    _context.set_ctx(rt)
+    return rt
+
+
+def shutdown() -> None:
+    ctx = _context.maybe_ctx()
+    if ctx is None:
+        return
+    if isinstance(ctx, Runtime):
+        ctx.shutdown()
+        _context.set_ctx(None)
+        return
+    # remote-driver client: disconnect (the head keeps running)
+    if hasattr(ctx, "disconnect"):
+        ctx.disconnect()

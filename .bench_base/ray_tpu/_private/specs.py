@@ -1,0 +1,139 @@
+"""Task / actor specifications — the wire-level unit of work.
+
+Parity: reference ``TaskSpecification`` (src/ray/common/task/task_spec.h)
+collapsed to the fields the centralized runtime needs. Functions and actor
+classes are registered once in the controller's function store (reference
+GcsFunctionManager, src/ray/gcs/gcs_server/gcs_kv_manager.h) and referenced
+by content hash, so hot-loop task submission ships ids, not pickles.
+"""
+from __future__ import annotations
+
+import hashlib
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import cloudpickle
+
+
+# Per-process-tree session tag, hex-only (id parsing splits on 'r').
+# Prefixing every task/object id with it names shm segments
+# rtpu_<tag>... so end-of-session orphan sweeps can't touch a
+# concurrent driver's segments. Child processes inherit it via env.
+import os as _os
+
+import re as _re
+
+_env_tag = _os.environ.get("RAY_TPU_SESSION", "")
+# only a sane hex tag counts as inherited (ids are parsed on 'r' and
+# segment names are swept by prefix — junk/empty values are ignored)
+SESSION_TAG_INHERITED = bool(_re.fullmatch(r"[0-9a-f]{4,16}", _env_tag))
+SESSION_TAG = _env_tag if SESSION_TAG_INHERITED else uuid.uuid4().hex[:6]
+_os.environ["RAY_TPU_SESSION"] = SESSION_TAG
+
+
+def new_task_id() -> str:
+    return SESSION_TAG + uuid.uuid4().hex[:12]
+
+
+def new_actor_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def function_id(pickled: bytes) -> str:
+    return hashlib.sha1(pickled).hexdigest()[:16]
+
+
+@dataclass
+class RefMarker:
+    """Placeholder for a top-level ObjectRef argument: the executing worker
+    fetches the value before invoking the function (dependency resolution,
+    reference transport/dependency_resolver.cc analogue)."""
+    object_id: str
+
+
+@dataclass
+class TaskSpec:
+    task_id: str
+    func_id: str                      # key into the function store
+    args: tuple = ()
+    kwargs: dict = field(default_factory=dict)
+    num_returns: int = 1
+    return_ids: list[str] = field(default_factory=list)
+    resources: dict[str, float] = field(default_factory=dict)
+    max_retries: int = 3
+    retries_used: int = 0
+    name: str = ""
+    # scheduling
+    placement_group_id: Optional[str] = None
+    placement_group_bundle_index: int = -1
+    scheduling_strategy: Any = None
+    node_id: Optional[str] = None     # node affinity (cluster sim)
+    affinity_soft: bool = False       # soft affinity falls back anywhere
+    # normalized (hard, soft) node-label constraints, or None
+    label_constraints: Any = None
+    runtime_env: Optional[dict] = None
+    # bookkeeping (filled by runtime)
+    pinned_refs: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ActorSpec:
+    actor_id: str
+    class_id: str                     # key into the function store
+    init_args: tuple = ()
+    init_kwargs: dict = field(default_factory=dict)
+    resources: dict[str, float] = field(default_factory=dict)
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    name: Optional[str] = None
+    namespace: str = "default"
+    lifetime: Optional[str] = None    # "detached" or None
+    placement_group_id: Optional[str] = None
+    placement_group_bundle_index: int = -1
+    scheduling_strategy: Any = None
+    node_id: Optional[str] = None
+    affinity_soft: bool = False
+    label_constraints: Any = None
+    runtime_env: Optional[dict] = None
+
+
+@dataclass
+class ActorTaskSpec:
+    task_id: str
+    actor_id: str
+    method_name: str
+    args: tuple = ()
+    kwargs: dict = field(default_factory=dict)
+    num_returns: int = 1
+    return_ids: list[str] = field(default_factory=list)
+    max_retries: int = 0              # from actor's max_task_retries
+    retries_used: int = 0
+    name: str = ""
+    pinned_refs: list[str] = field(default_factory=list)
+
+
+def pickle_callable(fn: Any) -> tuple[str, bytes]:
+    data = cloudpickle.dumps(fn)
+    return function_id(data), data
+
+
+def extract_ref_args(args: tuple, kwargs: dict):
+    """Replace top-level ObjectRef args with RefMarkers; return pinned ids.
+
+    Nested refs (inside lists/dicts/dataclasses) pass through pickled and
+    arrive as borrowed ObjectRefs, matching reference semantics where only
+    top-level refs are resolved to values before execution."""
+    from ray_tpu._private.refs import ObjectRef
+    pinned: list[str] = []
+
+    def conv(v):
+        if isinstance(v, ObjectRef):
+            pinned.append(v.object_id)
+            return RefMarker(v.object_id)
+        return v
+
+    new_args = tuple(conv(a) for a in args)
+    new_kwargs = {k: conv(v) for k, v in kwargs.items()}
+    return new_args, new_kwargs, pinned

@@ -1,0 +1,625 @@
+"""Worker process entry point + worker-side context.
+
+Parity: the reference's `default_worker.py` + worker-side core worker
+(reference python/ray/_private/workers/default_worker.py and
+src/ray/core_worker/core_worker.cc RunTaskExecutionLoop:2840 /
+ExecuteTask:2914). Execution flows through a thread pool whose width is the
+actor's ``max_concurrency`` (concurrency-group parity,
+core_worker/transport/concurrency_group_manager.cc, width only), so the
+socket reader thread never runs user code and a worker blocked in a nested
+``get`` keeps draining pushed messages.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import inspect
+import os
+import pickle
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu._private import context as _context
+from ray_tpu._private import protocol
+from ray_tpu._private.object_store import StoredObject, deserialize, serialize
+from ray_tpu._private.refs import ObjectRef
+from ray_tpu._private.specs import (ActorSpec, ActorTaskSpec, RefMarker,
+                                    TaskSpec, extract_ref_args, function_id,
+                                    new_actor_id, new_task_id)
+from ray_tpu.exceptions import (GetTimeoutError, TaskError, format_exception)
+
+
+class WorkerContext(_context.BaseContext):
+    is_driver = False
+
+    def __init__(self, conn: protocol.Connection, worker_id: str):
+        self.conn = conn
+        self.worker_id = worker_id
+        self._sent_funcs: set[str] = set()
+
+    # ---- object plane ----
+    def put(self, value: Any) -> ObjectRef:
+        stored = serialize(value)
+        rep = self.conn.request({"type": protocol.PUT_OBJECT,
+                                 "stored": stored})
+        if rep.get("pressure"):
+            # store over cap and fully pinned: self-throttle the
+            # producer (create-queueing backpressure applied in the
+            # producer process, never on a connection reader)
+            import time as _t
+            _t.sleep(0.2)
+        return ObjectRef(stored.object_id, owned=True)
+
+    def get_objects(self, object_ids: list[str],
+                    timeout: Optional[float]) -> list[Any]:
+        out = []
+        for oid in object_ids:
+            value, stored = self._get_one(oid, timeout)
+            if stored.is_error:
+                raise value
+            out.append(value)
+        return out
+
+    def _get_one(self, oid: str, timeout):
+        for attempt in (0, 1):
+            reply = self.conn.request(
+                {"type": protocol.GET_OBJECT, "object_id": oid,
+                 "timeout": timeout})
+            if reply.get("timeout") or reply.get("stored") is None:
+                raise GetTimeoutError(f"get() timed out waiting for {oid}")
+            stored: StoredObject = reply["stored"]
+            try:
+                return deserialize(stored), stored
+            except FileNotFoundError:
+                # driver spilled the object between reply and our shm
+                # map; one re-request restores it (inline buffers)
+                if attempt:
+                    raise
+
+    def wait(self, object_ids: list[str], num_returns: int,
+             timeout: Optional[float]):
+        reply = self.conn.request(
+            {"type": protocol.WAIT, "object_ids": object_ids,
+             "num_returns": num_returns, "timeout": timeout})
+        ready = set(reply.get("ready", []))
+        return ([o for o in object_ids if o in ready],
+                [o for o in object_ids if o not in ready])
+
+    def decref(self, object_id: str) -> None:
+        try:
+            self.conn.send({"type": protocol.DECREF, "object_id": object_id})
+        except protocol.ConnectionClosed:
+            pass
+
+    def addref(self, object_id: str) -> None:
+        try:
+            self.conn.send({"type": protocol.ADDREF, "object_id": object_id})
+        except protocol.ConnectionClosed:
+            pass
+
+    # ---- task plane (nested submission) ----
+    def submit_task(self, spec: TaskSpec, func_bytes: bytes = None) -> list[str]:
+        fb = None
+        if spec.func_id not in self._sent_funcs:
+            fb = func_bytes
+            self._sent_funcs.add(spec.func_id)
+        self.conn.request({"type": protocol.SUBMIT, "spec": spec,
+                           "func_bytes": fb})
+        return spec.return_ids
+
+    def create_actor(self, spec: ActorSpec, class_bytes: bytes = None) -> str:
+        fb = None
+        if spec.class_id not in self._sent_funcs:
+            fb = class_bytes
+            self._sent_funcs.add(spec.class_id)
+        self.conn.request({"type": protocol.SUBMIT_ACTOR, "spec": spec,
+                           "class_bytes": fb})
+        return spec.actor_id
+
+    def submit_actor_task(self, actor_id: str,
+                          spec: ActorTaskSpec) -> list[str]:
+        self.conn.request({"type": protocol.SUBMIT_ACTOR_TASK,
+                           "actor_id": actor_id, "spec": spec})
+        return spec.return_ids
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self.state_op("kill_actor", actor_id=actor_id)
+
+    def cancel_task(self, object_id: str, force: bool = False) -> None:
+        self.state_op("cancel_task", object_id=object_id, force=force)
+
+    # ---- control plane ----
+    def kv_op(self, op: str, key: str, value: Any = None,
+              namespace: str = "default", **kw) -> Any:
+        reply = self.conn.request({"type": protocol.KV_OP, "op": op,
+                                   "key": key, "value": value,
+                                   "namespace": namespace, **kw})
+        return reply.get("value")
+
+    def get_function(self, func_id: str) -> bytes:
+        return self.kv_op("func_get", func_id)
+
+    def state_op(self, op: str, **kwargs) -> Any:
+        reply = self.conn.request({"type": protocol.STATE_OP, "op": op,
+                                   "kwargs": kwargs})
+        if reply.get("stale"):
+            from ray_tpu._private.pubsub import StaleCursorError
+            raise StaleCursorError(reply.get("detail", "stale cursor"),
+                                   resync=reply.get("resync", 0))
+        return reply.get("value")
+
+    def get_actor_handle(self, name: str, namespace: str = "default"):
+        actors = self.state_op("list_actors")
+        for a in actors:
+            if a["name"] == name and a["state"] != "DEAD":
+                cls = pickle.loads(self.get_function(a["class_id"]))
+                from ray_tpu.actor import ActorHandle
+                return ActorHandle._from_class(a["actor_id"], cls, 0)
+        raise ValueError(f"No actor named {name!r}")
+
+    def node_resources(self) -> dict:
+        return self.state_op("cluster_resources")
+
+
+def _apply_runtime_env(renv: Optional[dict], kv_get=None) -> dict:
+    """Apply a runtime_env in this process; returns undo info.
+
+    Parity: reference _private/runtime_env/ plugins: env_vars fanout,
+    working_dir (chdir + sys.path), pip (per-host cached venv,
+    runtime_env/pip.py) and py_modules (KV-shipped packages,
+    runtime_env/py_modules.py); the key set is validated at SUBMISSION
+    time (api.validate_runtime_env). Atomic: a failure mid-apply
+    reverts whatever was already applied before re-raising — a pooled
+    worker must never leak a half-applied env onto later tasks."""
+    undo: dict = {"env": {}, "cwd": None, "paths": []}
+    if not renv:
+        return undo
+    try:
+        for k, v in (renv.get("env_vars") or {}).items():
+            undo["env"][k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        wd = renv.get("working_dir")
+        if wd:
+            undo["cwd"] = os.getcwd()
+            os.chdir(wd)
+            sys.path.insert(0, wd)
+            undo["paths"].append(wd)
+        if renv.get("pip"):
+            from ray_tpu._private.runtime_env import ensure_pip_env
+            site = ensure_pip_env(renv["pip"])
+            sys.path.insert(0, site)
+            undo["paths"].append(site)
+        if renv.get("uv"):
+            from ray_tpu._private.runtime_env import ensure_uv_env
+            site = ensure_uv_env(renv["uv"])
+            sys.path.insert(0, site)
+            undo["paths"].append(site)
+        if renv.get("conda"):
+            from ray_tpu._private.runtime_env import ensure_conda_env
+            site = ensure_conda_env(renv["conda"])
+            sys.path.insert(0, site)
+            undo["paths"].append(site)
+        # container/image_uri is a spawn-time concern (the scheduler
+        # wraps the worker command); nothing to apply in-process
+        if renv.get("py_modules"):
+            from ray_tpu._private.runtime_env import ensure_py_modules
+            for path in ensure_py_modules(renv["py_modules"], kv_get):
+                sys.path.insert(0, path)
+                undo["paths"].append(path)
+    except BaseException:
+        _revert_runtime_env(undo)
+        raise
+    return undo
+
+
+def _revert_runtime_env(undo: dict) -> None:
+    for k, old in undo["env"].items():
+        if old is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = old
+    if undo["cwd"] is not None:
+        os.chdir(undo["cwd"])
+    for path in undo.get("paths", []):
+        try:
+            sys.path.remove(path)
+        except ValueError:
+            pass
+
+
+class WorkerExecutor:
+    def __init__(self, ctx: WorkerContext):
+        self.ctx = ctx
+        self._fn_cache: dict[str, Any] = {}
+        self._running_tasks: dict[str, threading.Thread] = {}
+        # runtime env stays APPLIED between tasks with the same hash
+        # (runtime-env-keyed worker reuse, reference worker_pool.cc);
+        # a task with a different env reverts + re-applies
+        self._cur_env_hash = None
+        self._cur_env_undo: dict = {"env": {}, "cwd": None, "paths": []}
+        self._pending_cancels: set[str] = set()
+        self._cancel_lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="rtpu-exec")
+        self._actor: Any = None
+        self._actor_spec: Optional[ActorSpec] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.stop_event = threading.Event()
+        # worker-side task-event buffer: execution-truth timestamps
+        # (queue/env latency = gap vs the driver's RUNNING event),
+        # batched + flushed periodically instead of one RPC per event
+        # (reference src/ray/core_worker/task_event_buffer.cc)
+        self._event_buf: list[dict] = []
+        self._event_lock = threading.Lock()
+        self._event_last_flush = time.time()
+        self._event_flush_s = float(
+            os.environ.get("RAY_TPU_TASK_EVENT_FLUSH_S", "2.0"))
+        self._event_cap = int(
+            os.environ.get("RAY_TPU_TASK_EVENT_BUFFER", "32"))
+        threading.Thread(target=self._event_flush_loop,
+                         name="rtpu-task-events", daemon=True).start()
+        # pipelined-task steal-back (see UNQUEUE_TASK): tasks the driver
+        # reclaimed before they started; _run_task skips them silently
+        self._queue_lock = threading.Lock()
+        self._started_tasks: set[str] = set()
+        self._unqueued_tasks: set[str] = set()
+
+    # ---- message entry (called on reader thread) ----
+    def handle(self, conn: protocol.Connection, msg: dict) -> None:
+        mtype = msg["type"]
+        if mtype == protocol.TASK:
+            self._pool.submit(self._run_task, msg["spec"])
+        elif mtype == protocol.ACTOR_CREATE:
+            spec: ActorSpec = msg["spec"]
+            if spec.max_concurrency > 1:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=spec.max_concurrency,
+                    thread_name_prefix="rtpu-actor")
+            self._pool.submit(self._create_actor, spec)
+        elif mtype == protocol.ACTOR_TASK:
+            aspec: ActorTaskSpec = msg["spec"]
+            method = getattr(type(self._actor), aspec.method_name, None) \
+                if self._actor is not None else None
+            if method is not None and inspect.iscoroutinefunction(method):
+                self._ensure_loop()
+                asyncio.run_coroutine_threadsafe(
+                    self._run_actor_task_async(aspec), self._loop)
+            else:
+                self._pool.submit(self._run_actor_task, aspec)
+        elif mtype == protocol.CANCEL_TASK:
+            self._cancel_running(msg["task_id"])
+        elif mtype == protocol.UNQUEUE_TASK:
+            # driver steals back a task pipelined behind a BLOCKED task
+            # (it would deadlock if the blocked get transitively depends
+            # on it). Race-free: refuse once the task has started.
+            tid = msg["task_id"]
+            with self._queue_lock:
+                if tid in self._started_tasks:
+                    ok = False
+                else:
+                    self._unqueued_tasks.add(tid)
+                    ok = True
+            conn.reply(msg, ok=ok)
+        elif mtype == protocol.SHUTDOWN:
+            self.stop_event.set()
+        elif mtype == protocol.PING:
+            conn.reply(msg, ok=True)
+
+    # ---- worker-side task events ----
+    def _record_event(self, task_id: str, name: str, state: str,
+                      **extra) -> None:
+        ev = {"task_id": task_id, "name": name, "state": state,
+              "ts": time.time(), "worker_id": self.ctx.worker_id,
+              **extra}
+        with self._event_lock:
+            self._event_buf.append(ev)
+            should = (len(self._event_buf) >= self._event_cap
+                      or time.time() - self._event_last_flush
+                      >= self._event_flush_s)
+            if should:
+                # claim the window now so a burst of events doesn't
+                # spawn one flush thread each before the first one runs
+                self._event_last_flush = time.time()
+        if should:
+            # never block the caller (async actors record from the
+            # event-loop thread): flush on a short-lived thread
+            threading.Thread(target=self.flush_events,
+                             daemon=True).start()
+
+    def _event_flush_loop(self) -> None:
+        while not self.stop_event.wait(self._event_flush_s):
+            self.flush_events()
+
+    def flush_events(self) -> None:
+        with self._event_lock:
+            if not self._event_buf:
+                return
+            batch, self._event_buf = self._event_buf, []
+            self._event_last_flush = time.time()
+        try:
+            self.ctx.state_op("record_task_events", events=batch)
+        except Exception:
+            pass   # head unreachable (shutdown race): best-effort
+
+    def _cancel_running(self, task_id: str) -> None:
+        """Interrupt a running task by raising TaskCancelledError in its
+        executor thread (reference CancelTask path: the worker raises in
+        the executing thread; tasks blocked in C extensions only observe
+        it at the next bytecode boundary — same limitation there)."""
+        import ctypes
+
+        from ray_tpu.exceptions import TaskCancelledError
+        with self._cancel_lock:
+            # registration is popped under this same lock with the
+            # pending-exception cleared, so a cancel can never land on a
+            # thread after its task is done (it would brick the reused
+            # pool thread)
+            thread = self._running_tasks.get(task_id)
+            if thread is None or not thread.is_alive():
+                # Cancel raced ahead of registration (the pool thread
+                # hasn't started the task yet): record it so _run_task
+                # aborts before user code runs instead of silently
+                # completing while the driver shows CANCELLING. Bounded:
+                # a cancel that arrives AFTER completion leaves a stale
+                # id here (its task never runs again), so cap the set.
+                if len(self._pending_cancels) >= 1024:
+                    self._pending_cancels.pop()
+                self._pending_cancels.add(task_id)
+                return
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(thread.ident),
+                ctypes.py_object(TaskCancelledError))
+
+    def _ensure_loop(self) -> None:
+        if self._loop is None:
+            self._loop = asyncio.new_event_loop()
+            threading.Thread(target=self._loop.run_forever,
+                             name="rtpu-actor-loop", daemon=True).start()
+
+    # ---- execution ----
+    def _load_function(self, func_id: str):
+        fn = self._fn_cache.get(func_id)
+        if fn is None:
+            data = self.ctx.get_function(func_id)
+            if data is None:
+                raise RuntimeError(f"function {func_id} not found in store")
+            fn = cloudpickle.loads(data)
+            self._fn_cache[func_id] = fn
+        return fn
+
+    def _resolve_args(self, args, kwargs):
+        ref_ids = [a.object_id for a in args if isinstance(a, RefMarker)]
+        ref_ids += [v.object_id for v in kwargs.values()
+                    if isinstance(v, RefMarker)]
+        values = {}
+        if ref_ids:
+            got = self.ctx.get_objects(ref_ids, timeout=None)
+            values = dict(zip(ref_ids, got))
+        conv = lambda v: values[v.object_id] if isinstance(v, RefMarker) else v
+        return tuple(conv(a) for a in args), {
+            k: conv(v) for k, v in kwargs.items()}
+
+    def _send_results(self, task_id: str, return_ids: list[str],
+                      result: Any, num_returns: int, error: bool,
+                      **extra) -> None:
+        if not error and num_returns > 1:
+            if not isinstance(result, (tuple, list)) or \
+                    len(result) != num_returns:
+                error = True
+                result = TaskError(ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{type(result).__name__}"))
+        stored_list = []
+        if error or num_returns <= 1:
+            values = [result] * len(return_ids)
+        else:
+            values = list(result)
+        for oid, value in zip(return_ids, values):
+            try:
+                stored = serialize(value, object_id=oid)
+            except BaseException as e:  # noqa: BLE001
+                # Unserializable result (or shm failure): the task must
+                # still complete with an error, never vanish silently
+                # with its resources held.
+                error = True
+                stored = serialize(
+                    TaskError(e, format_exception(e)), object_id=oid)
+            stored.is_error = error
+            stored_list.append(stored)
+        self.ctx.conn.send({"type": protocol.TASK_DONE,
+                            "task_id": task_id, "results": stored_list,
+                            "error": error, **extra})
+
+    def _finish_task_cleanup(self, spec: TaskSpec) -> None:
+        """Idempotent post-task cleanup: deregister from the cancel
+        table, CLEAR any pending async cancel on this thread (a raced
+        cancel must not detonate in the pool thread's idle loop or in
+        _send_results), and revert the task's runtime env."""
+        import ctypes
+        with self._cancel_lock:
+            self._running_tasks.pop(spec.task_id, None)
+            self._pending_cancels.discard(spec.task_id)
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(threading.get_ident()), None)
+
+
+    def _switch_runtime_env(self, renv: Optional[dict]) -> None:
+        from ray_tpu._private.runtime_env import env_hash
+        h = env_hash(renv)
+        if h == self._cur_env_hash:
+            return
+        _revert_runtime_env(self._cur_env_undo)
+        # two envs may ship DIFFERENT versions of the same package:
+        # purge modules imported from the reverted paths or the next
+        # env would silently serve stale code
+        for path in self._cur_env_undo.get("paths", []):
+            prefix = os.path.abspath(path) + os.sep
+            for name, mod in list(sys.modules.items()):
+                f = getattr(mod, "__file__", None)
+                if f and os.path.abspath(f).startswith(prefix):
+                    del sys.modules[name]
+        self._cur_env_undo = {"env": {}, "cwd": None, "paths": []}
+        self._cur_env_hash = None
+        self._cur_env_undo = _apply_runtime_env(
+            renv, kv_get=lambda k: self.ctx.kv_op("get", k))
+        self._cur_env_hash = h
+
+    def _run_task(self, spec: TaskSpec) -> None:
+        from ray_tpu.exceptions import TaskCancelledError
+        with self._queue_lock:
+            if spec.task_id in self._unqueued_tasks:
+                # stolen back by the driver while queued: it was (or
+                # will be) re-dispatched elsewhere — skip silently
+                self._unqueued_tasks.discard(spec.task_id)
+                return
+            self._started_tasks.add(spec.task_id)
+        t0 = time.time()
+        self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
+        try:
+            try:
+                with self._cancel_lock:
+                    if spec.task_id in self._pending_cancels:
+                        self._pending_cancels.discard(spec.task_id)
+                        raise TaskCancelledError(spec.task_id)
+                    self._running_tasks[spec.task_id] = \
+                        threading.current_thread()
+                # env first: the function/args may only UNPICKLE under
+                # the declared working_dir/env (the actor path does the
+                # same). Kept applied for reuse by same-env tasks.
+                self._switch_runtime_env(
+                    getattr(spec, "runtime_env", None))
+                fn = self._load_function(spec.func_id)
+                args, kwargs = self._resolve_args(spec.args, spec.kwargs)
+                result = fn(*args, **kwargs)
+                error = False
+            except BaseException as e:  # noqa: BLE001
+                result = e if isinstance(e, TaskError) else TaskError(
+                    e, format_exception(e), task_name=spec.name)
+                error = True
+            finally:
+                self._finish_task_cleanup(spec)
+        except TaskCancelledError as e:
+            # the async cancel landed INSIDE the finally (between task
+            # completion and the pending-exc clear): redo the cleanup —
+            # the exception has fired, so this pass cannot be interrupted
+            # again — and report the task cancelled.
+            self._finish_task_cleanup(spec)
+            result = TaskError(e, format_exception(e),
+                               task_name=spec.name)
+            error = True
+        self._send_results(spec.task_id, spec.return_ids, result,
+                           spec.num_returns, error, name=spec.name)
+        self._record_event(spec.task_id, spec.name,
+                           "EXEC_FAILED" if error else "EXEC_FINISHED",
+                           duration_s=time.time() - t0)
+        with self._queue_lock:
+            self._started_tasks.discard(spec.task_id)
+
+    def _create_actor(self, spec: ActorSpec) -> None:
+        try:
+            # permanent: this worker is dedicated to the actor for life
+            self._switch_runtime_env(getattr(spec, "runtime_env", None))
+            cls = self._load_function(spec.class_id)
+            args, kwargs = self._resolve_args(spec.init_args,
+                                              spec.init_kwargs)
+            self._actor = cls(*args, **kwargs)
+            self._actor_spec = spec
+            err = False
+            err_repr = ""
+        except BaseException as e:  # noqa: BLE001
+            err = True
+            err_repr = format_exception(e)
+            sys.stderr.write(f"actor creation failed:\n{err_repr}")
+        self.ctx.conn.send({"type": protocol.TASK_DONE,
+                            "task_id": f"create:{spec.actor_id}",
+                            "results": [], "error": err,
+                            "error_repr": err_repr,
+                            "is_actor_create": True,
+                            "actor_id": spec.actor_id})
+
+    def _invoke_actor_method(self, spec: ActorTaskSpec):
+        args, kwargs = self._resolve_args(spec.args, spec.kwargs)
+        if spec.method_name == "__rtpu_apply__":
+            # escape hatch (reference actor.__ray_call__): run an
+            # arbitrary function against the actor instance — compiled
+            # DAGs use it to install their channel exec loops on user
+            # actors without requiring cooperation from the class
+            fn = cloudpickle.loads(args[0])
+            return fn(self._actor, *args[1:], **kwargs)
+        method = getattr(self._actor, spec.method_name)
+        return method(*args, **kwargs)
+
+    def _run_actor_task(self, spec: ActorTaskSpec) -> None:
+        t0 = time.time()
+        self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
+        try:
+            result = self._invoke_actor_method(spec)
+            error = False
+        except BaseException as e:  # noqa: BLE001
+            result = TaskError(e, format_exception(e), task_name=spec.name)
+            error = True
+        self._send_results(spec.task_id, spec.return_ids, result,
+                           spec.num_returns, error, is_actor_task=True,
+                           actor_id=spec.actor_id, name=spec.name)
+        self._record_event(spec.task_id, spec.name,
+                           "EXEC_FAILED" if error else "EXEC_FINISHED",
+                           duration_s=time.time() - t0)
+
+    async def _run_actor_task_async(self, spec: ActorTaskSpec) -> None:
+        t0 = time.time()
+        self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
+        try:
+            method = getattr(self._actor, spec.method_name)
+            args, kwargs = self._resolve_args(spec.args, spec.kwargs)
+            result = await method(*args, **kwargs)
+            error = False
+        except BaseException as e:  # noqa: BLE001
+            result = TaskError(e, format_exception(e), task_name=spec.name)
+            error = True
+        self._send_results(spec.task_id, spec.return_ids, result,
+                           spec.num_returns, error, is_actor_task=True,
+                           actor_id=spec.actor_id, name=spec.name)
+        self._record_event(spec.task_id, spec.name,
+                           "EXEC_FAILED" if error else "EXEC_FINISHED",
+                           duration_s=time.time() - t0)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", required=True)
+    parser.add_argument("--worker-id", required=True)
+    args = parser.parse_args()
+    host, port = args.addr.rsplit(":", 1)
+
+    executor_box: dict = {}
+
+    def handler(conn, msg):
+        executor_box["exec"].handle(conn, msg)
+
+    def on_close(conn):
+        # Driver went away: nothing useful left to do.
+        os._exit(0)
+
+    conn = protocol.connect((host, int(port)), handler, on_close,
+                            name=f"worker-{args.worker_id}")
+    ctx = WorkerContext(conn, args.worker_id)
+    _context.set_ctx(ctx)
+    executor = WorkerExecutor(ctx)
+    executor_box["exec"] = executor
+    conn.send({"type": protocol.REGISTER, "worker_id": args.worker_id,
+               "pid": os.getpid()})
+    executor.stop_event.wait()
+    executor.flush_events()
+    conn.close()
+    # Daemonic pool threads may be mid-task; hard-exit like the reference's
+    # worker does on graceful shutdown after draining.
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

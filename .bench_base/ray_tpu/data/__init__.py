@@ -1,0 +1,28 @@
+"""ray_tpu.data: streaming datasets feeding TPU training.
+
+Parity target: reference python/ray/data/ (Dataset dataset.py:141,
+streaming executor _internal/execution/streaming_executor.py:48) — the
+subset SURVEY.md §7 step 7 calls for: read → map_batches → shuffle →
+iter_batches yielding sharded jax.Arrays, executed as bounded-window
+remote tasks over the ray_tpu runtime.
+"""
+from ray_tpu.data import aggregate  # noqa: F401
+from ray_tpu.data.aggregate import (AbsMax, AggregateFn, Count, Max, Mean,
+                                    Min, Std, Sum)
+from ray_tpu.data.block import Block, BlockMetadata
+from ray_tpu.data.dataset import (ActorPoolStrategy, DataIterator, Dataset,
+                                  from_items, from_numpy, range, read_csv,
+                                  read_binary_files, read_images,
+                                  read_json, read_parquet, read_text,
+                                  read_tfrecords)
+from ray_tpu.data.grouped_data import GroupedData
+from ray_tpu.data.jax_iter import iter_jax_batches
+from ray_tpu.data.streaming import StageSpec
+
+__all__ = [
+    "Block", "BlockMetadata", "DataIterator", "Dataset", "from_items",
+    "from_numpy", "range", "read_csv", "read_json", "read_parquet",
+    "read_text", "read_binary_files", "read_images", "read_tfrecords",
+    "iter_jax_batches", "ActorPoolStrategy", "GroupedData", "StageSpec",
+    "AggregateFn", "Count", "Sum", "Min", "Max", "Mean", "Std", "AbsMax",
+]

@@ -1,0 +1,112 @@
+"""Host→device batch feeding: sharded jax.Arrays with prefetch.
+
+The Train ingestion edge (reference data/iterator.py iter_torch_batches
+analogue, TPU-shaped): numpy batches stream off the Dataset while the
+PREVIOUS batch's `jax.device_put` transfer overlaps the current step —
+a two-deep pipeline so input never serializes with compute unless the
+pipeline genuinely underruns (tracked in `stats()`).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+class _Prefetcher:
+    """Bounded background producer of host batches.
+
+    `close()` unblocks and retires the producer thread when the consumer
+    abandons the iterator early (the common `zip(range(steps), it)` loop)
+    — without it the thread would sit in q.put forever, pinning batches."""
+
+    def __init__(self, it: Iterator[Dict[str, np.ndarray]], depth: int):
+        import queue
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._sentinel = object()
+        self._closed = threading.Event()
+        self.wait_s = 0.0
+
+        def run():
+            try:
+                for item in it:
+                    if not self._put(item):
+                        return
+                self._put(self._sentinel)
+            except BaseException as e:
+                self._put(e)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="data-prefetch")
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        from ray_tpu.data._util import put_unless_closed
+        return put_unless_closed(self._q, item, self._closed)
+
+    def close(self) -> None:
+        self._closed.set()
+
+    def __iter__(self):
+        while True:
+            t0 = time.perf_counter()
+            item = self._q.get()
+            self.wait_s += time.perf_counter() - t0
+            if item is self._sentinel:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+
+def iter_jax_batches(dataset, *, batch_size: int,
+                     sharding=None,
+                     dtypes: Optional[Dict[str, str]] = None,
+                     drop_last: bool = True,
+                     local_shuffle_buffer_size: int = 0,
+                     seed: Optional[int] = None,
+                     prefetch_depth: int = 2,
+                     stats: Optional[dict] = None):
+    """Yield dict[str, jax.Array] batches.
+
+    `sharding`: a jax.sharding.Sharding (e.g. NamedSharding(mesh,
+    P("dp"))) applied on device_put — the per-host batch lands already
+    laid out for the train step, no resharding inside jit.
+    """
+    import jax
+
+    host_iter = dataset.iter_batches(
+        batch_size=batch_size, drop_last=drop_last,
+        local_shuffle_buffer_size=local_shuffle_buffer_size, seed=seed)
+    pf = _Prefetcher(host_iter, prefetch_depth)
+
+    def put(batch: Dict[str, np.ndarray]):
+        out = {}
+        for k, v in batch.items():
+            if dtypes and k in dtypes:
+                v = v.astype(dtypes[k])
+            out[k] = (jax.device_put(v, sharding) if sharding is not None
+                      else jax.device_put(v))
+        return out
+
+    pending = None
+    n = 0
+    try:
+        for batch in pf:
+            nxt = put(batch)        # start async transfer
+            if pending is not None:
+                yield pending
+                n += 1
+            pending = nxt
+        if pending is not None:
+            yield pending
+            n += 1
+    finally:
+        # runs on normal exhaustion AND GeneratorExit when the consumer
+        # abandons the loop early — either way the producer must die.
+        pf.close()
+        if stats is not None:
+            stats["num_batches"] = n
+            stats["input_wait_s"] = pf.wait_s

@@ -1,0 +1,8 @@
+"""ray_tpu.experimental: channels and other pre-stable APIs (reference
+python/ray/experimental/)."""
+from ray_tpu.experimental.channel import (Channel, ChannelClosed,
+                                          ChannelReader, ChannelTimeout,
+                                          ChannelWriter)
+
+__all__ = ["Channel", "ChannelReader", "ChannelWriter", "ChannelClosed",
+           "ChannelTimeout"]

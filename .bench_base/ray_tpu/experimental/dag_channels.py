@@ -1,0 +1,385 @@
+"""Channel-backed compiled DAG execution (the aDAG fast path).
+
+Parity: reference python/ray/dag/compiled_dag_node.py (CompiledDAG with
+persistent per-actor exec loops :135-224, execute :2118 returning
+CompiledDAGRef) over shared_memory_channel transport — re-designed for
+this stack: compilation allocates one mutable shm channel per producer
+node (single writer, one reader slot per consumer, plus the driver for
+outputs), then installs a long-running exec loop on every actor via the
+``__rtpu_apply__`` escape hatch. `execute()` writes the input into the
+input channel and returns a CompiledDAGRef whose `get()` reads the
+output channel — no task submission, object store traffic, or driver
+hop between stages.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.experimental.channel import (Channel, ChannelClosed,
+                                          ChannelReader, ChannelTimeout,
+                                          ChannelWriter)
+
+
+class AbortFlag:
+    """One shared u64 in shm that exec loops poll between bounded channel
+    reads, so a dead upstream actor can never wedge a loop forever: the
+    driver raises the flag at teardown and every surviving loop exits at
+    its next poll (reference CompiledDAG cancels exec loops instead)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._mv = None
+
+    @classmethod
+    def create(cls) -> "AbortFlag":
+        from ray_tpu._private.object_store import _create_segment
+        from ray_tpu._private.specs import SESSION_TAG
+        name = f"rtpu_{SESSION_TAG}_abort_{uuid.uuid4().hex[:12]}"
+        _create_segment(name, memoryview(bytes(8)))
+        return cls(name)
+
+    def _map(self):
+        if self._mv is None:
+            from ray_tpu._private.object_store import _map_segment
+            self._mv = _map_segment(self.name, 8)
+        return self._mv
+
+    def set(self) -> None:
+        struct.pack_into("<Q", self._map(), 0, 1)
+
+    def is_set(self) -> bool:
+        try:
+            return struct.unpack_from("<Q", self._map(), 0)[0] != 0
+        except BaseException:
+            return True                # segment gone == abort
+
+    def destroy(self) -> None:
+        from ray_tpu._private.object_store import unlink_segment
+        self._mv = None
+        unlink_segment(self.name)
+
+    def __reduce__(self):
+        return (AbortFlag, (self.name,))
+
+
+class _Err:
+    """Error envelope forwarded through downstream channels so one
+    failing node poisons the execution, not the pipeline."""
+
+    def __init__(self, repr_: str):
+        self.repr = repr_
+
+
+def _exec_loop(instance, method_name: str, in_channels: List[Channel],
+               in_reader_idx: List[int], arg_spec: List[Tuple],
+               kw_spec: Dict[str, Tuple], out_channel: Channel,
+               abort: AbortFlag) -> int:
+    """Runs INSIDE the actor (one long-lived call): read inputs, run the
+    method, write the result; repeats until the upstream closes or the
+    driver raises the abort flag (bounded reads — a dead peer can't
+    wedge this loop forever)."""
+    readers = [ChannelReader(ch, i)
+               for ch, i in zip(in_channels, in_reader_idx)]
+    writer = ChannelWriter(out_channel)
+
+    def bounded(fn, *a, **kw):
+        while True:
+            try:
+                return fn(*a, timeout=1.0, **kw)
+            except ChannelTimeout:
+                if abort.is_set():
+                    raise ChannelClosed("aborted") from None
+
+    executed = 0
+    while True:
+        vals: List[Any] = [None] * len(readers)
+        err: Any = None
+        try:
+            if len(readers) == 1:
+                vals[0] = bounded(readers[0].read)
+            else:
+                # overlap schedule (reference dag_node_operation.py
+                # intent): consume multi-node inputs in ARRIVAL order —
+                # a slow upstream never head-of-line-blocks the inputs
+                # that are already published
+                pending = set(range(len(readers)))
+                poll = 0.005
+                while pending:
+                    progressed = False
+                    for i in list(pending):
+                        try:
+                            vals[i] = readers[i].read(timeout=poll)
+                            pending.discard(i)
+                            progressed = True
+                        except ChannelTimeout:
+                            pass
+                    if progressed:
+                        poll = 0.005
+                    else:
+                        # idle between executes: back the poll off so
+                        # a parked DAG doesn't burn a core
+                        poll = min(poll * 2, 0.25)
+                        if abort.is_set():
+                            raise ChannelClosed("aborted")
+        except ChannelClosed:
+            # short ack wait: at teardown the driver may never ack the
+            # final output, and a 5s stall here would outlive the
+            # driver's loop-exit budget and get this actor killed
+            writer.close(timeout=0.5)
+            return executed
+        for v in vals:
+            if isinstance(v, _Err):
+                err = v
+                break
+        if err is None:
+            def resolve(spec):
+                kind, payload = spec
+                return vals[payload] if kind == "n" else payload
+            try:
+                args = [resolve(s) for s in arg_spec]
+                kwargs = {k: resolve(s) for k, s in kw_spec.items()}
+                result = getattr(instance, method_name)(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                import traceback
+                result = _Err("".join(traceback.format_exception(e)))
+        else:
+            result = err
+        try:
+            bounded(writer.write, result)
+        except ChannelClosed:
+            return executed
+        executed += 1
+
+
+class CompiledDAGRef:
+    """Result handle for one execute() (reference CompiledDAGRef):
+    `get()` reads the output channel(s) in order. ray_tpu.get() accepts
+    it directly."""
+
+    def __init__(self, dag: "ChannelCompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._consumed = False
+
+    def get(self, timeout: Optional[float] = 30.0):
+        if self._consumed:
+            raise ValueError("CompiledDAGRef can only be read once")
+        value = self._dag._fetch(self._seq, timeout)
+        self._consumed = True          # only after a successful fetch
+        if isinstance(value, _Err):
+            raise RuntimeError(f"compiled DAG node failed:\n{value.repr}")
+        if isinstance(value, list):
+            for v in value:
+                if isinstance(v, _Err):
+                    raise RuntimeError(
+                        f"compiled DAG node failed:\n{v.repr}")
+        return value
+
+
+class ChannelCompiledDAG:
+    """Channel-transport compiled DAG (single InputNode, every actor
+    hosts at most one node)."""
+
+    # executes in flight beyond this are drained into the fetched-
+    # results buffer first — each channel slot holds ONE message, so
+    # unbounded in-flight writes would deadlock the input writer
+    MAX_IN_FLIGHT = 2
+
+    def __init__(self, output, buffer_size_bytes: int = 1 << 20):
+        from ray_tpu.dag import (ClassMethodNode, CompiledDAG, InputNode,
+                                 MultiOutputNode)
+        self._buffer = buffer_size_bytes
+        base = CompiledDAG(output)          # reuse toposort + validation
+        self._order = base._order
+        self._input = base._input
+        if self._input is None:
+            raise ValueError("channel-mode DAG needs an InputNode")
+        self._output = output
+        nodes = [n for n in self._order
+                 if isinstance(n, ClassMethodNode)]
+        if not nodes:
+            raise ValueError("channel-mode DAG needs actor nodes")
+        actors = [n.actor for n in nodes]
+        if len({a._actor_id for a in actors}) != len(actors):
+            raise ValueError(
+                "channel mode requires each actor to host exactly one "
+                "DAG node (an actor's exec loop owns it exclusively)")
+        out_nodes = (list(output.outputs)
+                     if isinstance(output, MultiOutputNode) else [output])
+        for o in out_nodes:
+            if not isinstance(o, ClassMethodNode):
+                raise ValueError("DAG outputs must be actor nodes")
+        self._out_nodes = out_nodes
+
+        # --- consumers per producer (input node included)
+        consumers: Dict[int, List] = {id(self._input): []}
+        for n in nodes:
+            consumers[id(n)] = []
+        for n in nodes:
+            seen_up = set()
+            for up in n.upstream:
+                # dedup: a node passing the same upstream twice still
+                # reads it through ONE reader slot
+                if id(up) in seen_up:
+                    continue
+                seen_up.add(id(up))
+                if isinstance(up, (ClassMethodNode, InputNode)):
+                    consumers[id(up)].append(n)
+        # the driver reads every output node's channel
+        n_extra = {id(n): 0 for n in nodes}
+        for o in out_nodes:
+            n_extra[id(o)] += 1
+
+        # --- allocate channels
+        self._channels: Dict[int, Channel] = {}
+        for key, cons in consumers.items():
+            extra = n_extra.get(key, 0)
+            n_readers = len(cons) + extra
+            if n_readers == 0:
+                continue
+            self._channels[key] = Channel.create(
+                capacity=buffer_size_bytes, n_readers=n_readers)
+        # reader slot assignment: consumers take slots in order; the
+        # driver takes the last slot(s)
+        slot: Dict[Tuple[int, int], int] = {}
+        for key, cons in consumers.items():
+            for i, c in enumerate(cons):
+                slot[(key, id(c))] = i
+
+        # --- install exec loops
+        self._abort = AbortFlag.create()
+        self._loop_refs = []
+        self._loop_actors = []
+        from ray_tpu.actor import ActorMethod
+        for n in nodes:
+            in_chs, in_idx, arg_spec, kw_spec = [], [], [], {}
+            seen_inputs: Dict[int, int] = {}
+
+            def input_index(up) -> int:
+                if id(up) not in seen_inputs:
+                    seen_inputs[id(up)] = len(in_chs)
+                    in_chs.append(self._channels[id(up)])
+                    in_idx.append(slot[(id(up), id(n))])
+                return seen_inputs[id(up)]
+
+            for a in n.args:
+                if isinstance(a, (ClassMethodNode, InputNode)):
+                    arg_spec.append(("n", input_index(a)))
+                else:
+                    arg_spec.append(("c", a))
+            for k, v in n.kwargs.items():
+                if isinstance(v, (ClassMethodNode, InputNode)):
+                    kw_spec[k] = ("n", input_index(v))
+                else:
+                    kw_spec[k] = ("c", v)
+            method = ActorMethod(n.actor, "__rtpu_apply__", {})
+            self._loop_refs.append(method.remote(
+                cloudpickle.dumps(_exec_loop), n.method_name, in_chs,
+                in_idx, arg_spec, kw_spec, self._channels[id(n)],
+                self._abort))
+            self._loop_actors.append(n.actor)
+
+        # --- driver endpoints
+        self._in_writer = ChannelWriter(self._channels[id(self._input)])
+        self._out_readers = []
+        taken: Dict[int, int] = {}
+        for o in out_nodes:
+            ch = self._channels[id(o)]
+            base_slot = len(consumers[id(o)]) + taken.get(id(o), 0)
+            taken[id(o)] = taken.get(id(o), 0) + 1
+            self._out_readers.append(ChannelReader(ch, base_slot))
+        self._multi = isinstance(output, MultiOutputNode)
+        self._lock = threading.Lock()
+        self._next_seq = 0
+        self._fetched: Dict[int, Any] = {}
+        self._partial_row: List[Any] = []
+        self._read_seq = 0
+        self.num_executions = 0
+        self._torn_down = False
+
+    # ------------------------------------------------------------- api
+    def execute(self, *args) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("DAG was torn down")
+        if len(args) != 1:
+            raise TypeError(f"DAG takes exactly 1 input, got {len(args)}")
+        with self._lock:
+            # self-drain: pull finished results into _fetched so the
+            # pipeline's single-slot channels never back up into an
+            # unbounded blocking input write
+            while self._next_seq - self._read_seq >= self.MAX_IN_FLIGHT:
+                while len(self._partial_row) < len(self._out_readers):
+                    r = self._out_readers[len(self._partial_row)]
+                    self._partial_row.append(r.read(60.0))
+                outs, self._partial_row = self._partial_row, []
+                self._fetched[self._read_seq] = (
+                    outs if self._multi else outs[0])
+                self._read_seq += 1
+            self._in_writer.write(args[0], timeout=60.0)
+            seq = self._next_seq
+            self._next_seq += 1
+            self.num_executions += 1
+        return CompiledDAGRef(self, seq)
+
+    def _fetch(self, seq: int, timeout: Optional[float]):
+        with self._lock:
+            while self._read_seq <= seq:
+                # _partial_row survives a timeout mid-row: each reader's
+                # read consumes its single slot, so a retry must RESUME
+                # at the first unread output, never re-read consumed ones
+                while len(self._partial_row) < len(self._out_readers):
+                    r = self._out_readers[len(self._partial_row)]
+                    self._partial_row.append(r.read(timeout))
+                outs, self._partial_row = self._partial_row, []
+                self._fetched[self._read_seq] = (
+                    outs if self._multi else outs[0])
+                self._read_seq += 1
+            return self._fetched.pop(seq)
+
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        try:
+            self._in_writer.close()
+        except BaseException:
+            pass
+        # abort flag unwedges loops blocked on a dead peer's channel
+        try:
+            self._abort.set()
+        except BaseException:
+            pass
+        remaining = list(zip(self._loop_refs, self._loop_actors))
+        try:
+            ray_tpu.get(self._loop_refs, timeout=5.0)
+            remaining = []
+        except BaseException:
+            pass
+        # kill loops that still haven't exited — destroying segments
+        # under a live reader would leave its thread stuck for the
+        # actor's lifetime
+        for ref, actor in remaining:
+            try:
+                done, _ = ray_tpu.wait([ref], timeout=0.1)
+                if not done:
+                    ray_tpu.kill(actor)
+            except BaseException:
+                pass
+        for ch in self._channels.values():
+            ch.destroy()
+        try:
+            self._abort.destroy()
+        except BaseException:
+            pass
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except BaseException:
+            pass

@@ -1,0 +1,11 @@
+"""Model zoo: TPU-first transformer families as pure JAX pytrees.
+
+The reference delegates model code to torch/HF; ray_tpu ships its own
+flagship decoder (Llama-family, GQA + RoPE + SwiGLU) built directly on
+ray_tpu.ops kernels, with parameters as plain pytrees annotated by
+logical sharding axes (ray_tpu.parallel.sharding). Layers are stacked
+and scanned (`lax.scan`) so compile time is O(1) in depth; remat is a
+config switch.
+"""
+from ray_tpu.models.config import TransformerConfig  # noqa: F401
+from ray_tpu.models.transformer import Transformer  # noqa: F401

@@ -1,0 +1,120 @@
+"""Mixture-of-Experts FFN: top-k routing + capacity-based dispatch.
+
+Expert parallelism the TPU way (SURVEY.md §2.4 EP row — absent from the
+reference in-tree, delivered here natively): expert weights carry the
+"experts" logical axis (→ ep mesh axis), dispatch/combine are dense
+einsums whose sharding constraints make XLA insert the token all-to-all
+over ICI — no ragged buffers, no host-side routing. GShard-style
+capacity discipline: each expert processes at most
+`ceil(tokens·top_k/num_experts · capacity_factor)` tokens; overflow
+tokens fall through the residual connection (standard drop semantics).
+
+Parity property used by tests: with every expert initialised to the
+same weights, normalised top-k routing makes the MoE block exactly
+equal to its dense FFN (Σ w_k · F(x) = F(x)), so correctness reduces to
+dense-FFN parity plus sharding-invariance on an ep>1 mesh.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_jitter: float = 0.0    # train-time router noise (0 = off)
+
+
+def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    return max(1, int(math.ceil(
+        num_tokens * top_k / num_experts * capacity_factor)))
+
+
+def moe_ffn(x: jax.Array,
+            router_w: jax.Array,
+            gate_w: jax.Array, up_w: jax.Array, down_w: jax.Array,
+            *, top_k: int, capacity_factor: float,
+            constrain=None,
+            rngs: Optional[jax.Array] = None,
+            router_jitter: float = 0.0
+            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Apply the MoE FFN block.
+
+    x: (b, s, d). router_w: (d, E). gate/up_w: (E, d, f); down_w:
+    (E, f, d). `constrain(arr, logical_axes)` applies sharding
+    constraints (models pass their mesh-bound constrainer). Returns
+    (output (b, s, d), aux metrics incl. load-balance loss).
+    """
+    b, s, d = x.shape
+    E = router_w.shape[-1]
+    T = b * s
+    C = expert_capacity(T, E, top_k, capacity_factor)
+    cdtype = x.dtype
+
+    xf = x.reshape(T, d)
+    logits = (xf @ router_w.astype(cdtype)).astype(jnp.float32)  # (T, E)
+    if router_jitter and rngs is not None:
+        logits = logits + router_jitter * jax.random.normal(
+            rngs, logits.shape)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_e = lax.top_k(probs, top_k)                    # (T, k)
+    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)    # renorm
+
+    # Position of each (token, k) assignment within its expert's queue:
+    # flatten assignments k-major so k=0 choices win capacity ties.
+    assign = jax.nn.one_hot(top_e, E, dtype=jnp.int32)        # (T, k, E)
+    flat = assign.transpose(1, 0, 2).reshape(top_k * T, E)    # (kT, E)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat                # (kT, E)
+    pos = pos_flat.reshape(top_k, T, E).transpose(1, 0, 2)    # (T, k, E)
+    within = (pos * assign).sum(-1)                           # (T, k)
+    keep = within < C                                         # capacity
+
+    # dispatch (T, k, E, C) one-hot -> collapsed over k to (T, E, C)
+    disp = (assign[..., None]
+            * jax.nn.one_hot(within, C, dtype=jnp.int32)[:, :, None, :]
+            * keep[:, :, None, None].astype(jnp.int32))       # (T,k,E,C)
+    combine = (disp.astype(jnp.float32)
+               * top_p[:, :, None, None]).sum(1)              # (T, E, C)
+    dispatch = disp.sum(1).astype(cdtype)                     # (T, E, C)
+
+    # expert inputs: the big resharding einsum — tokens (dp-sharded)
+    # -> expert-major (ep-sharded): XLA inserts the all-to-all here.
+    ein = jnp.einsum("tec,td->ecd", dispatch, xf)             # (E, C, d)
+    if constrain is not None:
+        ein = constrain(ein, ("experts", "expert_capacity", "embed"))
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", ein,
+                               gate_w.astype(cdtype)))
+    h = h * jnp.einsum("ecd,edf->ecf", ein, up_w.astype(cdtype))
+    if constrain is not None:
+        h = constrain(h, ("experts", "expert_capacity", "mlp"))
+    eout = jnp.einsum("ecf,efd->ecd", h, down_w.astype(cdtype))
+    if constrain is not None:
+        eout = constrain(eout, ("experts", "expert_capacity", "embed"))
+
+    y = jnp.einsum("tec,ecd->td", combine.astype(cdtype), eout)
+    y = y.reshape(b, s, d)
+
+    # Aux: switch-style load-balance loss + routing stats.
+    frac_tokens = jnp.mean(assign[:, 0, :].astype(jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    lb_loss = E * jnp.sum(frac_tokens * frac_probs)
+    dropped = 1.0 - (jnp.sum(dispatch) / (T * top_k))
+    return y, {"moe_load_balance_loss": lb_loss,
+               "moe_dropped_fraction": dropped.astype(jnp.float32)}
+
+
+MOE_PARAM_AXES = {
+    "router": ("embed", None),
+    "moe_gate": ("experts", "embed", "mlp"),
+    "moe_up": ("experts", "embed", "mlp"),
+    "moe_down": ("experts", "mlp", "embed"),
+}

@@ -1,0 +1,353 @@
+"""Llama-family decoder: GQA + RoPE + SwiGLU on ray_tpu.ops kernels.
+
+Pure-pytree parameters (no module framework): `init` builds the tree,
+`param_logical_axes` mirrors it with logical axis names consumed by
+ray_tpu.parallel.sharding, `apply`/`loss` are jit-friendly functions.
+Layers are stacked on a leading "layers" axis and executed with
+`lax.scan` so XLA compiles one layer body regardless of depth; with
+`config.remat` the body is wrapped in `jax.checkpoint` trading FLOPs
+for HBM (SURVEY.md §7 hardware notes).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from ray_tpu.models.config import TransformerConfig
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.losses import softmax_cross_entropy
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.ring_attention import ring_attention_sharded
+from ray_tpu.parallel.sharding import with_logical_constraint
+
+Params = Dict[str, Any]
+
+# Activation logical axes (all optional constraints; params use the
+# rules in parallel.sharding directly).
+_ACT_RULES_EXTRA = {"act_embed": None, "expert_capacity": None}
+
+
+def _rules():
+    from ray_tpu.parallel.sharding import LOGICAL_AXIS_RULES
+    rules = dict(LOGICAL_AXIS_RULES)
+    rules.update(_ACT_RULES_EXTRA)
+    return rules
+
+
+class Transformer:
+    """Functional model bundle for one TransformerConfig."""
+
+    def __init__(self, config: TransformerConfig,
+                 mesh: Optional[Mesh] = None):
+        self.config = config
+        self.mesh = mesh
+
+    def _platform(self):
+        """Platform the forward will actually run on: the mesh's devices
+        when bound to a mesh (may differ from the default backend — e.g.
+        a virtual CPU mesh on a TPU host), else the default backend."""
+        if self.mesh is None:
+            return None
+        from ray_tpu.ops.dispatch import mesh_platform
+        return mesh_platform(self.mesh)
+
+    # ------------------------------------------------------------ init
+    def init(self, key: jax.Array) -> Params:
+        c = self.config
+        pd = c.parameter_dtype
+        e, f, hd = c.d_model, c.d_ff, c.head_dim
+        qd, kvd = c.n_heads * hd, c.kv_heads * hd
+        k = iter(jax.random.split(key, 16))
+        std = 0.02
+        out_std = std / math.sqrt(2 * c.n_layers)
+
+        def w(key, shape, scale):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * scale).astype(pd)
+
+        L = c.n_layers
+        layers: Params = {
+            "attn_norm": jnp.zeros((L, e), pd),
+            "wq": w(next(k), (L, e, qd), std),
+            "wk": w(next(k), (L, e, kvd), std),
+            "wv": w(next(k), (L, e, kvd), std),
+            "wo": w(next(k), (L, qd, e), out_std),
+            "mlp_norm": jnp.zeros((L, e), pd),
+        }
+        if c.moe_num_experts:
+            E = c.moe_num_experts
+            layers.update({
+                "router": w(next(k), (L, e, E), std),
+                "moe_gate": w(next(k), (L, E, e, f), std),
+                "moe_up": w(next(k), (L, E, e, f), std),
+                "moe_down": w(next(k), (L, E, f, e), out_std),
+            })
+        else:
+            layers.update({
+                "gate": w(next(k), (L, e, f), std),
+                "up": w(next(k), (L, e, f), std),
+                "down": w(next(k), (L, f, e), out_std),
+            })
+        params: Params = {
+            "embed": w(next(k), (c.vocab_size, e), std),
+            "layers": layers,
+            "final_norm": jnp.zeros((e,), pd),
+        }
+        if not c.tie_embeddings:
+            params["lm_head"] = w(next(k), (e, c.vocab_size), std)
+        return params
+
+    def param_logical_axes(self) -> Params:
+        layers = {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "embed"),
+        }
+        if self.config.moe_num_experts:
+            layers.update({
+                "router": ("layers", "embed", None),
+                "moe_gate": ("layers", "experts", "embed", "mlp"),
+                "moe_up": ("layers", "experts", "embed", "mlp"),
+                "moe_down": ("layers", "experts", "mlp", "embed"),
+            })
+        else:
+            layers.update({
+                "gate": ("layers", "embed", "mlp"),
+                "up": ("layers", "embed", "mlp"),
+                "down": ("layers", "mlp", "embed"),
+            })
+        axes = {
+            "embed": ("vocab", "embed"),
+            "layers": layers,
+            "final_norm": ("embed",),
+        }
+        if not self.config.tie_embeddings:
+            axes["lm_head"] = ("embed", "vocab")
+        return axes
+
+    # --------------------------------------------------------- forward
+    def _attention(self, q, k, v):
+        c = self.config
+        if (c.use_ring_attention and self.mesh is not None
+                and self.mesh.shape.get("sp", 1) > 1):
+            return ring_attention_sharded(q, k, v, self.mesh, causal=True)
+        if c.remat and c.remat_policy == "save_attn":
+            from ray_tpu.ops.attention import flash_attention_saveable
+            from ray_tpu.ops.dispatch import on_tpu
+            if on_tpu():
+                return flash_attention_saveable(
+                    q, k, v, causal=True, block_q=c.attn_block_q,
+                    block_k=c.attn_block_k)
+            # off-TPU the einsum fallback has no kernel to spare; plain
+            # path keeps CPU tests exercising the same math.
+        return flash_attention(q, k, v, causal=True,
+                               block_q=c.attn_block_q,
+                               block_k=c.attn_block_k)
+
+    def _constrain(self, x, axes):
+        if self.mesh is None:
+            return x
+        return with_logical_constraint(x, axes, mesh=self.mesh,
+                                       rules=_rules())
+
+    def _embed_lookup(self, table, tokens):
+        """Token embedding. With the table sharded (vocab->tp,
+        embed->fsdp) a gather forces SPMD involuntary full
+        rematerialization (xla spmd_partitioner.cc:652); the one-hot
+        contraction partitions cleanly (the vocab axis reduces with a
+        psum over tp) and runs on the MXU, so it is what the sharded
+        path uses — the same trade MaxText makes on TPU."""
+        m = self.mesh
+        if m is None or (m.shape.get("tp", 1) == 1
+                         and m.shape.get("fsdp", 1) == 1):
+            return table[tokens]
+        onehot = jax.nn.one_hot(tokens, self.config.vocab_size,
+                                dtype=table.dtype)
+        onehot = self._constrain(onehot, ("batch", "seq", "vocab"))
+        return onehot @ table
+
+    def _layer(self, x, layer: Params, rope):
+        """One block; returns (x, moe_aux_loss) — 0.0 for dense FFN."""
+        c = self.config
+        ad = c.activation_dtype
+        b, s, e = x.shape
+        hd = c.head_dim
+
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q = (h @ layer["wq"].astype(ad)).reshape(b, s, c.n_heads, hd)
+        k = (h @ layer["wk"].astype(ad)).reshape(b, s, c.kv_heads, hd)
+        v = (h @ layer["wv"].astype(ad)).reshape(b, s, c.kv_heads, hd)
+        from ray_tpu.ops.rope import apply_rope_cached
+        cos, sin = rope
+        q = apply_rope_cached(q, cos, sin)
+        k = apply_rope_cached(k, cos, sin)
+        q = q.transpose(0, 2, 1, 3)   # (b, h, s, hd)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        q = self._constrain(q, ("batch", "heads", "seq", "head_dim"))
+        attn = self._attention(q, k, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, c.n_heads * hd)
+        x = x + attn @ layer["wo"].astype(ad)
+        x = self._constrain(x, ("batch", "seq", "act_embed"))
+
+        h = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+        if c.moe_num_experts:
+            from ray_tpu.models.moe import moe_ffn
+            y, aux = moe_ffn(
+                h, layer["router"], layer["moe_gate"], layer["moe_up"],
+                layer["moe_down"], top_k=c.moe_top_k,
+                capacity_factor=c.moe_capacity_factor,
+                constrain=(None if self.mesh is None else
+                           lambda a, ax: self._constrain(a, ax)))
+            x = x + y
+            return (self._constrain(x, ("batch", "seq", "act_embed")),
+                    aux["moe_load_balance_loss"])
+        gate = jax.nn.silu(h @ layer["gate"].astype(ad))
+        up = h @ layer["up"].astype(ad)
+        mlp = self._constrain(gate * up, ("batch", "seq", "mlp"))
+        x = x + mlp @ layer["down"].astype(ad)
+        return (self._constrain(x, ("batch", "seq", "act_embed")),
+                jnp.float32(0.0))
+
+    def hidden(self, params: Params, tokens: jax.Array,
+               positions: Optional[jax.Array] = None) -> jax.Array:
+        """Trunk: tokens (b, s) -> post-final-norm hidden states (b, s, e)."""
+        return self.hidden_and_aux(params, tokens, positions)[0]
+
+    def hidden_and_aux(self, params: Params, tokens: jax.Array,
+                       positions: Optional[jax.Array] = None):
+        """(hidden states, summed MoE load-balance loss across layers)."""
+        from ray_tpu.ops.dispatch import compute_platform
+        with compute_platform(self._platform()):
+            return self._hidden(params, tokens, positions)
+
+    def _hidden(self, params: Params, tokens: jax.Array,
+                positions: Optional[jax.Array] = None):
+        c = self.config
+        ad = c.activation_dtype
+        b, s = tokens.shape
+        custom_positions = positions is not None
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        x = self._embed_lookup(params["embed"].astype(ad), tokens)
+        x = self._constrain(x, ("batch", "seq", "act_embed"))
+
+        # cos/sin computed once; identical for every layer and cheap to
+        # hold across remat (transcendentals dominate their recompute).
+        from ray_tpu.ops.rope import rope_cos_sin
+        rope = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+
+        remat_policy = None
+        if c.remat and c.remat_policy == "save_attn":
+            from ray_tpu.ops.attention import attn_remat_policy
+            remat_policy = attn_remat_policy()
+
+        def _checkpointed(body):
+            if c.remat:
+                # prevent_cse=False: scan's loop structure already blocks
+                # the CSE hazard; True inserts unfusable barriers.
+                return jax.checkpoint(body, prevent_cse=False,
+                                      policy=remat_policy)
+            return body
+
+        if (self.mesh is not None and self.mesh.shape.get("pp", 1) > 1
+                and c.pipeline_microbatches > 0):
+            if c.moe_num_experts:
+                raise NotImplementedError(
+                    "MoE + pipeline parallelism is not supported yet "
+                    "(the pipeline stage carries activations only)")
+            if custom_positions:
+                raise NotImplementedError(
+                    "pipeline parallelism assumes default positions "
+                    "(rope caches are sliced per microbatch, which is "
+                    "only exact when rows share the arange positions); "
+                    "pass positions=None with pp>1")
+            from ray_tpu.parallel.pipeline import pipeline_apply
+
+            # rope rides as explicit consts: closures over tracers don't
+            # cross the shard_map manual region. Caches are full-batch;
+            # rows are identical (positions broadcast from arange), so
+            # slicing to the microbatch is exact.
+            def stage(stage_layers, xm, cos, sin):
+                rope_mb = (cos[:xm.shape[0]], sin[:xm.shape[0]])
+
+                def sbody(carry, layer):
+                    y, _lb = self._layer(carry, layer, rope_mb)
+                    return y, None
+                out, _ = lax.scan(_checkpointed(sbody), xm, stage_layers)
+                return out
+
+            x = pipeline_apply(self.mesh, stage, params["layers"], x,
+                               c.pipeline_microbatches, consts=rope)
+            return (rms_norm(x, params["final_norm"], c.norm_eps),
+                    jnp.float32(0.0))
+
+        def body(carry, layer):
+            x, aux = carry
+            x, lb = self._layer(x, layer, rope)
+            return (x, aux + lb), None
+
+        (x, moe_aux), _ = lax.scan(_checkpointed(body),
+                                   (x, jnp.float32(0.0)),
+                                   params["layers"])
+        return rms_norm(x, params["final_norm"], c.norm_eps), moe_aux
+
+    def _head(self, params: Params) -> jax.Array:
+        return (params["embed"].T if self.config.tie_embeddings
+                else params["lm_head"])
+
+    def apply(self, params: Params, tokens: jax.Array,
+              positions: Optional[jax.Array] = None) -> jax.Array:
+        """tokens (b, s) int32 -> logits (b, s, vocab) in f32."""
+        c = self.config
+        x = self.hidden(params, tokens, positions)
+        logits = x @ self._head(params).astype(c.activation_dtype)
+        logits = self._constrain(logits, ("batch", "seq", "vocab"))
+        return logits.astype(jnp.float32)
+
+    # ------------------------------------------------------------ loss
+    def loss(self, params: Params, batch: Dict[str, jax.Array]):
+        """Causal LM loss. batch: tokens (b, s); optional loss_mask
+        (b, s) aligned with tokens-as-labels: loss_mask[i] = 0 excludes
+        token i from being counted as a prediction target (use 0 on
+        prompt/padding tokens, 1 on completion tokens)."""
+        c = self.config
+        tokens = batch["tokens"]
+        mask = batch.get("loss_mask")
+
+        def moe_term(aux):
+            if not c.moe_num_experts:
+                return 0.0
+            return c.moe_aux_coef * aux / c.n_layers
+
+        if c.loss_chunk:
+            # Full-length formulation (keeps seq divisible by the chunk):
+            # labels[i] = tokens[i+1], with the final position masked out.
+            from ray_tpu.ops.losses import chunked_lm_loss
+            b, s = tokens.shape
+            x, aux = self.hidden_and_aux(params, tokens)
+            labels = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+            m = (jnp.ones((b, s), jnp.float32) if mask is None
+                 else mask.astype(jnp.float32))
+            m = jnp.concatenate([m[:, 1:], jnp.zeros((b, 1))], axis=1)
+            head = self._head(params).astype(c.activation_dtype)
+            return chunked_lm_loss(x, head, labels, m,
+                                   chunk_size=c.loss_chunk) + moe_term(aux)
+        x, aux = self.hidden_and_aux(params, tokens)
+        logits = x @ self._head(params).astype(c.activation_dtype)
+        logits = self._constrain(logits,
+                                 ("batch", "seq", "vocab"))
+        logits = logits.astype(jnp.float32)[:, :-1]
+        labels = tokens[:, 1:]
+        if mask is not None:
+            mask = mask[:, 1:]
+        loss, _ = softmax_cross_entropy(logits, labels, mask=mask)
+        return loss + moe_term(aux)

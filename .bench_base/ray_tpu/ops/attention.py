@@ -1,0 +1,590 @@
+"""Flash attention: Pallas TPU kernels, forward AND backward.
+
+The hot op of the model zoo. Forward is an online-softmax kernel that
+streams K/V blocks through VMEM on a (batch, head, q-block, k-block)
+grid — O(seq) memory, MXU-shaped matmuls, causal blocks above the
+diagonal skipped. Backward is two Pallas kernels sharing the flash
+recomputation: a dK/dV kernel on a (b, h, k-block, q-block) grid and a
+dQ kernel on (b, h, q-block, k-block), both computing scores in the
+TRANSPOSED (block_k, block_q) orientation so the per-row stats (lse,
+delta) broadcast along sublanes — the cheap direction — instead of
+needing lane-expanded copies; dQ is produced as (b, h, d, s) and
+transposed once by XLA. A blockwise lax.scan backward is kept as the
+cross-check/fallback path (`_flash_bwd_xla`).
+
+Layout: (batch, num_heads, seq, head_dim). GQA supported: K/V may have
+fewer heads (num_kv_heads must divide num_heads) — the kernel maps query
+head h to kv head h // (num_heads // num_kv_heads) in the BlockSpec
+index map, no materialised repeat.
+
+On non-TPU backends the public `flash_attention` falls back to the
+reference einsum implementation; the kernel itself still runs anywhere
+via the Pallas interpreter (used by tests).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+# ------------------------------------------------------------- reference
+def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = True,
+                  sm_scale: Optional[float] = None,
+                  bias: Optional[jax.Array] = None) -> jax.Array:
+    """Plain einsum attention; ground truth + CPU path.
+
+    q: (b, h, s, d); k/v: (b, kvh, s, d) with kvh | h.
+    """
+    b, h, sq, d = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        qi = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        ki = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        logits = jnp.where(qi >= ki, logits, DEFAULT_MASK_VALUE)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# ----------------------------------------------------------- forward krn
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *,
+                      sm_scale: float, causal: bool,
+                      block_q: int, block_k: int, seq_k: int):
+    i = pl.program_id(2)           # q block
+    j = pl.program_id(3)           # k block
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: skip blocks strictly above the diagonal.
+    run = (not causal) or (j * block_k <= i * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+        ki = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            qi = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(qi >= ki, s, DEFAULT_MASK_VALUE)
+        if seq_k % block_k:
+            # tail K block: mask padding columns past the true length,
+            # and zero V's padding rows — they hold garbage and p=0
+            # does not neutralise NaN (0 * NaN = NaN).
+            s = jnp.where(ki < seq_k, s, DEFAULT_MASK_VALUE)
+            vrows = j * block_k + lax.broadcasted_iota(
+                jnp.int32, v.shape, 0)
+            v = jnp.where(vrows < seq_k, v, 0)
+        m_prev = m_ref[:, :1]                      # (bq, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)            # rescale factor
+        p = jnp.exp(s - m_new)                     # (bq, bk)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _final():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(safe_l)            # (bq, 1)
+        # lse laid out (b, h, 8, sq): an (8, block_q) block keeps the
+        # last-two-dims (8, 128) Mosaic tiling rule; sublanes broadcast.
+        lse_ref[0, 0, :, :] = jnp.broadcast_to(lse[:, 0][None, :],
+                                               (8, lse.shape[0]))
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    if h % kvh:
+        raise ValueError(
+            f"num_heads ({h}) must be a multiple of num_kv_heads ({kvh})")
+    group = h // kvh
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (b, h, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_k=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b_, h_, i, j: (b_, h_, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, 8, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),     # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[:, :, 0, :]
+
+
+# ---------------------------------------------------- backward (pallas)
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, *,
+                           sm_scale: float, causal: bool,
+                           block_q: int, block_k: int, seq_q: int):
+    j = pl.program_id(2)           # k block (parallel)
+    i = pl.program_id(3)           # q block (inner scan)
+    nq = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # Causal: k block j only sees q blocks whose max q index reaches it.
+    run = (not causal) or (i * block_q + block_q - 1 >= j * block_k)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        if seq_q % block_q:
+            # q/do padding rows hold garbage and are CONTRACTED into
+            # dk/dv below — zero them (p=0 does not neutralise NaN).
+            qrows = i * block_q + lax.broadcasted_iota(
+                jnp.int32, q.shape, 0)
+            q = jnp.where(qrows < seq_q, q, 0)
+            do = jnp.where(qrows < seq_q, do, 0)
+        lse = lse_ref[0, 0, 0:1, :]            # (1, block_q) f32
+        dlt = dlt_ref[0, 0, 0:1, :]            # (1, block_q) f32
+        # Transposed scores: rows = k positions, cols = q positions.
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # (bk, bq)
+        rows = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 0)
+        cols = i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1)
+        valid = None
+        if causal:
+            valid = rows <= cols
+        if seq_q % block_q:
+            vq = cols < seq_q                  # q-tail: garbage columns
+            valid = vq if valid is None else (valid & vq)
+        pt = jnp.exp(st - lse)                 # (bk, bq)
+        if valid is not None:
+            pt = jnp.where(valid, pt, 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+        dpt = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, bq)
+        dst = pt * (dpt - dlt) * sm_scale
+        if valid is not None:                  # kill 0*inf NaNs from tails
+            dst = jnp.where(valid, dst, 0.0)
+        dk_acc[:] += jax.lax.dot_general(
+            dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+
+    @pl.when(i == nq - 1)
+    def _final():
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                         dqt_ref, dqt_acc, *,
+                         sm_scale: float, causal: bool,
+                         block_q: int, block_k: int, seq_k: int):
+    i = pl.program_id(2)           # q block (parallel)
+    j = pl.program_id(3)           # k block (inner scan)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dqt_acc[:] = jnp.zeros_like(dqt_acc)
+
+    run = (not causal) or (j * block_k <= i * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        if seq_k % block_k:
+            # k padding rows are contracted into dq — zero the garbage.
+            krows = j * block_k + lax.broadcasted_iota(
+                jnp.int32, k.shape, 0)
+            k = jnp.where(krows < seq_k, k, 0)
+        lse = lse_ref[0, 0, 0:1, :]
+        dlt = dlt_ref[0, 0, 0:1, :]
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # (bk, bq)
+        rows = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 0)
+        cols = i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1)
+        valid = None
+        if causal:
+            valid = rows <= cols
+        if seq_k % block_k:
+            vk = rows < seq_k                  # k-tail: garbage rows feed
+            valid = vk if valid is None else (valid & vk)  # the contraction
+        pt = jnp.exp(st - lse)
+        if valid is not None:
+            pt = jnp.where(valid, pt, 0.0)
+        dpt = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, bq)
+        dst = pt * (dpt - dlt) * sm_scale
+        if valid is not None:
+            dst = jnp.where(valid, dst, 0.0)
+        # dq^T accumulation: (d, bq) = k^T (d, bk) @ ds^T (bk, bq).
+        dqt_acc[:] += jax.lax.dot_general(
+            k, dst.astype(k.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _final():
+        dqt_ref[0, 0, :, :] = dqt_acc[:].astype(dqt_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale,
+                      block_q, block_k, interpret):
+    """Full Pallas backward: returns (dq, dk, dv)."""
+    b, h, sq, d = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    group = h // kvh
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                  # (b, h, sq)
+    # Sublane-broadcast stats layout (b, h, 8, sq): tiles (8, block_q)
+    # satisfy Mosaic's (8, 128) rule; kernels read row 0 as (1, block_q).
+    lse8 = jnp.broadcast_to(lse[:, :, None, :], (b, h, 8, sq))
+    dlt8 = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, sq))
+
+    # -------- dk/dv: grid (b, h, k-block, q-block), q innermost --------
+    dkdv_out_dtype = jnp.float32 if group > 1 else k.dtype
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_q=sq),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, j, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, j, i: (b_, h_ // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, j, i: (b_, h_ // group, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, j, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b_, h_, j, i: (b_, h_, 0, i)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b_, h_, j, i: (b_, h_, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, j, i: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, j, i: (b_, h_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), dkdv_out_dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), dkdv_out_dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse8, dlt8)
+    if group > 1:
+        dk = dk.reshape(b, kvh, group, sk, d).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(b, kvh, group, sk, d).sum(axis=2).astype(v.dtype)
+
+    # -------- dq: grid (b, h, q-block, k-block), k innermost -----------
+    dqt = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_k=sk),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b_, h_, i, j: (b_, h_, 0, i)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b_, h_, i, j: (b_, h_, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d, block_q),
+                               lambda b_, h_, i, j: (b_, h_, 0, i)),
+        out_shape=jax.ShapeDtypeStruct((b, h, d, sq), q.dtype),
+        scratch_shapes=[pltpu.VMEM((d, block_q), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse8, dlt8)
+    dq = dqt.swapaxes(2, 3)                    # one XLA transpose
+    return dq, dk, dv
+
+
+# ------------------------------------------------ backward (xla check)
+def _flash_bwd_xla(q, k, v, o, lse, do, causal, sm_scale, block_k):
+    """Blockwise flash backward: scan over K blocks; O(seq·block) memory."""
+    b, h, sq, d = q.shape
+    kvh, sk = k.shape[1], k.shape[2]
+    group = h // kvh
+    if group != 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    # Keep matmul operands in the input dtype (bf16 on TPU) with f32
+    # accumulation — upcasting operands would force f32 MXU passes.
+    kf, vf = k, v
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)  # (b,h,sq)
+
+    block_k = min(block_k, sk)
+    sk_pad = ((sk + block_k - 1) // block_k) * block_k
+    if sk_pad != sk:
+        pad = [(0, 0), (0, 0), (0, sk_pad - sk), (0, 0)]
+        kf = jnp.pad(kf, pad)
+        vf = jnp.pad(vf, pad)
+    nk = sk_pad // block_k
+    kb = kf.reshape(b, h, nk, block_k, d).transpose(2, 0, 1, 3, 4)
+    vb = vf.reshape(b, h, nk, block_k, d).transpose(2, 0, 1, 3, 4)
+    qi = lax.broadcasted_iota(jnp.int32, (sq, block_k), 0)
+
+    def step(dq, blk):
+        j, k_j, v_j = blk                                  # (b,h,bk,d)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_j,
+                       preferred_element_type=jnp.float32) * sm_scale
+        ki = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (sq, block_k), 1)
+        valid = ki < sk
+        if causal:
+            valid = valid & (qi >= ki)
+        if causal or sk_pad != sk:
+            s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse[..., None])                    # (b,h,sq,bk) f32
+        pc = p.astype(q.dtype)
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", pc, do,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do, v_j,
+                        preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[..., None]) * sm_scale).astype(q.dtype)
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_j,
+                             preferred_element_type=jnp.float32)
+        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, q,
+                          preferred_element_type=jnp.float32)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)  # f32 accumulator across blocks
+    dq, (dkb, dvb) = lax.scan(
+        step, dq0, (jnp.arange(nk), kb, vb))
+    dk = dkb.transpose(1, 2, 0, 3, 4).reshape(b, h, sk_pad, d)[:, :, :sk]
+    dv = dvb.transpose(1, 2, 0, 3, 4).reshape(b, h, sk_pad, d)[:, :, :sk]
+    if group != 1:
+        dk = dk.reshape(b, kvh, group, sk, d).sum(axis=2)
+        dv = dv.reshape(b, kvh, group, sk, d).sum(axis=2)
+    return dq.astype(q.dtype), dk.astype(q.dtype), dv.astype(q.dtype)
+
+
+# ----------------------------------------------------------- public API
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    """Returns (out, lse); lse has stop-gradient semantics (its cotangent
+    is ignored by the VJP — it is an auxiliary statistic, not a loss
+    term)."""
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                      interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
+    do, _g_lse = g  # lse cotangent dropped by design (see _flash docstring)
+    q, k, v, out, lse = res
+    return _flash_bwd_pallas(q, k, v, out, lse, do, causal, sm_scale,
+                             block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    return_lse: bool = False):
+    """Dispatching entry point: Pallas on TPU, reference elsewhere.
+
+    Shapes: q (b, h, s, d); k/v (b, kvh, s, d), kvh | h.
+    """
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    from ray_tpu.ops.dispatch import on_tpu as _on_tpu
+    on_tpu = _on_tpu()
+    if return_lse:
+        return _flash(q, k, v, causal, sm_scale, block_q, block_k,
+                      not on_tpu)
+    if not on_tpu:
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, False)[0]
+
+
+def flash_attention_kernel(q, k, v, causal=True, sm_scale=None,
+                           block_q=128, block_k=128):
+    """Force the Pallas kernel path (interpreter off-TPU) — test hook."""
+    from ray_tpu.ops.dispatch import on_tpu as _on_tpu
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k,
+                  not _on_tpu())[0]
+
+
+# --------------------------------------- remat-saveable attention path
+#
+# Under per-layer `jax.checkpoint`, a custom_vjp flash kernel reruns its
+# forward during the backward pass to rebuild residuals — the kernel
+# executes twice per step. This path splits the op so the residuals
+# (out, lse) are *named public values* a checkpoint policy can save:
+#
+#   out, lse = fwd kernel        (no AD; pruned from recompute when saved)
+#   out, lse = checkpoint_name(...)
+#   return _attn_from_saved(q, k, v, stop_grad(out), stop_grad(lse))
+#
+# `_attn_from_saved` is the only differentiable op: its VJP runs the
+# Pallas backward straight from the saved residuals. Cotangents for
+# out/lse die at stop_gradient, so the forward kernel is never
+# differentiated or (with `save_only_these_names("attn_out","attn_lse")`)
+# re-executed. q/k/v are still rematerialised by the layer recompute —
+# that is three cheap matmuls + rope, not the attention kernel.
+
+ATTN_RESIDUAL_NAMES = ("attn_out", "attn_lse")
+
+
+def attn_remat_policy():
+    """Checkpoint policy saving exactly the flash-attention residuals."""
+    return jax.checkpoint_policies.save_only_these_names(
+        *ATTN_RESIDUAL_NAMES)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _attn_from_saved(q, k, v, out, lse, causal, sm_scale, block_q,
+                     block_k, interpret):
+    return out
+
+
+def _afs_fwd(q, k, v, out, lse, causal, sm_scale, block_q, block_k,
+             interpret):
+    return out, (q, k, v, out, lse)
+
+
+def _afs_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, do, causal,
+                                   sm_scale, block_q, block_k, interpret)
+    # out/lse arrive through stop_gradient: their cotangents are dropped
+    # symbolically, these zeros never materialise.
+    return dq, dk, dv, jnp.zeros_like(out), jnp.zeros_like(lse)
+
+
+_attn_from_saved.defvjp(_afs_fwd, _afs_bwd)
+
+
+def flash_attention_saveable(q: jax.Array, k: jax.Array, v: jax.Array,
+                             causal: bool = True,
+                             sm_scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None) -> jax.Array:
+    """Flash attention whose residuals survive `jax.checkpoint` when the
+    wrapping policy is `attn_remat_policy()` (see block comment above).
+    Semantically identical to `flash_attention`; use inside rematted
+    layer bodies."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        from ray_tpu.ops.dispatch import on_tpu as _on_tpu
+        interpret = not _on_tpu()
+    from jax.ad_checkpoint import checkpoint_name
+    # Run the forward kernel on gradient-stopped inputs: pallas_call has
+    # no JVP rule, and the only differentiable route is _attn_from_saved.
+    out, lse = _flash_fwd(lax.stop_gradient(q), lax.stop_gradient(k),
+                          lax.stop_gradient(v), causal, sm_scale,
+                          block_q, block_k, interpret)
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
+    return _attn_from_saved(q, k, v, lax.stop_gradient(out),
+                            lax.stop_gradient(lse), causal, sm_scale,
+                            block_q, block_k, interpret)

@@ -1,0 +1,54 @@
+"""Target-platform resolution for Pallas kernel dispatch.
+
+Pallas TPU kernels must run in interpret mode on CPU, and the decision
+has to follow the devices the computation will actually run on — not
+`jax.default_backend()`. On a TPU host that builds a virtual CPU mesh
+(the multi-chip dry run, tests), the default backend says "tpu" while
+the mesh says "cpu"; keying off the default backend then lowers a
+compiled TPU kernel onto CPU, which XLA rejects.
+
+Ops call `on_tpu()`; code that knows its target devices (a model bound
+to a mesh, a trainer) wraps tracing in `compute_platform(...)`. The
+override is a contextvar read at *trace* time, so it composes with jit:
+whatever platform is active while the function is being traced wins.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Iterator, Optional
+
+import jax
+
+_PLATFORM_OVERRIDE: contextvars.ContextVar[Optional[str]] = (
+    contextvars.ContextVar("ray_tpu_compute_platform", default=None))
+
+
+def mesh_platform(mesh) -> str:
+    """Platform string ("tpu"/"cpu"/...) of a Mesh's devices."""
+    return mesh.devices.flat[0].platform
+
+
+@contextlib.contextmanager
+def compute_platform(platform: Optional[str]) -> Iterator[None]:
+    """Pin the platform ops should compile for while tracing under this
+    context. `None` is a no-op (defer to the default backend)."""
+    if platform is None:
+        yield
+        return
+    token = _PLATFORM_OVERRIDE.set(platform)
+    try:
+        yield
+    finally:
+        _PLATFORM_OVERRIDE.reset(token)
+
+
+def target_platform() -> str:
+    override = _PLATFORM_OVERRIDE.get()
+    if override is not None:
+        return override
+    return jax.default_backend()
+
+
+def on_tpu() -> bool:
+    return target_platform() == "tpu"

@@ -1,0 +1,110 @@
+"""Loss ops. Cross-entropy is computed in f32 with the max-subtracted
+log-sum-exp; supports a vocab-sharded (tp) variant where each shard holds
+a slice of the logits and the reduction runs over the mesh axis.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
+                          mask: Optional[jax.Array] = None,
+                          z_loss: float = 0.0):
+    """Token-level CE. logits (..., vocab) f32/bf16; labels int (...,).
+
+    Returns (mean_loss, per_token_loss). `mask` (same shape as labels,
+    1=count) excludes padding from the mean. `z_loss` adds the standard
+    logsumexp^2 regulariser (stabilises f32->bf16 logits drift).
+    """
+    logits = logits.astype(jnp.float32)
+    # No stop_gradient on the max: the two m-terms must cancel in the
+    # VJP (a half-stopped max adds a spurious one_hot(argmax) to the
+    # gradient of every token).
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    shifted = logits - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0]
+    label_logit = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1)[..., 0]
+    per_token = lse - label_logit
+    if z_loss:
+        per_token = per_token + z_loss * jnp.square(lse)
+    if mask is None:
+        return jnp.mean(per_token), per_token
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(per_token * mask) / denom, per_token
+
+
+def chunked_lm_loss(x: jax.Array, head: jax.Array, labels: jax.Array,
+                    mask: Optional[jax.Array] = None,
+                    chunk_size: int = 512):
+    """LM head projection + CE, scanned over sequence chunks with remat.
+
+    Avoids materialising the full (b, s, vocab) f32 logits (the dominant
+    activation on 30k+ vocabs): each chunk's logits exist only inside a
+    rematerialised scan step, cutting peak memory by s/chunk_size.
+    x: (b, s, e) final hidden states; head: (e, vocab); labels (b, s).
+    Returns mean loss over unmasked positions.
+    """
+    b, s, e = x.shape
+    if mask is None:
+        mask = jnp.ones((b, s), jnp.float32)
+    if s % chunk_size:
+        # pad the tail chunk (mask 0 excludes padding from the loss)
+        pad = chunk_size - s % chunk_size
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+        s += pad
+    n = s // chunk_size
+    xs = x.reshape(b, n, chunk_size, e).transpose(1, 0, 2, 3)
+    ls = labels.reshape(b, n, chunk_size).transpose(1, 0, 2)
+    ms = mask.astype(jnp.float32).reshape(
+        b, n, chunk_size).transpose(1, 0, 2)
+
+    def body(carry, blk):
+        xc, lc, mc = blk
+        logits = (xc @ head).astype(jnp.float32)
+        _, per_token = softmax_cross_entropy(logits, lc)
+        return (carry[0] + jnp.sum(per_token * mc),
+                carry[1] + jnp.sum(mc)), None
+
+    (total, denom), _ = lax.scan(
+        jax.checkpoint(body, prevent_cse=False), (0.0, 0.0),
+        (xs, ls, ms))
+    return total / jnp.maximum(denom, 1.0)
+
+
+def sharded_softmax_cross_entropy(local_logits: jax.Array,
+                                  labels: jax.Array,
+                                  axis: str,
+                                  vocab_shard_size: int,
+                                  mask: Optional[jax.Array] = None):
+    """CE when the vocab dim is sharded over mesh `axis` (inside shard_map).
+
+    Each device holds logits[..., lo:lo+shard]; the logsumexp and the
+    label-logit gather are psum-reduced so no device materialises the
+    full vocab — the tp-sharded LM head never all-gathers its output.
+    """
+    local_logits = local_logits.astype(jnp.float32)
+    lo = lax.axis_index(axis) * vocab_shard_size
+    gmax = lax.pmax(jnp.max(local_logits, axis=-1), axis)
+    shifted = local_logits - gmax[..., None]
+    sumexp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis)
+    lse = jnp.log(sumexp) + gmax
+    local_label = labels - lo
+    in_shard = (local_label >= 0) & (local_label < vocab_shard_size)
+    safe = jnp.clip(local_label, 0, vocab_shard_size - 1)
+    picked = jnp.take_along_axis(local_logits, safe[..., None],
+                                 axis=-1)[..., 0]
+    label_logit = lax.psum(jnp.where(in_shard, picked, 0.0), axis)
+    per_token = lse - label_logit
+    if mask is None:
+        return jnp.mean(per_token), per_token
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(per_token * mask) / denom, per_token

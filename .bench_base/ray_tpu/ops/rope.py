@@ -1,0 +1,54 @@
+"""Rotary position embeddings (RoPE).
+
+Pure JAX: RoPE is elementwise sin/cos mul-add and XLA fuses it into the
+surrounding QK projections; a hand kernel buys nothing here. Supports an
+absolute `positions` argument so sequence-parallel shards (each holding a
+seq slice) rotate with their *global* positions — required for ring
+attention (ray_tpu/ops/ring_attention.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 10000.0) -> jax.Array:
+    """Inverse frequencies for each rotated pair, shape (head_dim//2,)."""
+    if head_dim % 2:
+        raise ValueError(f"head_dim must be even, got {head_dim}")
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponent)
+
+
+def rope_cos_sin(positions: jax.Array, head_dim: int,
+                 theta: float = 10000.0):
+    """Precompute (cos, sin), each (..., seq, 1, head_dim//2) f32.
+
+    Compute once per forward pass and reuse across layers/remat passes —
+    the transcendentals are VPU-expensive and identical for every layer.
+    """
+    inv_freq = rope_frequencies(head_dim, theta)
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    angles = angles[..., None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope_cached(x: jax.Array, cos: jax.Array,
+                      sin: jax.Array) -> jax.Array:
+    """Rotate x (..., seq, heads, head_dim) by precomputed cos/sin."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float = 10000.0) -> jax.Array:
+    """Rotate x of shape (..., seq, heads, head_dim) by per-token angles.
+
+    positions: integer array broadcastable to x.shape[:-2] + (seq,) —
+    usually (batch, seq) or (seq,). Split-halves convention (llama):
+    the first half of head_dim pairs with the second half.
+    """
+    cos, sin = rope_cos_sin(positions, x.shape[-1], theta)
+    return apply_rope_cached(x, cos, sin)

@@ -1,0 +1,34 @@
+"""TPU-first parallelism layer.
+
+This is the ray_tpu replacement for the reference's process-group wiring
+(reference python/ray/train/torch/config.py:66-121, NCCL DDP) and the
+externally-delegated TP/PP/SP strategies catalogued in SURVEY.md §2.4.
+Instead of NCCL process groups we expose:
+
+- :class:`MeshSpec` / :func:`prepare_mesh` — named `jax.sharding.Mesh`
+  construction over (dp, fsdp, tp, sp, ep, pp) axes, single- or multi-slice.
+- logical-axis sharding rules (:mod:`ray_tpu.parallel.sharding`) that map
+  model-logical axes ("batch", "embed", "mlp", "heads", ...) onto mesh axes,
+  GSPMD-style, replacing DDP/FSDP/ZeRO wrappers
+  (reference python/ray/train/torch/train_loop_utils.py:162-202).
+- collective helpers (:mod:`ray_tpu.parallel.collectives`) for use inside
+  ``shard_map`` — the ICI-native analogue of ray.util.collective
+  (reference python/ray/util/collective/collective.py).
+- multi-host bootstrap (:mod:`ray_tpu.parallel.dist`) replacing
+  ``dist.init_process_group`` (reference python/ray/train/torch/xla/config.py:67-75).
+"""
+from ray_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    prepare_mesh,
+    local_mesh,
+    mesh_shape_for,
+)
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    LOGICAL_AXIS_RULES,
+    logical_sharding,
+    shard_pytree,
+    with_logical_constraint,
+    param_shardings,
+)
+from ray_tpu.parallel import collectives  # noqa: F401
+from ray_tpu.parallel.dist import initialize_distributed  # noqa: F401

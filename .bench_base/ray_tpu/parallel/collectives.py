@@ -1,0 +1,92 @@
+"""Collective helpers for shard_map bodies — ICI-native ray.util.collective.
+
+The reference exposes allreduce/broadcast/allgather/reducescatter/send/recv
+over NCCL actor groups (reference python/ray/util/collective/collective.py:
+258,373,423,472,531,594). On TPU the same verbs are XLA collectives emitted
+inside `shard_map`; these wrappers exist so library code and user code share
+one vocabulary, and so the host-side (CPU, cross-process) backend in
+ray_tpu.util.collective can mirror the same API.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def allreduce(x, axis: str, op: str = "sum"):
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def allgather(x, axis: str, *, tiled: bool = True, gather_dim: int = 0):
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reducescatter(x, axis: str, *, scatter_dim: int = 0, tiled: bool = True):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
+                            tiled=tiled)
+
+
+def broadcast(x, axis: str, root: int = 0):
+    """Everyone receives root's shard. Non-root shards are never read
+    (NCCL broadcast tolerates garbage/NaN in non-root buffers), so the
+    non-root contribution is a hard zero via `where`, not a mask multiply."""
+    idx = lax.axis_index(axis)
+    contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(contrib, axis)
+
+
+def all_to_all(x, axis: str, split_dim: int, concat_dim: int, *,
+               tiled: bool = True):
+    """Ulysses-style head/sequence re-sharding primitive."""
+    return lax.all_to_all(x, axis, split_axis=split_dim,
+                          concat_axis=concat_dim, tiled=tiled)
+
+
+def ppermute_ring(x, axis: str, *, shift: int = 1):
+    """Rotate shards around the ring (K/V rotation for ring attention)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def send_recv(x, axis: str, pairs):
+    """Explicit point-to-point: `pairs` is a list of (src, dst) device
+    indices along `axis`. Only named destinations receive; every other
+    device keeps its own buffer — matching NCCL send/recv semantics
+    (reference nccl_collective_group.py send/recv)."""
+    shifted = lax.ppermute(x, axis, pairs)
+    idx = lax.axis_index(axis)
+    is_dst = jnp.zeros((), bool)
+    for _, dst in pairs:
+        is_dst = is_dst | (idx == dst)
+    return jnp.where(is_dst, shifted, x)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)
+
+
+def barrier(axis: str, x=None):
+    """Collective fence. With `x`, threads the fence through the value's
+    data dependency (a zero-valued psum token is added to every leaf) so
+    the collective cannot be dead-code-eliminated; a bare `barrier(axis)`
+    returns the token, which MUST be consumed to have any effect."""
+    token = lax.psum(jnp.zeros((), jnp.int32), axis)
+    if x is None:
+        return token
+    return jax.tree.map(lambda v: v + token.astype(v.dtype), x)

@@ -1,0 +1,77 @@
+"""Multi-host JAX bootstrap — the torch `init_process_group` replacement.
+
+The reference's torch-XLA backend broadcasts a master address and calls
+`dist.init_process_group("xla")` on every worker (reference
+python/ray/train/torch/xla/config.py:67-75,120-169). The JAX analogue is
+`jax.distributed.initialize(coordinator, num_processes, process_id)`: all
+hosts join one multi-controller SPMD program and `jax.devices()` becomes
+the global pod view. ray_tpu.train's JaxBackend calls this on every
+worker actor with a rendezvous address fanned out from worker 0.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None,
+                           local_device_ids=None) -> None:
+    """Join the global JAX distributed runtime (idempotent).
+
+    With no args, relies on TPU metadata / env autodetection (GKE, GCE),
+    mirroring the reference's TPU pod probing
+    (reference python/ray/_private/accelerators/tpu.py:48-68,198-228).
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    if num_processes is not None and num_processes <= 1 and (
+            coordinator_address is None):
+        # Single-process: nothing to rendezvous.
+        _initialized = True
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    logger.info("jax.distributed.initialize(%s)", kwargs)
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+
+
+def is_distributed_initialized() -> bool:
+    return _initialized
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def coordinator_env() -> dict:
+    """Env vars a worker-group launcher should fan out (parity with the
+    reference's MASTER_ADDR/MASTER_PORT fanout,
+    reference python/ray/train/torch/config.py:156-200)."""
+    return {
+        k: v for k, v in os.environ.items()
+        if k.startswith(("JAX_", "TPU_", "MEGASCALE_"))
+    }

@@ -1,0 +1,148 @@
+"""Named device-mesh construction for TPU pods and slices.
+
+The reference wires data-parallel groups with NCCL ranks
+(reference python/ray/train/torch/config.py:153-213); on TPU the analogue
+is a `jax.sharding.Mesh` whose axes name the parallelism strategies.
+Collectives ride ICI within a slice and DCN across slices — we use
+`mesh_utils.create_hybrid_device_mesh` when >1 slice is present so the
+outermost (data/pipeline) axes land on DCN and inner (model) axes on ICI.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Canonical axis order: outermost (slowest-varying, DCN-friendly) first.
+# pp/dp/fsdp cross slices fine; tp/sp/ep want ICI locality so they're innermost.
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape over the canonical parallelism axes.
+
+    Any axis set to -1 absorbs the remaining device count (at most one).
+    Axes of size 1 are still materialised in the mesh so sharding rules can
+    reference them unconditionally (a size-1 axis shards to replication).
+    """
+
+    dp: int = -1     # data parallel (gradient psum)
+    fsdp: int = 1    # fully-sharded params/optimizer (ZeRO-3 analogue)
+    tp: int = 1      # tensor parallel (megatron-style matmul sharding)
+    sp: int = 1      # sequence/context parallel (ring attention axis)
+    ep: int = 1      # expert parallel (MoE all_to_all axis)
+    pp: int = 1      # pipeline parallel (inter-slice / DCN axis)
+
+    def resolve(self, n_devices: int) -> Tuple[int, ...]:
+        """Concrete per-axis sizes in AXIS_ORDER, -1 axis inferred."""
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one axis may be -1, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[wild[0]] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {math.prod(sizes.values())} devices, "
+                f"have {n_devices}")
+        return tuple(sizes[a] for a in AXIS_ORDER)
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        return prepare_mesh(self, devices)
+
+
+def _num_slices(devices: Sequence[jax.Device]) -> int:
+    """Count distinct TPU slices (DCN-connected groups) among devices."""
+    ids = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    return max(len(ids), 1)
+
+
+def prepare_mesh(spec: MeshSpec | None = None,
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 **axes: int) -> Mesh:
+    """Build a named Mesh from a MeshSpec (or axis kwargs).
+
+    ``prepare_mesh(dp=4, tp=2)`` is the TPU-era `prepare_model` entry point:
+    the returned mesh is what all sharding rules and pjit'ed steps close over.
+    """
+    if spec is None:
+        spec = MeshSpec(**axes) if axes else MeshSpec()
+    elif axes:
+        raise ValueError("pass either a MeshSpec or axis kwargs, not both")
+    devices = list(devices if devices is not None else jax.devices())
+    shape = spec.resolve(len(devices))
+    n_slices = _num_slices(devices)
+    if n_slices > 1 and len(devices) % n_slices == 0:
+        # Hybrid mesh: outer axes over DCN (slices), inner over ICI.
+        per_slice = len(devices) // n_slices
+        dcn_shape, ici_shape = _split_hybrid(shape, n_slices, per_slice)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+    else:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+# Axes allowed to span DCN (slice boundaries). tp/sp/ep are ICI-only:
+# their collectives are latency/bandwidth-critical per-layer, and landing
+# them on DCN silently would be a performance cliff, so we refuse.
+_DCN_AXES = frozenset({"pp", "dp", "fsdp"})
+
+
+def _split_hybrid(shape: Tuple[int, ...], n_slices: int,
+                  per_slice: int) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """Factor each axis into (dcn, ici) parts, consuming slices outermost-first.
+
+    Only pp/dp/fsdp may absorb the slice factor — the inner model axes
+    (sp/ep/tp) always stay within a slice (ICI)."""
+    dcn, ici = [], []
+    remaining = n_slices
+    for axis, size in zip(AXIS_ORDER, shape):
+        allowed = axis in _DCN_AXES
+        if allowed and remaining > 1 and size % remaining == 0:
+            dcn.append(remaining)
+            ici.append(size // remaining)
+            remaining = 1
+        elif allowed and remaining > 1 and remaining % size == 0 and size > 1:
+            dcn.append(size)
+            ici.append(1)
+            remaining //= size
+        else:
+            dcn.append(1)
+            ici.append(size)
+    if remaining != 1:
+        raise ValueError(
+            f"cannot place {n_slices} slices onto mesh shape {shape}; "
+            "make an outer axis (pp/dp/fsdp) a multiple of the slice count")
+    if math.prod(ici) != per_slice:
+        raise ValueError(
+            f"inner (ICI) mesh {ici} needs {math.prod(ici)} devices per "
+            f"slice but each slice has {per_slice}")
+    return tuple(dcn), tuple(ici)
+
+
+def local_mesh(**axes: int) -> Mesh:
+    """Mesh over this process's addressable devices only (single-host debug)."""
+    return prepare_mesh(MeshSpec(**axes) if axes else None,
+                        devices=jax.local_devices())
+
+
+def mesh_shape_for(n_devices: int, model_axes: int = 1) -> MeshSpec:
+    """Heuristic default: put `model_axes` devices on tp, rest on dp."""
+    if n_devices % model_axes:
+        raise ValueError(f"{n_devices} % {model_axes} != 0")
+    return MeshSpec(dp=n_devices // model_axes, tp=model_axes)
+
+
+def device_count(mesh: Mesh) -> int:
+    return int(np.prod(list(mesh.shape.values())))

@@ -1,0 +1,26 @@
+"""RLlib-equivalent: TPU-native reinforcement learning on ray_tpu.
+
+Component layout mirrors the reference's new API stack (SURVEY.md §2.3):
+ActorCriticModule ~ RLModule, PPOLearner/LearnerGroup ~ Learner stack,
+SingleAgentEnvRunner/EnvRunnerGroup ~ EnvRunner stack, and
+FaultTolerantActorManager as the shared actor-fleet substrate.
+"""
+from ray_tpu.rllib.actor_manager import (CallResult,
+                                         FaultTolerantActorManager,
+                                         RemoteCallResults)
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.learner import (LearnerGroup, PPOLearner,
+                                        PPOLearnerConfig)
+from ray_tpu.rllib.core.rl_module import ActorCriticModule, Categorical
+from ray_tpu.rllib.env.env_runner import EnvRunnerConfig, SingleAgentEnvRunner
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rllib.tune_adapter import tune_trainable
+
+__all__ = [
+    "AlgorithmConfig",
+    "PPO", "PPOConfig", "PPOLearner", "PPOLearnerConfig", "LearnerGroup",
+    "ActorCriticModule", "Categorical", "SingleAgentEnvRunner",
+    "EnvRunnerConfig", "EnvRunnerGroup", "FaultTolerantActorManager",
+    "RemoteCallResults", "CallResult", "tune_trainable",
+]

@@ -1,0 +1,16 @@
+from ray_tpu.rllib.algorithms.appo import (APPO, APPOConfig,
+                                            APPOLearner)
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, QModule
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
+from ray_tpu.rllib.algorithms.impala import (IMPALA, IMPALAConfig,
+                                             IMPALALearner,
+                                             IMPALALearnerConfig,
+                                             vtrace_returns)
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACModule
+
+__all__ = ["APPO", "APPOConfig", "APPOLearner",
+           "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "IMPALALearner",
+           "IMPALALearnerConfig", "vtrace_returns", "DQN", "DQNConfig",
+           "QModule", "SAC", "SACConfig", "SACModule",
+           "DreamerV3", "DreamerV3Config"]

@@ -1,0 +1,149 @@
+"""APPO: asynchronous PPO — IMPALA's pipeline with a clipped surrogate.
+
+Parity: reference rllib/algorithms/appo/appo.py (+ appo_torch_learner):
+async env runners feed a queued learner exactly as IMPALA does, but the
+policy loss is PPO's clipped surrogate over V-trace advantages, with a
+periodically-refreshed TARGET network providing the stable old-policy
+for a KL regularizer (the reference's target_network_update_freq +
+use_kl_loss path). Everything rides the IMPALA machinery here: same
+runner group, sample queue, and single-jit update — only the loss and
+the target-params state differ.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.impala import (IMPALA, IMPALAConfig,
+                                             IMPALALearner,
+                                             IMPALALearnerConfig,
+                                             vtrace_returns)
+from ray_tpu.rllib.core.rl_module import Categorical
+
+
+@dataclasses.dataclass
+class APPOConfig(IMPALAConfig):
+    clip_eps: float = 0.2
+    use_kl_loss: bool = True
+    kl_coef: float = 0.2
+    target_network_update_freq: int = 16    # learner updates per refresh
+
+
+@dataclasses.dataclass
+class APPOLearnerConfig(IMPALALearnerConfig):
+    clip_eps: float = 0.2
+    use_kl_loss: bool = True
+    kl_coef: float = 0.2
+    target_network_update_freq: int = 16
+
+
+class APPOLearner(IMPALALearner):
+    """V-trace advantages + clipped surrogate + target-network KL."""
+
+    # (params, target_params, opt_state) precede the batch
+    N_REPLICATED_ARGS = 3
+
+    def __init__(self, config: APPOLearnerConfig):
+        super().__init__(config)
+        self.target_params = jax.tree_util.tree_map(jnp.copy,
+                                                    self.params)
+
+    def _build_update(self):
+        c = self.config
+        module = self.module
+
+        def loss_fn(params, target_params, batch):
+            logits, value = module.forward(params, batch["obs"])
+            logits = logits[:-1]                       # (T, N, A)
+            logp = Categorical.log_prob(logits, batch["actions"])
+            vs, pg_adv, _rho = vtrace_returns(
+                jax.lax.stop_gradient(value), batch["rewards"],
+                batch["terminateds"], batch["dones"], batch["logp"],
+                jax.lax.stop_gradient(logp), c.gamma,
+                c.vtrace_rho_clip, c.vtrace_c_clip)
+            m = batch["mask"]
+            denom = jnp.maximum(jnp.sum(m), 1.0)
+            # PPO clipped surrogate against the BEHAVIOUR policy's logp
+            # (reference appo_torch_learner: ratio to the sampling
+            # policy, advantages from v-trace)
+            ratio = jnp.exp(logp - batch["logp"])
+            adv = pg_adv
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - c.clip_eps, 1 + c.clip_eps) * adv)
+            pg_loss = -jnp.sum(surr * m) / denom
+            v_loss = 0.5 * jnp.sum(
+                jnp.square(vs - value[:-1]) * m) / denom
+            ent = jnp.sum(Categorical.entropy(logits) * m) / denom
+            total = pg_loss + c.vf_coef * v_loss - c.ent_coef * ent
+            kl = jnp.zeros(())
+            if c.use_kl_loss:
+                t_logits, _ = module.forward(target_params, batch["obs"])
+                t_logits = jax.lax.stop_gradient(t_logits[:-1])
+                t_logp_all = jax.nn.log_softmax(t_logits, axis=-1)
+                logp_all = jax.nn.log_softmax(logits, axis=-1)
+                kl_tn = jnp.sum(jnp.exp(t_logp_all)
+                                * (t_logp_all - logp_all), axis=-1)
+                kl = jnp.sum(kl_tn * m) / denom
+                total = total + c.kl_coef * kl
+            return total, {"policy_loss": pg_loss, "vf_loss": v_loss,
+                           "entropy": ent, "kl_to_target": kl,
+                           "mean_rho": jnp.sum(_rho * m) / denom}
+
+        def update(params, target_params, opt_state, batch):
+            (_, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, metrics
+
+        return update
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        t0 = time.perf_counter()
+        self.params, self.opt_state, metrics = self._update_fn(
+            self.params, self.target_params, self.opt_state, batch)
+        metrics = {k: float(v) for k, v in metrics.items()}
+        dt = time.perf_counter() - t0
+        self.version += 1
+        self._timer["updates"] += 1
+        self._timer["update_time"] += dt
+        self._timer["transitions"] += int(np.prod(batch["rewards"].shape))
+        if self.version % self.config.target_network_update_freq == 0:
+            self.target_params = jax.tree_util.tree_map(jnp.copy,
+                                                        self.params)
+        metrics["update_time_s"] = dt
+        return metrics
+
+
+class APPO(IMPALA):
+    """Asynchronous PPO on the IMPALA pipeline."""
+
+    LEARNER_CLS = APPOLearner
+    LEARNER_CONFIG_CLS = APPOLearnerConfig
+
+    def get_state(self):
+        state = super().get_state()
+        # the KL target net is part of the learner state (restoring
+        # without it would regularize toward a random network)
+        state["target_params"] = jax.device_get(
+            self.learner.target_params)
+        return state
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        if "target_params" in state:
+            self.learner.target_params = jax.device_put(
+                state["target_params"])
+        else:
+            self.learner.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.learner.params)
+
+
+APPOConfig.algo_class = APPO

@@ -1,0 +1,462 @@
+"""DreamerV3: model-based RL via a recurrent state-space world model.
+
+Parity (structure, not scale) with reference rllib/algorithms/dreamerv3
+(dreamerv3.py + tf world_model.py / actor_network.py / critic_network.py,
+itself after Hafner et al. 2023):
+- RSSM world model: GRU deterministic path + categorical stochastic
+  latents (straight-through gradients), encoder/decoder in symlog
+  space, reward + continue heads, KL balancing with free bits.
+- Actor + critic trained purely on IMAGINED rollouts through the world
+  model: lambda-returns over predicted rewards/continues, return-range
+  normalization (EMA of the 5th..95th percentile spread), entropy-
+  regularized REINFORCE for the discrete actor.
+- A recurrent env runner carries (h, z) across real steps and resets
+  them at episode boundaries.
+
+Everything trains in ONE jitted update per iteration — world model,
+actor, and critic — the TPU-native shape of the reference's three
+optimizers. Discrete action spaces (the reference's Atari/gym path).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+
+
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def _dense(key, din, dout, scale=1.0):
+    w = jax.random.normal(key, (din, dout)) * scale / np.sqrt(din)
+    return {"w": w.astype(jnp.float32),
+            "b": jnp.zeros((dout,), jnp.float32)}
+
+
+def _mlp_init(key, dims, scale_last=1.0):
+    keys = jax.random.split(key, len(dims) - 1)
+    return [_dense(k, dims[i], dims[i + 1],
+                   1.0 if i < len(dims) - 2 else scale_last)
+            for i, k in enumerate(keys)]
+
+
+def _mlp(layers, x):
+    for layer in layers[:-1]:
+        x = jax.nn.silu(x @ layer["w"] + layer["b"])
+    last = layers[-1]
+    return x @ last["w"] + last["b"]
+
+
+def _gru_init(key, din, dh):
+    k1, k2 = jax.random.split(key)
+    return {"wi": _dense(k1, din, 3 * dh),
+            "wh": _dense(k2, dh, 3 * dh)}
+
+
+def _gru(p, h, x):
+    gi = x @ p["wi"]["w"] + p["wi"]["b"]
+    gh = h @ p["wh"]["w"] + p["wh"]["b"]
+    ir, iz, inn = jnp.split(gi, 3, axis=-1)
+    hr, hz, hnn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(inn + r * hnn)
+    return (1.0 - z) * n + z * h
+
+
+@dataclasses.dataclass
+class DreamerV3Config(AlgorithmConfig):
+    env: str = "CartPole-v1"
+    num_envs: int = 16
+    rollout_length: int = 32
+    # --- world model
+    deter_dim: int = 128                   # GRU state
+    n_categoricals: int = 8
+    n_classes: int = 8
+    embed_dim: int = 64
+    units: int = 128                       # MLP width
+    wm_lr: float = 4e-4
+    free_bits: float = 1.0
+    kl_dyn_scale: float = 0.5
+    kl_rep_scale: float = 0.1
+    # --- behavior (imagination)
+    horizon: int = 15
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    actor_lr: float = 4e-5
+    critic_lr: float = 1e-4
+    ent_coef: float = 3e-3
+    imag_starts: int = 256                 # imagined trajectories/update
+    num_updates_per_iteration: int = 4
+    seed: int = 0
+
+class DreamerV3:
+    """sample (recurrent runner) -> world-model + behavior updates."""
+
+    def __init__(self, config: DreamerV3Config):
+        import gymnasium as gym
+        self.config = c = config
+        self._envs = gym.make_vec(c.env, num_envs=c.num_envs,
+                                  vectorization_mode="sync")
+        space = self._envs.single_action_space
+        if not hasattr(space, "n"):
+            raise ValueError("DreamerV3 (this stack) needs a discrete "
+                             "action space")
+        self.obs_dim = int(np.prod(
+            self._envs.single_observation_space.shape))
+        self.num_actions = int(space.n)
+        self.z_dim = c.n_categoricals * c.n_classes
+        self.feat_dim = c.deter_dim + self.z_dim
+
+        key = jax.random.PRNGKey(c.seed)
+        ks = jax.random.split(key, 12)
+        u, D = c.units, c.deter_dim
+        self.wm = {
+            "enc": _mlp_init(ks[0], (self.obs_dim, u, c.embed_dim)),
+            "gru": _gru_init(ks[1], self.z_dim + self.num_actions, D),
+            "prior": _mlp_init(ks[2], (D, u, self.z_dim)),
+            "post": _mlp_init(ks[3], (D + c.embed_dim, u, self.z_dim)),
+            "dec": _mlp_init(ks[4], (self.feat_dim, u, self.obs_dim)),
+            "rew": _mlp_init(ks[5], (self.feat_dim, u, 1), 0.0),
+            "cont": _mlp_init(ks[6], (self.feat_dim, u, 1)),
+        }
+        self.actor = _mlp_init(ks[7], (self.feat_dim, u, u,
+                                       self.num_actions), 0.01)
+        self.critic = _mlp_init(ks[8], (self.feat_dim, u, u, 1), 0.0)
+        self._wm_tx = optax.chain(optax.clip_by_global_norm(100.0),
+                                  optax.adam(c.wm_lr, eps=1e-8))
+        self._actor_tx = optax.chain(optax.clip_by_global_norm(100.0),
+                                     optax.adam(c.actor_lr, eps=1e-8))
+        self._critic_tx = optax.chain(optax.clip_by_global_norm(100.0),
+                                      optax.adam(c.critic_lr, eps=1e-8))
+        self.wm_opt = self._wm_tx.init(self.wm)
+        self.actor_opt = self._actor_tx.init(self.actor)
+        self.critic_opt = self._critic_tx.init(self.critic)
+        # return-range EMA (reference's percentile return normalizer)
+        self.ret_scale = jnp.asarray(1.0)
+        self._key = ks[9]
+
+        self._update_fn = jax.jit(self._build_update())
+        self._act_fn = jax.jit(self._build_act())
+
+        self._obs, _ = self._envs.reset(seed=c.seed)
+        self._h = np.zeros((c.num_envs, D), np.float32)
+        self._z = np.zeros((c.num_envs, self.z_dim), np.float32)
+        self._prev_a = np.zeros(c.num_envs, np.int64)
+        self._prev_done = np.zeros(c.num_envs, bool)
+        self._ep_ret = np.zeros(c.num_envs)
+        self._recent: list = []
+        self._total_steps = 0
+        self.iteration = 0
+
+    # ----------------------------------------------------- rssm pieces
+    def _sample_z(self, logits, key):
+        """Straight-through categorical sample -> flat one-hot z."""
+        c = self.config
+        lg = logits.reshape(logits.shape[:-1]
+                            + (c.n_categoricals, c.n_classes))
+        # unimix (1% uniform) keeps gradients alive (reference)
+        probs = 0.99 * jax.nn.softmax(lg, -1) + 0.01 / c.n_classes
+        lg = jnp.log(probs)
+        idx = jax.random.categorical(key, lg)
+        one = jax.nn.one_hot(idx, c.n_classes)
+        st = one + jax.nn.softmax(lg, -1) - jax.lax.stop_gradient(
+            jax.nn.softmax(lg, -1))
+        return st.reshape(st.shape[:-2] + (self.z_dim,)), lg
+
+    def _kl(self, lhs_logits, rhs_logits):
+        """KL(lhs || rhs) summed over categoricals (both already
+        unimixed log-probs of shape (..., n_cat, n_cls))."""
+        p = jnp.exp(lhs_logits)
+        return jnp.sum(p * (lhs_logits - rhs_logits), axis=(-2, -1))
+
+    # ------------------------------------------------------------- jit
+    def _build_act(self):
+        c = self.config
+
+        def act(wm, actor, h, z, obs, prev_a, reset, key):
+            k1, k2 = jax.random.split(key)
+            h = h * (1.0 - reset)[:, None]
+            z = z * (1.0 - reset)[:, None]
+            a_oh = jax.nn.one_hot(prev_a, self.num_actions) \
+                * (1.0 - reset)[:, None]
+            h = _gru(wm["gru"], h, jnp.concatenate([z, a_oh], -1))
+            embed = _mlp(wm["enc"], symlog(obs))
+            post_logits = _mlp(wm["post"],
+                               jnp.concatenate([h, embed], -1))
+            z, _ = self._sample_z(post_logits, k1)
+            feat = jnp.concatenate([h, z], -1)
+            a = jax.random.categorical(k2, _mlp(actor, feat))
+            return h, z, a
+
+        return act
+
+    def _build_update(self):
+        c = self.config
+
+        def observe(wm, batch, key):
+            """Posterior scan over the real sequence; returns features
+            + per-step stats. batch obs (T+1,N,D); actions (T,N)."""
+            obs = symlog(batch["obs"])
+            T, N = batch["actions"].shape
+            embeds = _mlp(wm["enc"], obs)          # (T+1, N, E)
+            a_oh = jax.nn.one_hot(batch["actions"], self.num_actions)
+            # state input at t uses a_{t-1} (zero at t=0)
+            a_prev = jnp.concatenate(
+                [jnp.zeros_like(a_oh[:1]), a_oh[:-1]], 0)
+            # reset at t where the previous step ended an episode
+            reset = jnp.concatenate(
+                [jnp.ones((1, N)), batch["dones"][:-1]], 0)
+            keys = jax.random.split(key, T)
+
+            def step(carry, inp):
+                h, z = carry
+                embed_t, a_t, reset_t, k = inp
+                h = h * (1.0 - reset_t)[:, None]
+                z = z * (1.0 - reset_t)[:, None]
+                h = _gru(wm["gru"], h,
+                         jnp.concatenate([z, a_t], -1))
+                prior_logits = _mlp(wm["prior"], h)
+                post_logits = _mlp(wm["post"],
+                                   jnp.concatenate([h, embed_t], -1))
+                z, post_lg = self._sample_z(post_logits, k)
+                _, prior_lg = self._sample_z(prior_logits, k)
+                return (h, z), (h, z, post_lg, prior_lg)
+
+            (h, z), (hs, zs, post_lg, prior_lg) = jax.lax.scan(
+                step,
+                (jnp.zeros((N, c.deter_dim)),
+                 jnp.zeros((N, self.z_dim))),
+                (embeds[:T], a_prev, reset, keys))
+            return hs, zs, post_lg, prior_lg
+
+        def wm_loss(wm, batch, key):
+            obs_sym = symlog(batch["obs"])
+            T, N = batch["actions"].shape
+            m = batch["mask"]
+            hs, zs, post_lg, prior_lg = observe(wm, batch, key)
+            feat = jnp.concatenate([hs, zs], -1)   # (T, N, F)
+            recon = _mlp(wm["dec"], feat)
+            l_rec = jnp.sum(jnp.square(recon - obs_sym[:T]), -1)
+            rew_p = _mlp(wm["rew"], feat)[..., 0]
+            l_rew = jnp.square(rew_p - symlog(batch["rewards"]))
+            cont_p = _mlp(wm["cont"], feat)[..., 0]
+            cont_t = 1.0 - batch["terminateds"]
+            l_cont = optax.sigmoid_binary_cross_entropy(cont_p, cont_t)
+            # KL balancing + free bits (reference dreamerv3)
+            kl_dyn = self._kl(jax.lax.stop_gradient(post_lg), prior_lg)
+            kl_rep = self._kl(post_lg, jax.lax.stop_gradient(prior_lg))
+            l_kl = (c.kl_dyn_scale * jnp.maximum(kl_dyn, c.free_bits)
+                    + c.kl_rep_scale * jnp.maximum(kl_rep, c.free_bits))
+            denom = jnp.maximum(m.sum(), 1.0)
+            loss = jnp.sum((l_rec + l_rew + l_cont + l_kl) * m) / denom
+            stats = {"wm_loss": loss,
+                     "recon_loss": jnp.sum(l_rec * m) / denom,
+                     "reward_loss": jnp.sum(l_rew * m) / denom,
+                     "kl_dyn": jnp.sum(kl_dyn * m) / denom}
+            return loss, (feat, stats)
+
+        def imagine(wm, actor, start_feat, key):
+            """Roll the actor through the PRIOR for `horizon` steps."""
+            D = c.deter_dim
+            h0 = start_feat[:, :D]
+            z0 = start_feat[:, D:]
+            keys = jax.random.split(key, c.horizon)
+
+            def step(carry, k):
+                h, z = carry
+                ka, kz = jax.random.split(k)
+                feat = jnp.concatenate([h, z], -1)
+                a = jax.random.categorical(ka, _mlp(actor, feat))
+                a_oh = jax.nn.one_hot(a, self.num_actions)
+                h2 = _gru(wm["gru"], h, jnp.concatenate([z, a_oh], -1))
+                z2, _ = self._sample_z(_mlp(wm["prior"], h2), kz)
+                return (h2, z2), (feat, a)
+
+            (_, _), (feats, acts) = jax.lax.scan(step, (h0, z0), keys)
+            return feats, acts
+
+        def behavior_losses(wm, actor, critic, start_feat, ret_scale,
+                            key):
+            feats, acts = imagine(wm, actor, start_feat, key)
+            feats = jax.lax.stop_gradient(feats)     # (H, B, F)
+            rew = symexp(_mlp(wm["rew"], feats)[..., 0])
+            cont = jax.nn.sigmoid(_mlp(wm["cont"], feats)[..., 0])
+            disc = c.gamma * cont
+            v = _mlp(critic, feats)[..., 0]          # (H, B)
+
+            def lam_step(carry, inp):
+                r_t, d_t, v_t = inp
+                ret = r_t + d_t * ((1 - c.gae_lambda) * v_t
+                                   + c.gae_lambda * carry)
+                return ret, ret
+
+            # bootstrap from the last value
+            _, rets = jax.lax.scan(
+                lam_step, v[-1],
+                (rew[:-1], disc[:-1], v[1:]), reverse=True)
+            rets = jax.lax.stop_gradient(rets)       # (H-1, B)
+            v_loss = jnp.mean(jnp.square(v[:-1] - rets))
+            # return-range normalization (EMA of P95-P5 spread)
+            spread = jnp.percentile(rets, 95) - jnp.percentile(rets, 5)
+            new_scale = 0.99 * ret_scale + 0.01 * spread
+            adv = (rets - v[:-1]) / jnp.maximum(1.0, new_scale)
+            logits = _mlp(actor, feats[:-1])
+            logp = jax.nn.log_softmax(logits)
+            lp_a = jnp.take_along_axis(
+                logp, acts[:-1][..., None], -1)[..., 0]
+            ent = -jnp.sum(jnp.exp(logp) * logp, -1)
+            a_loss = jnp.mean(-jax.lax.stop_gradient(adv) * lp_a
+                              - c.ent_coef * ent)
+            return a_loss, v_loss, new_scale, {
+                "actor_loss": a_loss, "critic_loss": v_loss,
+                "imag_return_mean": rets.mean(),
+                "actor_entropy": ent.mean(), "ret_scale": new_scale}
+
+        def update(wm, actor, critic, opts, ret_scale, batch, key):
+            wm_opt, actor_opt, critic_opt = opts
+            k1, k2, k3 = jax.random.split(key, 3)
+            (wl, (feat, wm_stats)), wm_grads = jax.value_and_grad(
+                wm_loss, has_aux=True)(wm, batch, k1)
+            upd, wm_opt = self._wm_tx.update(wm_grads, wm_opt)
+            wm = optax.apply_updates(wm, upd)
+
+            # imagination starts: subsample posterior states
+            T, N = batch["actions"].shape
+            flat = feat.reshape(T * N, -1)
+            idx = jax.random.choice(
+                k2, T * N, (min(c.imag_starts, T * N),), replace=False)
+            start = jax.lax.stop_gradient(flat[idx])
+
+            # one combined grad pass: a_loss's critic dependence and
+            # v_loss's actor dependence are both stop-gradient'd, so
+            # d(a_loss+v_loss)/d(actor,critic) equals the separate
+            # gradients — 1 behavior eval instead of 3
+            def ac_loss_fn(ac):
+                actor_p, critic_p = ac
+                a_loss, v_loss, new_scale, b_stats = behavior_losses(
+                    wm, actor_p, critic_p, start, ret_scale, k3)
+                return a_loss + v_loss, (new_scale, b_stats)
+
+            (_, (new_scale, b_stats)), (a_grads, v_grads) = \
+                jax.value_and_grad(ac_loss_fn, has_aux=True)(
+                    (actor, critic))
+            upd, actor_opt = self._actor_tx.update(a_grads, actor_opt)
+            actor = optax.apply_updates(actor, upd)
+            upd, critic_opt = self._critic_tx.update(v_grads, critic_opt)
+            critic = optax.apply_updates(critic, upd)
+            stats = {**wm_stats, **b_stats}
+            return (wm, actor, critic,
+                    (wm_opt, actor_opt, critic_opt), new_scale, stats)
+
+        return update
+
+    # ------------------------------------------------------------- api
+    def _sample(self) -> Dict[str, np.ndarray]:
+        c = self.config
+        T, N = c.rollout_length, c.num_envs
+        obs_buf = np.empty((T + 1, N, self.obs_dim), np.float32)
+        act_buf = np.empty((T, N), np.int32)
+        rew_buf = np.empty((T, N), np.float32)
+        term_buf = np.empty((T, N), np.float32)
+        done_buf = np.empty((T, N), np.float32)
+        mask_buf = np.empty((T, N), np.float32)
+        for t in range(T):
+            obs_f = self._obs.reshape(N, -1).astype(np.float32)
+            obs_buf[t] = obs_f
+            self._key, sub = jax.random.split(self._key)
+            h, z, a = self._act_fn(
+                self.wm, self.actor, jnp.asarray(self._h),
+                jnp.asarray(self._z), jnp.asarray(obs_f),
+                jnp.asarray(self._prev_a),
+                jnp.asarray(self._prev_done, jnp.float32), sub)
+            self._h, self._z = np.asarray(h), np.asarray(z)
+            a = np.asarray(a)
+            nobs, rew, term, trunc, _ = self._envs.step(a)
+            done = term | trunc
+            valid = ~self._prev_done
+            act_buf[t] = a
+            rew_buf[t] = rew
+            term_buf[t] = term.astype(np.float32)
+            done_buf[t] = done.astype(np.float32)
+            mask_buf[t] = valid.astype(np.float32)
+            self._ep_ret[valid] += rew[valid]
+            for i in np.nonzero(done & valid)[0]:
+                self._recent.append(float(self._ep_ret[i]))
+                self._ep_ret[i] = 0.0
+            self._recent = self._recent[-100:]
+            self._prev_a = a.astype(np.int64)
+            self._prev_done = done
+            self._obs = nobs
+            self._total_steps += N
+        obs_buf[T] = self._obs.reshape(N, -1).astype(np.float32)
+        return {"obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+                "terminateds": term_buf, "dones": done_buf,
+                "mask": mask_buf}
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        batch = self._sample()
+        dev = {k: jnp.asarray(v) for k, v in batch.items()}
+        stats: Dict[str, Any] = {}
+        for _ in range(self.config.num_updates_per_iteration):
+            self._key, sub = jax.random.split(self._key)
+            (self.wm, self.actor, self.critic,
+             (self.wm_opt, self.actor_opt, self.critic_opt),
+             self.ret_scale, stats_j) = self._update_fn(
+                self.wm, self.actor, self.critic,
+                (self.wm_opt, self.actor_opt, self.critic_opt),
+                self.ret_scale, dev, sub)
+            stats = {k: float(v) for k, v in stats_j.items()}
+        self.iteration += 1
+        stats.update({
+            "training_iteration": self.iteration,
+            "episode_return_mean": (float(np.mean(self._recent))
+                                    if self._recent else float("nan")),
+            "num_episodes": len(self._recent),
+            "num_env_steps_sampled_lifetime": self._total_steps,
+            "time_iteration_s": time.perf_counter() - t0,
+        })
+        return stats
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"wm": jax.device_get(self.wm),
+                "actor": jax.device_get(self.actor),
+                "critic": jax.device_get(self.critic),
+                "opts": jax.device_get((self.wm_opt, self.actor_opt,
+                                        self.critic_opt)),
+                "ret_scale": float(self.ret_scale),
+                "key": jax.device_get(self._key),
+                "total_steps": self._total_steps,
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.wm = jax.device_put(state["wm"])
+        self.actor = jax.device_put(state["actor"])
+        self.critic = jax.device_put(state["critic"])
+        if "opts" in state:
+            self.wm_opt, self.actor_opt, self.critic_opt = \
+                jax.device_put(state["opts"])
+        self.ret_scale = jnp.asarray(state.get("ret_scale", 1.0))
+        if "key" in state:
+            self._key = jnp.asarray(state["key"])
+        self._total_steps = state.get("total_steps", 0)
+        self.iteration = state.get("iteration", 0)
+
+    def stop(self) -> None:
+        self._envs.close()
+
+
+DreamerV3Config.algo_class = DreamerV3

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.core.rl_module import ActorCriticModule, Categorical
+from ray_tpu.rllib.core.learner import PPOLearner, LearnerGroup
+
+__all__ = ["ActorCriticModule", "Categorical", "PPOLearner", "LearnerGroup"]

@@ -1,0 +1,262 @@
+"""RLModule-equivalent: the neural net + action-distribution bundle.
+
+Parity: reference rllib/core/rl_module/rl_module.py (framework-agnostic
+module with forward_inference/forward_train) — re-done as pure JAX
+pytrees + functions (no torch Module): `init` builds the param tree,
+`forward` returns (logits, value), and the distribution helpers are
+static functions usable inside jit on both the learner (TPU mesh) and
+the env-runner (CPU) side.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = dict
+
+
+class Categorical:
+    """Minimal categorical distribution over logits, jit-friendly."""
+
+    @staticmethod
+    def sample(logits: jax.Array, key: jax.Array) -> jax.Array:
+        return jax.random.categorical(key, logits, axis=-1)
+
+    @staticmethod
+    def log_prob(logits: jax.Array, actions: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.take_along_axis(
+            logp, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    @staticmethod
+    def entropy(logits: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+_LOG_2PI = 1.8378770664093453
+
+
+class DiagGaussian:
+    """Diagonal gaussian over continuous actions (state-independent
+    log_std, the reference's default for Box spaces). All shapes
+    (..., A); log_prob/entropy reduce over the action dim."""
+
+    @staticmethod
+    def sample(mean: jax.Array, log_std: jax.Array,
+               key: jax.Array) -> jax.Array:
+        return mean + jnp.exp(log_std) * jax.random.normal(
+            key, mean.shape)
+
+    @staticmethod
+    def log_prob(mean: jax.Array, log_std: jax.Array,
+                 actions: jax.Array) -> jax.Array:
+        z = (actions - mean) * jnp.exp(-log_std)
+        return jnp.sum(-0.5 * jnp.square(z) - log_std - 0.5 * _LOG_2PI,
+                       axis=-1)
+
+    @staticmethod
+    def entropy(log_std: jax.Array,
+                like: jax.Array) -> jax.Array:
+        """Entropy broadcast to `like`'s leading shape (state-independent
+        std makes it constant per state)."""
+        ent = jnp.sum(log_std + 0.5 * (_LOG_2PI + 1.0), axis=-1)
+        return jnp.broadcast_to(ent, like.shape[:-1])
+
+
+@dataclasses.dataclass(frozen=True)
+class ActorCriticModule:
+    """MLP torso with separate policy/value heads.
+
+    Mirrors the reference's default RLModule for classic-control tasks
+    (rllib/core/rl_module/default_model_config.py): tanh MLP encoder,
+    scalar value head, and either a categorical head (Discrete spaces;
+    `num_actions` = n) or a diag-gaussian head with state-independent
+    log_std (Box spaces; `continuous=True`, `num_actions` = action dim).
+    """
+
+    obs_dim: int
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+    continuous: bool = False
+
+    def init(self, key: jax.Array) -> Params:
+        keys = jax.random.split(key, 2 * len(self.hidden) + 2)
+        ki = iter(keys)
+
+        def dense(key, din, dout, scale):
+            w = jax.random.orthogonal(key, max(din, dout))[:din, :dout]
+            return {"w": (w * scale).astype(jnp.float32),
+                    "b": jnp.zeros((dout,), jnp.float32)}
+
+        params: Params = {"pi": [], "vf": []}
+        for head, out_dim, out_scale in (("pi", self.num_actions, 0.01),
+                                         ("vf", 1, 1.0)):
+            din = self.obs_dim
+            layers = []
+            for h in self.hidden:
+                layers.append(dense(next(ki), din, h, jnp.sqrt(2.0)))
+                din = h
+            layers.append(dense(next(ki), din, out_dim, out_scale))
+            params[head] = layers
+        if self.continuous:
+            params["log_std"] = jnp.zeros((self.num_actions,),
+                                          jnp.float32)
+        return params
+
+    # ------------------------------------------- distribution dispatch
+    def dist_log_prob(self, params: Params, pi_out: jax.Array,
+                      actions: jax.Array) -> jax.Array:
+        if self.continuous:
+            return DiagGaussian.log_prob(pi_out, params["log_std"],
+                                         actions)
+        return Categorical.log_prob(pi_out, actions)
+
+    def dist_entropy(self, params: Params,
+                     pi_out: jax.Array) -> jax.Array:
+        if self.continuous:
+            return DiagGaussian.entropy(params["log_std"], pi_out)
+        return Categorical.entropy(pi_out)
+
+    @staticmethod
+    def _mlp(layers, x):
+        for layer in layers[:-1]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        last = layers[-1]
+        return x @ last["w"] + last["b"]
+
+    def forward(self, params: Params, obs: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+        """obs (..., obs_dim) -> (logits (..., A), value (...))."""
+        logits = self._mlp(params["pi"], obs)
+        value = self._mlp(params["vf"], obs)[..., 0]
+        return logits, value
+
+    def action_logp(self, params: Params, obs: jax.Array, key: jax.Array
+                    ) -> Tuple[jax.Array, jax.Array]:
+        logits, _ = self.forward(params, obs)
+        action = Categorical.sample(logits, key)
+        return action, Categorical.log_prob(logits, action)
+
+    # ----------------------------------------------- numpy (env runner)
+    @staticmethod
+    def forward_policy_np(params_np: Params, obs):
+        """Pure-numpy policy logits for env-runner-side inference.
+
+        Tiny classic-control MLPs are dominated by per-call dispatch
+        overhead under jit; the env runner therefore samples with plain
+        numpy (mathematically identical to `forward`'s policy head) and
+        keeps JAX for the learner, where the batch is big enough for XLA
+        to win."""
+        import numpy as np
+        x = obs
+        layers = params_np["pi"]
+        for layer in layers[:-1]:
+            x = np.tanh(x @ layer["w"] + layer["b"])
+        return x @ layers[-1]["w"] + layers[-1]["b"]
+
+    def sample_np(self, logits, rng, params_np: Params = None):
+        """Numpy action sample + log-prob (env-runner side).
+
+        Discrete: Gumbel-max categorical. Continuous (needs params_np
+        for log_std): diag-gaussian around the mean head."""
+        import numpy as np
+        if self.continuous:
+            log_std = np.asarray(params_np["log_std"])
+            std = np.exp(log_std)
+            action = logits + std * rng.standard_normal(logits.shape)
+            z = (action - logits) / std
+            logp = (-0.5 * np.square(z) - log_std
+                    - 0.5 * _LOG_2PI).sum(-1)
+            return action.astype(np.float32), logp.astype(np.float32)
+        z = logits - logits.max(axis=-1, keepdims=True)
+        logp_all = z - np.log(np.exp(z).sum(axis=-1, keepdims=True))
+        g = rng.gumbel(size=logits.shape)
+        action = np.argmax(logits + g, axis=-1)
+        logp = np.take_along_axis(
+            logp_all, action[..., None], axis=-1)[..., 0]
+        return action.astype(np.int32), logp.astype(np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvActorCriticModule:
+    """CNN torso for pixel observations (reference model catalog's
+    default conv_filters for image spaces, rllib/models/catalog.py) —
+    NHWC conv stack -> flatten -> dense -> policy/value heads. Integer
+    (uint8) inputs are normalized to [0, 1] inside forward, keyed on
+    dtype; float inputs are assumed pre-scaled (the EnvRunner scales
+    integer env observations in numpy before buffering)."""
+
+    obs_shape: Tuple[int, int, int]           # (H, W, C)
+    num_actions: int
+    # (out_channels, kernel, stride) per conv layer; default matches
+    # the classic 84x84 Atari stack
+    conv_filters: Sequence[Tuple[int, int, int]] = (
+        (16, 8, 4), (32, 4, 2), (32, 3, 1))
+    hidden: int = 256
+
+    def init(self, key: jax.Array) -> Params:
+        keys = jax.random.split(key, len(self.conv_filters) + 3)
+        ki = iter(keys)
+        params: Params = {"conv": []}
+        c_in = self.obs_shape[-1]
+        h, w = self.obs_shape[0], self.obs_shape[1]
+        for c_out, k, s in self.conv_filters:
+            fan_in = k * k * c_in
+            params["conv"].append({
+                "w": (jax.random.normal(next(ki), (k, k, c_in, c_out))
+                      * jnp.sqrt(2.0 / fan_in)).astype(jnp.float32),
+                "b": jnp.zeros((c_out,), jnp.float32)})
+            h = -(-(h - k + 1) // s)         # VALID conv output size
+            w = -(-(w - k + 1) // s)
+            c_in = c_out
+        flat = h * w * c_in
+        if flat <= 0:
+            raise ValueError(
+                f"conv_filters collapse {self.obs_shape} to nothing")
+
+        def dense(key, din, dout, scale):
+            wshape = (din, dout)
+            wkey = jax.random.normal(key, wshape) * scale / jnp.sqrt(din)
+            return {"w": wkey.astype(jnp.float32),
+                    "b": jnp.zeros((dout,), jnp.float32)}
+
+        params["torso"] = dense(next(ki), flat, self.hidden, 1.0)
+        params["pi"] = dense(next(ki), self.hidden, self.num_actions,
+                             0.01)
+        params["vf"] = dense(next(ki), self.hidden, 1, 1.0)
+        return params
+
+    def forward(self, params: Params, obs: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+        """obs (..., H, W, C) uint8/float -> (logits (..., A),
+        value (...))."""
+        lead = obs.shape[:-3]
+        x = obs.reshape((-1,) + tuple(self.obs_shape))
+        # normalization keyed on dtype, not batch content: integer
+        # (pixel) inputs always get /255, floats are assumed pre-scaled
+        is_int = jnp.issubdtype(obs.dtype, jnp.integer)
+        x = x.astype(jnp.float32)
+        if is_int:
+            x = x / 255.0
+        for layer, (c_out, k, s) in zip(params["conv"],
+                                        self.conv_filters):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + layer["b"])
+        x = x.reshape(x.shape[0], -1)
+        x = jnp.tanh(x @ params["torso"]["w"] + params["torso"]["b"])
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return (logits.reshape(lead + (self.num_actions,)),
+                value.reshape(lead))
+
+    def dist_log_prob(self, params, pi_out, actions):
+        return Categorical.log_prob(pi_out, actions)
+
+    def dist_entropy(self, params, pi_out):
+        return Categorical.entropy(pi_out)

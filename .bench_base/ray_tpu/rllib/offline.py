@@ -1,0 +1,456 @@
+"""Offline RL: experience recording + behavior cloning on ray_tpu.data.
+
+Parity: reference rllib/offline (offline_data.py readers/writers feeding
+the learner; the BC/MARWIL family trains from recorded episodes). The
+TPU-shaped version: experiences are ray_tpu.data Datasets (jsonl/parquet
+— the same substrate as SFT data), and BC is a single-jit supervised
+update maximizing log pi(a|s) over dataset batches.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.core.rl_module import ActorCriticModule
+
+
+def record_transitions(env_name: str, policy_fn: Callable, path: str,
+                       num_steps: int = 5000, num_envs: int = 8,
+                       seed: int = 0) -> str:
+    """Roll a policy (obs_batch -> action_batch) and write transitions
+    as jsonl rows {obs, action, reward, terminated} (reference offline
+    output writer shape). Returns the written path."""
+    import gymnasium as gym
+
+    from ray_tpu import data as rd
+    envs = gym.make_vec(env_name, num_envs=num_envs,
+                        vectorization_mode="sync")
+    obs, _ = envs.reset(seed=seed)
+    prev_done = np.zeros(num_envs, bool)
+    eps_counter = np.arange(num_envs)        # episode ids per env lane
+    next_eps = num_envs
+    rows = []
+    while len(rows) < num_steps:
+        action = np.asarray(policy_fn(obs.astype(np.float32)))
+        nobs, reward, term, trunc, _ = envs.step(action)
+        done = term | trunc
+        valid = ~prev_done
+        for i in np.nonzero(valid)[0]:
+            rows.append({"obs": obs[i].astype(np.float32),
+                         "action": action[i],
+                         "reward": float(reward[i]),
+                         "new_obs": nobs[i].astype(np.float32),
+                         "terminated": bool(term[i]),
+                         "eps_id": int(eps_counter[i])})
+        for i in np.nonzero(done)[0]:
+            eps_counter[i] = next_eps
+            next_eps += 1
+        prev_done = done
+        obs = nobs
+    envs.close()
+    ds = rd.from_items(rows, override_num_blocks=8)
+    ds.write_jsonl(path)
+    return path
+
+
+
+
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+
+
+class _OfflineConfigMixin(AlgorithmConfig):
+    """Offline configs share the unified AlgorithmConfig surface, plus
+    the offline-data source group (reference config.offline_data())."""
+
+    # legacy alias: subclasses may still set _ALGO
+    _ALGO: type = None
+
+    def offline_data(self, input_path: str):
+        self.input_path = input_path
+        return self
+
+    def build(self):
+        return (self.algo_class or self._ALGO)(self)
+
+@dataclasses.dataclass
+class BCConfig(_OfflineConfigMixin):
+    env: str = "CartPole-v1"
+    input_path: str = ""                 # jsonl dir/file of transitions
+    hidden: Sequence[int] = (64, 64)
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    num_batches_per_iteration: int = 50
+    seed: int = 0
+
+
+class BC:
+    """Behavior cloning: maximize log pi(a|s) over the offline dataset."""
+
+    def __init__(self, config: BCConfig):
+        if not config.input_path:
+            raise ValueError("BC needs offline_data(input_path=...)")
+        import gymnasium as gym
+
+        from ray_tpu import data as rd
+        self.config = config
+        env = gym.make(config.env)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        space = env.action_space
+        self._continuous = not hasattr(space, "n")
+        num_actions = (int(np.prod(space.shape)) if self._continuous
+                       else int(space.n))
+        env.close()
+        self.module = ActorCriticModule(obs_dim, num_actions,
+                                        tuple(config.hidden),
+                                        continuous=self._continuous)
+        self.params = self.module.init(jax.random.PRNGKey(config.seed))
+        self._tx = optax.adam(config.lr)
+        self.opt_state = self._tx.init(self.params)
+        self._dataset = rd.read_json(config.input_path)
+        self._update_fn = jax.jit(self._build_update())
+        self.iteration = 0
+
+    def _build_update(self):
+        module = self.module
+
+        def loss_fn(params, obs, actions):
+            logits, _ = module.forward(params, obs)
+            logp = module.dist_log_prob(params, logits, actions)
+            return -jnp.mean(logp)
+
+        def update(params, opt_state, obs, actions):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs,
+                                                      actions)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return update
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.perf_counter()
+        losses = []
+        batches = self._dataset.iter_batches(
+            batch_size=c.train_batch_size, drop_last=True,
+            local_shuffle_buffer_size=4 * c.train_batch_size,
+            seed=c.seed + self.iteration)
+        for _, batch in zip(range(c.num_batches_per_iteration), batches):
+            obs = np.stack([np.asarray(o, np.float32)
+                            for o in batch["obs"]])
+            if self._continuous:
+                actions = np.stack([np.asarray(a, np.float32)
+                                    for a in batch["action"]])
+            else:
+                actions = np.asarray(batch["action"], np.int64)
+            self.params, self.opt_state, loss = self._update_fn(
+                self.params, self.opt_state, jnp.asarray(obs),
+                jnp.asarray(actions))
+            losses.append(float(loss))
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "bc_loss": float(np.mean(losses)) if losses else
+                float("nan"),
+                "num_batches": len(losses),
+                "time_iteration_s": time.perf_counter() - t0}
+
+    def evaluate(self, num_episodes: int = 10,
+                 seed: int = 123) -> Dict[str, float]:
+        """Greedy rollout return of the cloned policy."""
+        import gymnasium as gym
+        env = gym.make(self.config.env)
+        params_np = jax.tree_util.tree_map(np.asarray, self.params)
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            total, done = 0.0, False
+            while not done:
+                pi_out = self.module.forward_policy_np(
+                    params_np, obs.astype(np.float32)[None])
+                action = (pi_out[0] if self._continuous
+                          else int(np.argmax(pi_out[0])))
+                obs, r, term, trunc, _ = env.step(action)
+                total += float(r)
+                done = term or trunc
+            returns.append(total)
+        env.close()
+        return {"episode_return_mean": float(np.mean(returns)),
+                "num_episodes": num_episodes}
+
+
+BCConfig._ALGO = BC
+
+
+def _load_transitions(input_path: str):
+    """Load an offline jsonl dataset into flat arrays (rows keep
+    insertion order, so per-eps_id sequences are time-ordered)."""
+    from ray_tpu import data as rd
+    rows = rd.read_json(input_path).take_all()
+    obs = np.stack([np.asarray(r["obs"], np.float32) for r in rows])
+    actions = np.asarray([r["action"] for r in rows])
+    rewards = np.asarray([r["reward"] for r in rows], np.float32)
+    terms = np.asarray([r["terminated"] for r in rows], np.float32)
+    new_obs = (np.stack([np.asarray(r["new_obs"], np.float32)
+                         for r in rows])
+               if "new_obs" in rows[0] else None)
+    eps_ids = (np.asarray([r["eps_id"] for r in rows])
+               if "eps_id" in rows[0] else None)
+    return obs, actions, rewards, new_obs, terms, eps_ids
+
+
+def _returns_to_go(rewards, eps_ids, gamma: float) -> np.ndarray:
+    """Discounted return-to-go per episode (reference
+    postprocessing compute advantages for MARWIL)."""
+    if eps_ids is None:
+        raise ValueError(
+            "dataset lacks eps_id column (re-record with this version's "
+            "record_transitions) — MARWIL needs episode boundaries")
+    rtg = np.zeros_like(rewards)
+    for eid in np.unique(eps_ids):
+        idx = np.nonzero(eps_ids == eid)[0]       # time-ordered
+        acc = 0.0
+        for j in idx[::-1]:
+            acc = rewards[j] + gamma * acc
+            rtg[j] = acc
+    return rtg
+
+
+@dataclasses.dataclass
+class MARWILConfig(_OfflineConfigMixin):
+    """Reference rllib/algorithms/marwil/marwil.py: exponentially
+    advantage-weighted imitation (beta=0 reduces to BC)."""
+    env: str = "CartPole-v1"
+    input_path: str = ""
+    hidden: Sequence[int] = (64, 64)
+    lr: float = 1e-3
+    beta: float = 1.0
+    vf_coef: float = 1.0
+    gamma: float = 0.99
+    train_batch_size: int = 256
+    num_batches_per_iteration: int = 50
+    seed: int = 0
+
+
+class MARWIL:
+    """Advantage-weighted behavior cloning: maximize
+    exp(beta * Â(s, a)) * log pi(a|s) with a monte-carlo value baseline
+    (reference marwil_torch_learner loss)."""
+
+    def __init__(self, config: MARWILConfig):
+        if not config.input_path:
+            raise ValueError("MARWIL needs offline_data(input_path=...)")
+        import gymnasium as gym
+        self.config = config
+        env = gym.make(config.env)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        space = env.action_space
+        self._continuous = not hasattr(space, "n")
+        num_actions = (int(np.prod(space.shape)) if self._continuous
+                       else int(space.n))
+        env.close()
+        self.module = ActorCriticModule(obs_dim, num_actions,
+                                        tuple(config.hidden),
+                                        continuous=self._continuous)
+        self.params = self.module.init(jax.random.PRNGKey(config.seed))
+        self._tx = optax.adam(config.lr)
+        self.opt_state = self._tx.init(self.params)
+        obs, actions, rewards, _nobs, _terms, eps_ids = \
+            _load_transitions(config.input_path)
+        self._obs = obs
+        self._actions = (actions.astype(np.float32) if self._continuous
+                         else actions.astype(np.int32))
+        self._rtg = _returns_to_go(rewards, eps_ids, config.gamma)
+        self._rng = np.random.default_rng(config.seed)
+        self._update_fn = jax.jit(self._build_update())
+        self.iteration = 0
+
+    def _build_update(self):
+        c = self.config
+        module = self.module
+
+        def loss_fn(params, obs, actions, rtg):
+            logits, value = module.forward(params, obs)
+            logp = module.dist_log_prob(params, logits, actions)
+            adv = rtg - value
+            # batch-normalized advantage inside the exp weight
+            adv_n = adv / (jnp.std(jax.lax.stop_gradient(adv)) + 1e-6)
+            w = jnp.minimum(
+                jnp.exp(c.beta * jax.lax.stop_gradient(adv_n)), 20.0)
+            pi_loss = -jnp.mean(w * logp)
+            vf_loss = jnp.mean(jnp.square(adv))
+            return pi_loss + c.vf_coef * vf_loss, (pi_loss, vf_loss)
+
+        def update(params, opt_state, obs, actions, rtg):
+            (loss, (pi_l, vf_l)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, obs, actions, rtg)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            return (optax.apply_updates(params, updates), opt_state,
+                    loss, pi_l, vf_l)
+
+        return update
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.perf_counter()
+        n = len(self._obs)
+        losses, pi_ls, vf_ls = [], [], []
+        for _ in range(c.num_batches_per_iteration):
+            idx = self._rng.integers(0, n, c.train_batch_size)
+            self.params, self.opt_state, loss, pi_l, vf_l = \
+                self._update_fn(self.params, self.opt_state,
+                                jnp.asarray(self._obs[idx]),
+                                jnp.asarray(self._actions[idx]),
+                                jnp.asarray(self._rtg[idx]))
+            losses.append(float(loss))
+            pi_ls.append(float(pi_l))
+            vf_ls.append(float(vf_l))
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "marwil_loss": float(np.mean(losses)),
+                "policy_loss": float(np.mean(pi_ls)),
+                "vf_loss": float(np.mean(vf_ls)),
+                "time_iteration_s": time.perf_counter() - t0}
+
+    evaluate = BC.evaluate
+
+
+@dataclasses.dataclass
+class CQLConfig(_OfflineConfigMixin):
+    """Discrete conservative Q-learning (reference
+    rllib/algorithms/cql: CQL(H) regularizer over a Q-learning core)."""
+    env: str = "CartPole-v1"
+    input_path: str = ""
+    hidden: Sequence[int] = (64, 64)
+    lr: float = 5e-4
+    gamma: float = 0.99
+    cql_alpha: float = 1.0
+    target_network_update_freq: int = 100
+    train_batch_size: int = 256
+    num_batches_per_iteration: int = 50
+    seed: int = 0
+
+
+class CQL:
+    """Offline Q-learning with the conservative penalty
+    E[logsumexp Q(s,·) - Q(s, a_data)] that pushes down out-of-
+    distribution action values (CQL(H), Kumar et al. 2020)."""
+
+    def __init__(self, config: CQLConfig):
+        if not config.input_path:
+            raise ValueError("CQL needs offline_data(input_path=...)")
+        import gymnasium as gym
+
+        from ray_tpu.rllib.algorithms.dqn import QModule
+        self.config = config
+        env = gym.make(config.env)
+        if not hasattr(env.action_space, "n"):
+            raise ValueError("discrete CQL needs a Discrete action "
+                             "space (continuous CQL rides SAC)")
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        env.close()
+        self.module = QModule(obs_dim, num_actions,
+                              tuple(config.hidden))
+        self.params = self.module.init(jax.random.PRNGKey(config.seed))
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        self._tx = optax.adam(config.lr)
+        self.opt_state = self._tx.init(self.params)
+        obs, actions, rewards, new_obs, terms, _eps = \
+            _load_transitions(config.input_path)
+        if new_obs is None:
+            raise ValueError(
+                "dataset lacks new_obs (re-record with this version's "
+                "record_transitions) — CQL needs next observations")
+        self._data = (obs, actions.astype(np.int32), rewards, new_obs,
+                      terms)
+        self._rng = np.random.default_rng(config.seed)
+        self._update_fn = jax.jit(self._build_update())
+        self._num_updates = 0
+        self.iteration = 0
+
+    def _build_update(self):
+        c = self.config
+        module = self.module
+
+        def loss_fn(params, target_params, obs, actions, rewards,
+                    new_obs, terms):
+            q = module.forward(params, obs)
+            q_sa = jnp.take_along_axis(q, actions[:, None],
+                                       axis=-1)[:, 0]
+            q_next_t = module.forward(target_params, new_obs)
+            a_star = jnp.argmax(module.forward(params, new_obs), -1)
+            q_next = jnp.take_along_axis(q_next_t, a_star[:, None],
+                                         axis=-1)[:, 0]
+            target = rewards + c.gamma * (1 - terms) * \
+                jax.lax.stop_gradient(q_next)
+            td = jnp.mean(jnp.square(q_sa - target))
+            # conservative term: push down OOD actions, up dataset ones
+            cql = jnp.mean(jax.scipy.special.logsumexp(q, axis=-1)
+                           - q_sa)
+            return td + c.cql_alpha * cql, (td, cql)
+
+        def update(params, target_params, opt_state, *batch):
+            (loss, (td, cql)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, *batch)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            return (optax.apply_updates(params, updates), opt_state,
+                    loss, td, cql)
+
+        return update
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.perf_counter()
+        obs, actions, rewards, new_obs, terms = self._data
+        n = len(obs)
+        tds, cqls = [], []
+        for _ in range(c.num_batches_per_iteration):
+            idx = self._rng.integers(0, n, c.train_batch_size)
+            (self.params, self.opt_state, _loss, td, cql) = \
+                self._update_fn(
+                    self.params, self.target_params, self.opt_state,
+                    jnp.asarray(obs[idx]), jnp.asarray(actions[idx]),
+                    jnp.asarray(rewards[idx]),
+                    jnp.asarray(new_obs[idx]), jnp.asarray(terms[idx]))
+            tds.append(float(td))
+            cqls.append(float(cql))
+            self._num_updates += 1
+            if self._num_updates % c.target_network_update_freq == 0:
+                self.target_params = jax.tree_util.tree_map(
+                    jnp.copy, self.params)
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "td_loss": float(np.mean(tds)),
+                "cql_loss": float(np.mean(cqls)),
+                "num_updates_lifetime": self._num_updates,
+                "time_iteration_s": time.perf_counter() - t0}
+
+    def evaluate(self, num_episodes: int = 10,
+                 seed: int = 123) -> Dict[str, float]:
+        """Greedy Q rollout."""
+        import gymnasium as gym
+        env = gym.make(self.config.env)
+        params_np = jax.tree_util.tree_map(np.asarray, self.params)
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            total, done = 0.0, False
+            while not done:
+                q = self.module.forward_np(params_np,
+                                           obs.astype(np.float32)[None])
+                obs, r, term, trunc, _ = env.step(int(np.argmax(q[0])))
+                total += float(r)
+                done = term or trunc
+            returns.append(total)
+        env.close()
+        return {"episode_return_mean": float(np.mean(returns)),
+                "num_episodes": num_episodes}
+
+
+MARWILConfig._ALGO = MARWIL
+CQLConfig._ALGO = CQL

@@ -1,0 +1,8 @@
+from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
+                                                ReplayBuffer)
+from ray_tpu.rllib.utils.schedules import (ConstantSchedule,
+                                           LinearSchedule,
+                                           PiecewiseSchedule)
+
+__all__ = ["ReplayBuffer", "PrioritizedReplayBuffer", "ConstantSchedule",
+           "LinearSchedule", "PiecewiseSchedule"]

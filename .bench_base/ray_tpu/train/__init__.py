@@ -1,0 +1,25 @@
+"""ray_tpu.train: distributed training orchestration (Ray Train parity).
+
+The minimum end-to-end slice of SURVEY.md §7 step 5: JaxTrainer fans a
+user `train_loop_per_worker` out to a WorkerGroup of actors, wires them
+into one jax.distributed SPMD program (JaxBackend), streams report()
+results back, manages checkpoints with retention, and restarts the whole
+group from the latest checkpoint on failure.
+
+Reference mapping:
+- JaxTrainer       <- train/data_parallel_trainer.py + backend_executor.py
+- Backend/JaxConfig<- train/backend.py + train/torch/xla/config.py
+- report/get_context <- train/_internal/session.py
+- Checkpoint/CheckpointManager <- train/_checkpoint.py, checkpoint_manager.py
+- ScalingConfig etc <- air/config.py
+"""
+from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxConfig  # noqa: F401
+from ray_tpu.train.checkpoint import (Checkpoint, CheckpointManager,  # noqa: F401
+                                      load_pytree, save_pytree)
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig,  # noqa: F401
+                                  Result, RunConfig, ScalingConfig)
+from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
+                                   get_dataset_shard,
+                                   make_temp_checkpoint_dir, report)
+from ray_tpu.train.trainer import JaxTrainer  # noqa: F401
+from ray_tpu.train.worker_group import RayTrainWorker, WorkerGroup  # noqa: F401

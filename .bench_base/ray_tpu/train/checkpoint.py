@@ -1,0 +1,308 @@
+"""Checkpoints: directory handles + retention + pytree (de)serialisation.
+
+Parity: reference train/_checkpoint.py (directory-handle Checkpoint),
+train/_internal/checkpoint_manager.py:80-108 (num_to_keep retention).
+
+Two storage engines:
+- "npz" (default): pickled treedef + flat npz of leaves. Round-trips
+  ARBITRARY pytrees (optax NamedTuple states included) with no restore
+  target needed.
+- "orbax": orbax.checkpoint PyTreeCheckpointer (async save available).
+  Orbax cannot rebuild custom treedefs without a `target`, so pass one
+  to `load_pytree` when restoring non-dict trees saved this way.
+Select via `engine=` or the RAY_TPU_CKPT_ENGINE env var.
+
+Checkpoint DIRECTORIES move between hosts as tar bytes (`pack_dir` /
+`unpack_dir`) through the object store — the trainer never assumes a
+shared filesystem (reference ships files via storage_path upload,
+train/_internal/storage.py:104; our transport is the object plane).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import pickle
+import shutil
+import tarfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory (contents are framework-free)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    # ------------------------------------------------------ pytree io
+    @classmethod
+    def from_state(cls, path: str, state: Any,
+                   metadata: Optional[dict] = None) -> "Checkpoint":
+        """Persist a JAX/numpy pytree to `path` and return the handle."""
+        os.makedirs(path, exist_ok=True)
+        save_pytree(state, os.path.join(path, "state"))
+        if metadata is not None:
+            with open(os.path.join(path, "metadata.json"), "w") as f:
+                json.dump(metadata, f)
+        return cls(path)
+
+    def load_state(self) -> Any:
+        return load_pytree(os.path.join(self.path, "state"))
+
+    def metadata(self) -> dict:
+        p = os.path.join(self.path, "metadata.json")
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def _encode_leaf(leaf) -> Tuple[np.ndarray, Optional[str]]:
+    """npz only round-trips builtin numpy dtypes; ml_dtypes leaves
+    (bfloat16, fp8, ...) are stored as raw bytes + a dtype tag. 0-d
+    arrays can't be viewed as uint8 directly — they ride as (1,) with a
+    `!0d` tag suffix."""
+    a = np.asarray(leaf)
+    if a.dtype.isbuiltin == 1:   # ml_dtypes register as 2 ("user w/ slots")
+        return a, None
+    tag = str(a.dtype)
+    if a.ndim == 0:
+        a = a.reshape(1)
+        tag += "!0d"
+    return a.view(np.uint8).reshape(a.shape + (a.dtype.itemsize,)), tag
+
+
+def _decode_leaf(a: np.ndarray, dtype_tag: Optional[str]) -> np.ndarray:
+    if dtype_tag is None:
+        return a
+    import ml_dtypes  # ships with jax
+    scalar = dtype_tag.endswith("!0d")
+    if scalar:
+        dtype_tag = dtype_tag[:-3]
+    dt = np.dtype(getattr(ml_dtypes, dtype_tag))
+    out = a.reshape(a.shape[:-1] + (-1,)).view(dt).reshape(a.shape[:-1])
+    return out.reshape(()) if scalar else out
+
+
+def _engine(engine: Optional[str]) -> str:
+    return engine or os.environ.get("RAY_TPU_CKPT_ENGINE", "npz")
+
+
+# path -> in-flight orbax AsyncCheckpointer (see save_pytree)
+_ASYNC_CKPTRS: Dict[str, Any] = {}
+
+
+def save_pytree(tree: Any, path: str, engine: Optional[str] = None,
+                async_save: bool = False):
+    """Persist a pytree under `path` with the chosen engine.
+
+    engine="npz" (default): treedef pickle + npz leaves, any treedef.
+    engine="orbax": orbax PyTreeCheckpointer; with async_save=True
+    returns an orbax future-like handle (call .wait() or let the next
+    save barrier), else None.
+    """
+    eng = _engine(engine)
+    if eng not in ("npz", "orbax"):
+        raise ValueError(f"unknown checkpoint engine {eng!r}")
+    os.makedirs(path, exist_ok=True)
+    if eng == "orbax":
+        import orbax.checkpoint as ocp
+        target = os.path.join(path, "orbax")
+        # One AsyncCheckpointer per path, reused: re-saving a path first
+        # barriers on the in-flight save, so rmtree can never tear a
+        # write that is still running.
+        prev = _ASYNC_CKPTRS.pop(path, None)
+        if prev is not None:
+            prev.wait_until_finished()
+        if os.path.exists(target):
+            shutil.rmtree(target)
+        marker = os.path.join(path, "engine")
+        if async_save:
+            ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+            ckptr.save(target, args=ocp.args.PyTreeSave(tree))
+            _ASYNC_CKPTRS[path] = ckptr
+            # bound the registry: each entry holds threads + tree refs;
+            # fresh-dir-per-step loops would otherwise grow it forever
+            while len(_ASYNC_CKPTRS) > 4:
+                old_path = next(iter(_ASYNC_CKPTRS))
+                _ASYNC_CKPTRS.pop(old_path).wait_until_finished()
+            with open(marker, "w") as f:
+                f.write(eng)
+            return ckptr           # .wait_until_finished() before reading
+        ocp.PyTreeCheckpointer().save(target, tree)
+        with open(marker, "w") as f:
+            f.write(eng)
+        return None
+    with open(os.path.join(path, "engine"), "w") as f:
+        f.write(eng)
+    import jax
+    leaves, treedef = jax.tree.flatten(
+        jax.tree.map(lambda x: np.asarray(x), tree))
+    encoded, tags = [], []
+    for leaf in leaves:
+        e, t = _encode_leaf(leaf)
+        encoded.append(e)
+        tags.append(t)
+    np.savez(os.path.join(path, "leaves.npz"),
+             **{f"leaf_{i}": leaf for i, leaf in enumerate(encoded)})
+    with open(os.path.join(path, "treedef.pkl"), "wb") as f:
+        pickle.dump((treedef, tags), f)
+    return None
+
+
+def load_pytree(path: str, target: Any = None) -> Any:
+    """Load a pytree saved by `save_pytree`. `target` (an example tree)
+    is only needed to rebuild custom treedefs from orbax-engine saves."""
+    import jax
+    inflight = _ASYNC_CKPTRS.pop(path, None)
+    if inflight is not None:     # racing our own async save: barrier
+        inflight.wait_until_finished()
+    marker = os.path.join(path, "engine")
+    eng = "npz"
+    if os.path.exists(marker):
+        with open(marker) as f:
+            eng = f.read().strip()
+    if eng == "orbax":
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(os.path.join(path, "orbax"))
+        if target is None:
+            return restored
+        return jax.tree.unflatten(
+            jax.tree.structure(target), jax.tree.leaves(restored))
+    with open(os.path.join(path, "treedef.pkl"), "rb") as f:
+        meta = pickle.load(f)
+    treedef, tags = meta if isinstance(meta, tuple) else (meta, None)
+    data = np.load(os.path.join(path, "leaves.npz"))
+    leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    if tags is not None:
+        leaves = [_decode_leaf(a, t) for a, t in zip(leaves, tags)]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+# -------------------------------------------------- dir <-> bytes
+def pack_dir(path: str) -> bytes:
+    """Tar a checkpoint directory into bytes (the cross-host transport:
+    worker -> object store -> driver storage; no shared fs assumed)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        tar.add(path, arcname=".")
+    return buf.getvalue()
+
+
+def unpack_dir(data: bytes, dest: str) -> str:
+    os.makedirs(dest, exist_ok=True)
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r") as tar:
+        try:
+            tar.extractall(dest, filter="data")
+        except TypeError:
+            # filter= needs >=3.10.12/3.11.4; validate members manually
+            # on older patch releases before falling back.
+            root = os.path.realpath(dest)
+            members = tar.getmembers()
+            for m in members:
+                target = os.path.realpath(os.path.join(dest, m.name))
+                if not (target == root
+                        or target.startswith(root + os.sep)):
+                    raise RuntimeError(
+                        f"unsafe path in checkpoint tar: {m.name!r}")
+                if not (m.isreg() or m.isdir()):
+                    # filter="data" parity: no links, FIFOs, devices
+                    raise RuntimeError(
+                        f"non-regular member in checkpoint tar: "
+                        f"{m.name!r}")
+                m.mode &= 0o777   # strip setuid/setgid/sticky
+            tar.extractall(dest, members=members)
+    return dest
+
+
+class CheckpointManager:
+    """Registers reported checkpoints, keeps the best/latest num_to_keep."""
+
+    def __init__(self, root: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max"):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._registered: List[Tuple[float, int, str, Dict]] = []
+        self._counter = 0
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Optional[Dict] = None) -> Checkpoint:
+        """Move the checkpoint under management and apply retention.
+        Only valid when `checkpoint.path` is on THIS host's filesystem;
+        remote workers ship bytes via `register_bytes`."""
+        metrics = metrics or {}
+        self._counter += 1
+        dest = os.path.join(self.root, f"checkpoint_{self._counter:06d}")
+        if os.path.abspath(checkpoint.path) != dest:
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.move(checkpoint.path, dest)
+        return self._register_dest(dest, metrics)
+
+    def register_bytes(self, data: bytes,
+                       metrics: Optional[Dict] = None) -> Checkpoint:
+        """Unpack a worker-shipped checkpoint tarball under management
+        (the no-shared-filesystem path)."""
+        metrics = metrics or {}
+        self._counter += 1
+        dest = os.path.join(self.root, f"checkpoint_{self._counter:06d}")
+        if os.path.exists(dest):
+            shutil.rmtree(dest)
+        unpack_dir(data, dest)
+        return self._register_dest(dest, metrics)
+
+    def _register_dest(self, dest: str, metrics: Dict) -> Checkpoint:
+        score = self._score(metrics)
+        self._registered.append((score, self._counter, dest, metrics))
+        self._apply_retention()
+        return Checkpoint(dest)
+
+    def _score(self, metrics: Dict) -> float:
+        if self.score_attribute and self.score_attribute in metrics:
+            v = float(metrics[self.score_attribute])
+            return v if self.score_order == "max" else -v
+        return float(self._counter)  # fall back to recency
+
+    def _apply_retention(self) -> None:
+        if self.num_to_keep is None:
+            return
+        while len(self._registered) > self.num_to_keep:
+            self._registered.sort(key=lambda t: (t[0], t[1]))
+            score, cnt, path, _ = self._registered.pop(0)
+            if os.path.exists(path):
+                shutil.rmtree(path, ignore_errors=True)
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        if not self._registered:
+            return None
+        return Checkpoint(max(self._registered, key=lambda t: t[1])[2])
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        if not self._registered:
+            return None
+        return Checkpoint(max(self._registered,
+                              key=lambda t: (t[0], t[1]))[2])
+
+    def checkpoints(self) -> List[Checkpoint]:
+        return [Checkpoint(p) for _, _, p, _ in
+                sorted(self._registered, key=lambda t: t[1])]

@@ -1,0 +1,115 @@
+"""Train configuration dataclasses.
+
+Parity: reference python/ray/air/config.py (ScalingConfig:102, RunConfig,
+CheckpointConfig, FailureConfig), re-pointed at TPU concepts: instead of
+GPUs-per-worker the scaling config speaks hosts x chips and optionally a
+mesh layout (ray_tpu.parallel.MeshSpec) that the trainer materialises on
+the worker group.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many training workers and what each holds.
+
+    num_workers: one worker process per TPU host (each worker is one
+    jax.distributed process owning that host's chips). use_tpu=False
+    runs CPU-only workers (CI, debugging).
+    """
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 0           # 0 = all visible chips
+    resources_per_worker: Optional[Dict[str, float]] = None
+    mesh: Optional[MeshSpec] = None     # global mesh over all workers
+    placement_strategy: str = "PACK"
+    # TPU pod-slice mode: topology (e.g. "v4-32") makes the trainer
+    # reserve the whole slice as a STRICT_SPREAD placement group (one
+    # worker per slice host, head bundle on rank 0 — the reference's
+    # pod-slice scheduling, _private/accelerators/tpu.py:334-397).
+    topology: Optional[str] = None
+    pod_name: Optional[str] = None
+
+    def __post_init__(self):
+        if self.topology is not None:
+            from ray_tpu._private.accelerators.tpu import num_hosts
+            hosts = num_hosts(self.topology)
+            if self.num_workers not in (1, hosts):
+                raise ValueError(
+                    f"num_workers={self.num_workers} contradicts "
+                    f"topology {self.topology} ({hosts} hosts)")
+            self.num_workers = hosts
+            self.use_tpu = True
+            self.placement_strategy = "STRICT_SPREAD"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            if self.topology is not None:
+                from ray_tpu._private.accelerators.tpu import chips_per_host
+                res.setdefault("TPU", float(chips_per_host(self.topology)))
+            else:
+                res.setdefault("TPU", float(self.chips_per_worker or 1))
+        return res
+
+    def worker_bundles(self) -> Optional[list]:
+        """Explicit per-rank bundles for pod-slice mode (else None)."""
+        if self.topology is None:
+            return None
+        from ray_tpu.util.accelerators.tpu import slice_bundles
+        base = self.worker_resources()
+        bundles = slice_bundles(self.topology, self.pod_name,
+                                cpus_per_host=base.get("CPU", 1.0))
+        return bundles
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None        # None = keep all
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+    def __post_init__(self):
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be max|min")
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Whole-group restart-from-checkpoint semantics (reference
+    backend_executor.py:759-786): max_failures < 0 means unlimited."""
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None       # defaults to ~/ray_tpu_results
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    # Max seconds between report() rounds before the group is declared
+    # hung. None = wait forever (first steps of big models can spend
+    # many minutes in XLA compilation).
+    worker_poll_timeout: Optional[float] = None
+
+
+@dataclasses.dataclass
+class Result:
+    """What JaxTrainer.fit returns (reference train/base_trainer Result)."""
+    metrics: Dict[str, Any]
+    checkpoint: Optional["Checkpoint"]  # noqa: F821 (train.checkpoint)
+    path: str
+    metrics_history: list = dataclasses.field(default_factory=list)
+    error: Optional[BaseException] = None
+    # trial config when produced by a Tune sweep (reference Result.config)
+    config: Optional[Dict[str, Any]] = None

@@ -1,0 +1,22 @@
+"""ray_tpu.tune: hyperparameter sweeps over trial actors.
+
+Parity: reference python/ray/tune (Tuner, TuneConfig, grid_search,
+ASHA). Inside a trainable use `ray_tpu.tune.report` (alias of
+ray_tpu.train.report) and `ray_tpu.tune.get_checkpoint`.
+"""
+from ray_tpu.train.session import get_checkpoint, report  # noqa: F401
+from ray_tpu.tune.schedulers import (ASHAScheduler,  # noqa: F401
+                                     FIFOScheduler,
+                                     PopulationBasedTraining)
+from ray_tpu.tune.search import (BasicVariantGenerator, choice,  # noqa: F401
+                                 grid_search, loguniform, randint,
+                                 Searcher, TPESearcher, uniform)
+from ray_tpu.tune.tuner import (ResultGrid, Trial, TuneConfig,  # noqa: F401
+                                Tuner)
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "Trial", "ASHAScheduler",
+    "FIFOScheduler", "PopulationBasedTraining", "grid_search", "choice",
+    "uniform", "loguniform", "randint", "BasicVariantGenerator",
+    "Searcher", "TPESearcher", "report", "get_checkpoint",
+]

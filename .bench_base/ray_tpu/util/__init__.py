@@ -1,0 +1,6 @@
+from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
+                                          placement_group_table,
+                                          remove_placement_group)
+
+__all__ = ["PlacementGroup", "placement_group", "remove_placement_group",
+           "placement_group_table"]

@@ -1,0 +1,4 @@
+from ray_tpu.util.accelerators.tpu import (slice_bundles,
+                                           slice_placement_group)
+
+__all__ = ["slice_bundles", "slice_placement_group"]

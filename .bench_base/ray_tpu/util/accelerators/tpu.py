@@ -1,0 +1,47 @@
+"""Public TPU pod-slice scheduling helpers.
+
+Parity: reference python/ray/util/accelerators/tpu.py:7-29 plus the
+pod-slice bundle recipe of _private/accelerators/tpu.py:334-397: a pod
+slice schedules as one STRICT_SPREAD placement group with a per-host
+bundle {TPU: chips_per_host, <pod_name>: 1}, the head bundle adding
+{TPU-<gen>-head: 1}, giving "one actor per pod host, addressed as a
+unit" — the SPMD-slice primitive the Train worker group rides on.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private.accelerators.tpu import (chips_per_host,
+                                               head_resource_name,
+                                               num_hosts)
+
+
+def slice_bundles(accelerator_type: str,
+                  pod_name: Optional[str] = None,
+                  cpus_per_host: float = 1.0) -> List[Dict[str, float]]:
+    """One bundle per slice host; bundle 0 carries the head resource."""
+    hosts = num_hosts(accelerator_type)
+    per_host = chips_per_host(accelerator_type)
+    bundles: List[Dict[str, float]] = []
+    for i in range(hosts):
+        b: Dict[str, float] = {"CPU": cpus_per_host,
+                               "TPU": float(per_host)}
+        if pod_name:
+            b[pod_name] = 1.0
+        if i == 0:
+            b[head_resource_name(accelerator_type)] = 1.0
+        bundles.append(b)
+    return bundles
+
+
+def slice_placement_group(accelerator_type: str,
+                          pod_name: Optional[str] = None,
+                          cpus_per_host: float = 1.0):
+    """Reserve a whole pod slice: STRICT_SPREAD so each bundle lands on
+    a distinct host. Raises PlacementGroupUnschedulableError when the
+    cluster cannot ever hold the slice."""
+    from ray_tpu.util.placement_group import placement_group
+    return placement_group(
+        slice_bundles(accelerator_type, pod_name, cpus_per_host),
+        strategy="STRICT_SPREAD",
+        name=f"tpu_slice_{accelerator_type}")

@@ -1,0 +1,194 @@
+"""User-facing metrics: Counter / Gauge / Histogram + registry.
+
+Parity: reference python/ray/util/metrics.py (Counter:...Gauge,
+Histogram over the OpenCensus pipeline, src/ray/stats/metric.h:103) —
+re-shaped for this runtime: metrics register into an in-process
+registry; `collect()` snapshots every series, and
+`prometheus_text()` renders the standard exposition format for
+scraping or file export. Tags follow the reference's tag_keys model.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_TagTuple = Tuple[str, ...]
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, "Metric"] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: "Metric") -> None:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None and type(existing) is not type(metric):
+                raise ValueError(
+                    f"metric {metric.name!r} already registered as "
+                    f"{type(existing).__name__}")
+            self._metrics[metric.name] = metric
+
+    def get(self, name: str) -> Optional["Metric"]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def collect(self) -> Dict[str, dict]:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m.snapshot() for m in metrics}
+
+    def prometheus_text(self) -> str:
+        lines: List[str] = []
+        for name, snap in self.collect().items():
+            lines.append(f"# HELP {name} {snap['description']}")
+            lines.append(f"# TYPE {name} {snap['type']}")
+            for tags, value in snap["series"].items():
+                label = ",".join(f'{k}="{v}"' for k, v in tags)
+                label = "{" + label + "}" if label else ""
+                if snap["type"] == "histogram":
+                    total, count, buckets = value
+                    blabel = label[:-1] + "," if label else "{"
+                    for bound, c in buckets:
+                        lines.append(
+                            f'{name}_bucket{blabel}le="{bound}"}} {c}')
+                    # exposition format mandates the +Inf bucket == count
+                    lines.append(
+                        f'{name}_bucket{blabel}le="+Inf"}} {count}')
+                    lines.append(f"{name}_sum{label} {total}")
+                    lines.append(f"{name}_count{label} {count}")
+                else:
+                    lines.append(f"{name}{label} {value}")
+        return "\n".join(lines) + "\n"
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+DEFAULT_REGISTRY = MetricsRegistry()
+
+
+class Metric:
+    _type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = (),
+                 registry: Optional[MetricsRegistry] = None):
+        if not name or not name.replace("_", "").replace(":", "") \
+                .isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._series: Dict[_TagTuple, float] = {}
+        self._lock = threading.Lock()
+        self._default_tags: Dict[str, str] = {}
+        (registry or DEFAULT_REGISTRY).register(self)
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> _TagTuple:
+        merged = {**self._default_tags, **(tags or {})}
+        extra = set(merged) - set(self.tag_keys)
+        if extra:
+            raise ValueError(
+                f"unknown tag(s) {sorted(extra)}; declared "
+                f"tag_keys={self.tag_keys}")
+        return tuple((k, str(merged.get(k, ""))) for k in self.tag_keys)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"type": self._type, "description": self.description,
+                    "series": dict(self._series)}
+
+
+class Counter(Metric):
+    _type = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only go up")
+        k = self._key(tags)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    _type = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._series[self._key(tags)] = float(value)
+
+
+DEFAULT_HISTOGRAM_BOUNDARIES = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0)
+
+
+class Histogram(Metric):
+    _type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = DEFAULT_HISTOGRAM_BOUNDARIES,
+                 tag_keys: Sequence[str] = (),
+                 registry: Optional[MetricsRegistry] = None):
+        self.boundaries = tuple(sorted(boundaries))
+        super().__init__(name, description, tag_keys, registry)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            total, count, buckets = self._series.get(
+                k, (0.0, 0, tuple((b, 0) for b in self.boundaries)))
+            buckets = tuple(
+                (b, c + (1 if value <= b else 0)) for b, c in buckets)
+            self._series[k] = (total + value, count + 1, buckets)
+
+
+def timeline(filename: Optional[str] = None) -> list:
+    """Chrome-trace dump of task events (reference `ray timeline`).
+
+    Pairs RUNNING→FINISHED/FAILED transitions per task into complete
+    ("X") events; open-ended states become instant ("i") events. Load
+    the file in chrome://tracing or Perfetto.
+    """
+    import json
+
+    from ray_tpu._private import context as _ctx
+    events = _ctx.get_ctx().state_op("list_tasks", limit=100_000)
+    t0 = min((e["ts"] for e in events), default=0.0)
+    open_runs: Dict[str, dict] = {}
+    trace: List[dict] = []
+    for ev in events:
+        us = (ev["ts"] - t0) * 1e6
+        if ev["state"] == "RUNNING":
+            open_runs[ev["task_id"]] = ev
+        elif ev["state"] in ("FINISHED", "FAILED") and \
+                ev["task_id"] in open_runs:
+            start = open_runs.pop(ev["task_id"])
+            trace.append({
+                "name": ev["name"] or ev["task_id"],
+                "cat": "task", "ph": "X",
+                "ts": (start["ts"] - t0) * 1e6,
+                "dur": (ev["ts"] - start["ts"]) * 1e6,
+                "pid": ev["worker_id"] or start.get("worker_id") or "driver",
+                "tid": ev["task_id"],
+                "args": {"state": ev["state"], "error": ev["error"]},
+            })
+        else:
+            trace.append({
+                "name": f'{ev["name"]}:{ev["state"]}', "cat": "task",
+                "ph": "i", "ts": us, "s": "g",
+                "pid": ev["worker_id"] or "driver", "tid": ev["task_id"],
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -1,0 +1,62 @@
+"""Tracing/profiling hooks (SURVEY §5.1).
+
+Parity: reference util/tracing (opt-in opentelemetry wrapping) + the
+nsight runtime-env plugin + `ray timeline`. The TPU-native profiler IS
+jax.profiler (XLA/TPU traces viewable in TensorBoard/Perfetto); this
+module gives it the framework spelling and keeps the task-level Chrome
+trace next to it:
+
+    with ray_tpu.util.tracing.profile("/tmp/tb"):   # device+host trace
+        train_step(...)
+
+    with ray_tpu.util.tracing.annotate("sample"):    # named span
+        ...
+
+    ray_tpu.util.tracing.task_timeline("out.json")   # task events
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def profile(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace (XLA ops, TPU activity, host) under
+    `log_dir` for TensorBoard/XProf."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span inside a profile() capture (TraceAnnotation); no-op
+    cost when no trace is active."""
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def annotate_fn(name: Optional[str] = None):
+    """Decorator flavor of `annotate` (reference tracing_helper's
+    function wrapping)."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with annotate(name or fn.__qualname__):
+                return fn(*args, **kwargs)
+        return wrapped
+    return deco
+
+
+def task_timeline(filename: Optional[str] = None) -> list:
+    """Chrome-trace of runtime task events (`ray timeline` parity);
+    see util/metrics.timeline."""
+    from ray_tpu.util.metrics import timeline
+    return timeline(filename)

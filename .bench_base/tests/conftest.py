@@ -1,0 +1,56 @@
+"""Test harness config.
+
+Forces JAX onto a virtual 8-device CPU platform *before* any jax import so
+sharding/mesh tests exercise real multi-device paths without TPU hardware —
+the analogue of the reference's same-host multi-raylet trick
+(reference python/ray/cluster_utils.py:135) per SURVEY.md §4.5.
+"""
+import os
+
+# Force CPU even if the environment points at real TPU hardware
+# (JAX_PLATFORMS=axon in the driver env): unit tests always run on the
+# virtual 8-device CPU mesh; only bench.py touches the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep XLA/CPU thread pools small on tiny CI boxes.
+os.environ.setdefault("XLA_CPU_MULTI_THREAD_EIGEN", "false")
+
+# A site hook re-registers the axon TPU platform and rewrites
+# jax_platforms to "axon,cpu"; pin it back to cpu-only for tests.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def ray_cluster():
+    """Shared runtime: reuses a live runtime if present, (re)creates one
+    otherwise (a prior fresh_cluster may have torn it down). No teardown
+    — the session finalizer below shuts it down once."""
+    import ray_tpu
+    yield ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _shutdown_at_end():
+    yield
+    import ray_tpu
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def fresh_cluster():
+    """Isolated runtime for failure-injection tests. Tears down any
+    module-scoped shared runtime first (one runtime per process)."""
+    import ray_tpu
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()

@@ -1,0 +1,144 @@
+"""Remote-driver client + multiprocessing Pool + joblib backend.
+
+Mirrors the reference's client tests (util/client) and shim tests
+(util/multiprocessing, util/joblib): a SECOND process connects to the
+head over TCP as a driver; Pool/joblib run real workloads on actors.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+
+
+# --------------------------------------------------------------- client
+def test_remote_driver_client(fresh_cluster):
+    """A separate process connects via ray_tpu.init(address=...) and
+    uses tasks, actors, put/get, and named-actor lookup against this
+    head (reference util/client ray:// mode)."""
+    host, port = fresh_cluster.address
+
+    # a named actor the client will look up
+    @ray_tpu.remote
+    class Board:
+        def __init__(self):
+            self.v = {}
+
+        def set(self, k, v):
+            self.v[k] = v
+            return "ok"
+
+        def get(self, k):
+            return self.v.get(k)
+
+    board = Board.options(name="board").remote()
+    ray_tpu.get(board.set.remote("seed", 7))
+
+    script = textwrap.dedent(f"""
+        import ray_tpu
+        ctx = ray_tpu.init(address="{host}:{port}")
+        assert ctx.is_connected()
+
+        @ray_tpu.remote
+        def double(x):
+            return 2 * x
+
+        print("TASKS", ray_tpu.get([double.remote(i) for i in range(4)]))
+
+        ref = ray_tpu.put({{"from": "client"}})
+        print("PUTGET", ray_tpu.get(ref)["from"])
+
+        b = ray_tpu.get_actor("board")
+        print("NAMED", ray_tpu.get(b.get.remote("seed")))
+        ray_tpu.get(b.set.remote("reply", 42))
+        ray_tpu.shutdown()
+        print("DONE")
+    """)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("RAY_TPU_SESSION", None)    # a client is its own session
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "TASKS [0, 2, 4, 6]" in out.stdout
+    assert "PUTGET client" in out.stdout
+    assert "NAMED 7" in out.stdout
+    assert "DONE" in out.stdout
+    # the client's actor mutation is visible head-side
+    assert ray_tpu.get(board.get.remote("reply"), timeout=30) == 42
+
+
+# ----------------------------------------------------------------- Pool
+def _sq(x):
+    return x * x
+
+
+def test_multiprocessing_pool_map_variants(ray_cluster):
+    from ray_tpu.util.multiprocessing import Pool
+    with Pool(processes=2) as p:
+        assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert p.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+        assert list(p.imap(_sq, range(6), chunksize=2)) == [
+            0, 1, 4, 9, 16, 25]
+        assert sorted(p.imap_unordered(_sq, range(6))) == [
+            0, 1, 4, 9, 16, 25]
+        assert p.apply(_sq, (7,)) == 49
+        ar = p.map_async(_sq, range(4))
+        assert ar.get(timeout=60) == [0, 1, 4, 9]
+        assert ar.ready() and ar.successful()
+
+
+def test_multiprocessing_pool_initializer_and_errors(ray_cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def init_env(tag):
+        os.environ["POOL_TAG"] = tag
+
+    def read_tag(_):
+        return os.environ.get("POOL_TAG")
+
+    with Pool(processes=2, initializer=init_env,
+              initargs=("hello",)) as p:
+        assert set(p.map(read_tag, range(4))) == {"hello"}
+
+        def boom(x):
+            raise RuntimeError("pool-err")
+        with pytest.raises(Exception, match="pool-err"):
+            p.map(boom, [1, 2])
+        ar = p.map_async(boom, [1])
+        ar.wait(60)
+        assert ar.ready() and not ar.successful()
+    with pytest.raises(ValueError, match="not running"):
+        p.map(_sq, [1])
+
+
+# ---------------------------------------------------------------- joblib
+def test_joblib_backend(ray_cluster):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(
+            joblib.delayed(_sq)(i) for i in range(8))
+    assert out == [i * i for i in range(8)]
+
+
+def _slowsq(x):
+    import time as _t
+    _t.sleep(0.3)
+    return x * x
+
+
+def test_pool_close_join_returns_inflight_results(ray_cluster):
+    """stdlib contract: close() + join() lets pending work finish, so a
+    prior map_async still yields its results."""
+    from ray_tpu.util.multiprocessing import Pool
+    p = Pool(processes=2)
+    ar = p.map_async(_slowsq, range(6))
+    p.close()
+    p.join()
+    assert ar.get(timeout=60) == [x * x for x in range(6)]

@@ -1,0 +1,238 @@
+"""Model zoo tests on the virtual 8-device mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import Transformer, TransformerConfig
+from ray_tpu.models.config import tiny, llama2_7b, PRESETS
+from ray_tpu.parallel import prepare_mesh, param_shardings, shard_pytree
+
+
+def test_param_count_exact():
+    cfg = tiny()
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    n = sum(x.size for x in jax.tree.leaves(params))
+    assert n == cfg.num_params()
+
+
+def test_llama2_7b_param_count():
+    # canonical 6.74B
+    assert abs(llama2_7b().num_params() - 6.738e9) < 2e7
+
+
+def test_forward_shapes_and_loss():
+    cfg = tiny()
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    loss = model.loss(params, {"tokens": tokens})
+    # random init ≈ uniform: CE ~ log(vocab)
+    assert abs(float(loss) - np.log(cfg.vocab_size)) < 1.0
+
+
+def test_sharded_train_step_runs_and_matches_single():
+    cfg = tiny()
+    mesh = prepare_mesh(dp=2, fsdp=2, tp=2)
+    model = Transformer(cfg, mesh=mesh)
+    params = model.init(jax.random.PRNGKey(0))
+    shardings = param_shardings(mesh, model.param_logical_axes())
+    sharded = shard_pytree(params, shardings)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                cfg.vocab_size)
+
+    loss_sharded = jax.jit(model.loss)(sharded, {"tokens": tokens})
+    model_local = Transformer(cfg)  # no mesh: single device
+    loss_local = model_local.loss(params, {"tokens": tokens})
+    np.testing.assert_allclose(float(loss_sharded), float(loss_local),
+                               rtol=1e-4)
+
+
+def test_grad_step_decreases_loss():
+    cfg = tiny()
+    mesh = prepare_mesh(dp=4, tp=2)
+    model = Transformer(cfg, mesh=mesh)
+    params = model.init(jax.random.PRNGKey(0))
+    shardings = param_shardings(mesh, model.param_logical_axes())
+    params = shard_pytree(params, shardings)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+
+    @jax.jit
+    def step(p):
+        loss, g = jax.value_and_grad(model.loss)(p, batch)
+        return loss, jax.tree.map(lambda w, gw: w - 0.5 * gw, p, g)
+
+    loss0, params = step(params)
+    for _ in range(4):
+        loss, params = step(params)
+    assert float(loss) < float(loss0)
+
+
+def test_loss_mask():
+    cfg = tiny()
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    full = model.loss(params, {"tokens": tokens})
+    masked = model.loss(params, {
+        "tokens": tokens,
+        "loss_mask": jnp.zeros((2, 16)).at[:, :8].set(1.0)})
+    assert not np.isclose(float(full), float(masked))
+
+
+def test_ring_attention_model_matches_flash():
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=64, remat=False, dtype="float32",
+        param_dtype="float32", use_ring_attention=True)
+    mesh = prepare_mesh(sp=4)
+    model_ring = Transformer(cfg, mesh=mesh)
+    params = model_ring.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    logits_ring = jax.jit(model_ring.apply)(params, tokens)
+    cfg_flash = TransformerConfig(**{
+        **cfg.__dict__, "use_ring_attention": False})
+    model_flash = Transformer(cfg_flash)
+    logits_flash = model_flash.apply(params, tokens)
+    np.testing.assert_allclose(np.asarray(logits_ring),
+                               np.asarray(logits_flash),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_chunked_loss_matches_dense():
+    cfg = tiny()
+    cfg_chunk = TransformerConfig(**{**cfg.__dict__, "loss_chunk": 32})
+    model = Transformer(cfg)
+    model_chunk = Transformer(cfg_chunk)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                                cfg.vocab_size)
+    mask = jnp.zeros((2, 64)).at[:, 10:50].set(1.0)
+    for batch in ({"tokens": tokens},
+                  {"tokens": tokens, "loss_mask": mask}):
+        dense = model.loss(params, batch)
+        chunked = model_chunk.loss(params, batch)
+        np.testing.assert_allclose(float(chunked), float(dense), rtol=1e-5)
+    # grads agree too
+    g1 = jax.grad(model.loss)(params, {"tokens": tokens})
+    g2 = jax.grad(model_chunk.loss)(params, {"tokens": tokens})
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)
+
+
+def test_tied_embeddings():
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+        tie_embeddings=True, remat=False, dtype="float32",
+        param_dtype="float32")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    assert "lm_head" not in params
+    logits = model.apply(params, jnp.zeros((1, 8), jnp.int32))
+    assert logits.shape == (1, 8, 64)
+
+
+def test_presets_importable():
+    for name, fn in PRESETS.items():
+        cfg = fn()
+        assert cfg.num_params() > 0
+
+
+# ------------------------------------------------------------------ moe
+def test_moe_identical_experts_equals_dense():
+    """With every expert initialised to the dense FFN weights and
+    renormalised top-k routing, the MoE block IS the dense block
+    (sum_k w_k F(x) = F(x)) — the correctness anchor for dispatch."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.config import tiny
+    dense_cfg = tiny()
+    moe_cfg = dataclasses.replace(
+        dense_cfg, moe_num_experts=4, moe_top_k=2,
+        moe_capacity_factor=8.0)
+    dense = Transformer(dense_cfg)
+    moe = Transformer(moe_cfg)
+    dp = dense.init(jax.random.PRNGKey(0))
+    mp = moe.init(jax.random.PRNGKey(0))
+    E = moe_cfg.moe_num_experts
+    for name, src in (("moe_gate", "gate"), ("moe_up", "up"),
+                      ("moe_down", "down")):
+        mp["layers"][name] = jnp.broadcast_to(
+            dp["layers"][src][:, None],
+            (dense_cfg.n_layers, E) + dp["layers"][src].shape[1:])
+    for k in ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"):
+        mp["layers"][k] = dp["layers"][k]
+    mp["embed"] = dp["embed"]
+    mp["final_norm"] = dp["final_norm"]
+    mp["lm_head"] = dp["lm_head"]
+    tokens = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (4, 32), 0, dense_cfg.vocab_size))
+    h_d = jax.jit(dense.hidden)(dp, tokens)
+    h_m = jax.jit(moe.hidden)(mp, tokens)
+    np.testing.assert_allclose(np.asarray(h_m), np.asarray(h_d),
+                               atol=1e-5)
+
+
+def test_moe_ep_mesh_invariance_and_router_grads():
+    """The same MoE model on an (dp,ep,tp) mesh must match single-device
+    outputs; router gets gradient signal through the load-balance loss
+    and combine weights."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.config import tiny
+    from ray_tpu.parallel.mesh import MeshSpec
+    cfg = dataclasses.replace(tiny(), moe_num_experts=4, moe_top_k=2,
+                              moe_capacity_factor=2.0)
+    mesh = MeshSpec(dp=2, ep=2, tp=2).build()
+    model = Transformer(cfg)
+    model_mesh = Transformer(cfg, mesh=mesh)
+    params = model.init(jax.random.PRNGKey(3))
+    tokens = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size))
+    h1 = jax.jit(model.hidden)(params, tokens)
+    h2 = jax.jit(model_mesh.hidden)(params, tokens)
+    np.testing.assert_allclose(np.asarray(h2), np.asarray(h1), atol=1e-5)
+    loss, g = jax.value_and_grad(model_mesh.loss)(
+        params, {"tokens": jnp.asarray(tokens)})
+    assert np.isfinite(float(loss))
+    assert float(jnp.linalg.norm(g["layers"]["router"])) > 0
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree_util.tree_leaves(g))
+
+
+def test_moe_capacity_drops_tokens():
+    """A tiny capacity factor must drop tokens (reported metric) while
+    keeping outputs finite (dropped tokens ride the residual)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.moe import expert_capacity, moe_ffn
+    T, d, E, f = 64, 8, 4, 16
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 5)
+    x = jax.random.normal(ks[0], (2, T // 2, d))
+    out, aux = moe_ffn(
+        x, jax.random.normal(ks[1], (d, E)) * 5.0,  # skewed router
+        jax.random.normal(ks[2], (E, d, f)) * 0.1,
+        jax.random.normal(ks[3], (E, d, f)) * 0.1,
+        jax.random.normal(ks[4], (E, f, d)) * 0.1,
+        top_k=2, capacity_factor=0.25)
+    assert out.shape == x.shape
+    assert np.isfinite(np.asarray(out)).all()
+    assert float(aux["moe_dropped_fraction"]) > 0.1
+    assert expert_capacity(64, 4, 2, 0.25) == 8

@@ -1,0 +1,114 @@
+"""Borrowed-reference protocol (reference reference_count.h:64,115-117
+borrower registration + WaitForRefRemoved; reference_count.cc nested-ref
+ownership for refs pickled inside other objects).
+
+The r4 VERDICT's prescribed failing scenario: an actor stores a ref it
+received inside an argument PAST the carrying task, the driver drops its
+own handle, and the actor must still be able to get() the object.
+"""
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Holder:
+    def set(self, box):
+        self.ref = box[0]          # borrow outlives the carrying task
+        return True
+
+    def read(self):
+        return float(ray_tpu.get(self.ref, timeout=15)[0])
+
+    def drop(self):
+        self.ref = None
+        gc.collect()
+        return True
+
+
+def test_actor_stored_borrow_survives_driver_drop(rt):
+    data = ray_tpu.put(np.full(300_000, 5.0))   # shm-backed
+    oid = data.object_id
+    h = Holder.remote()
+    assert ray_tpu.get(h.set.remote([data]), timeout=60)
+    del data                      # driver's only handle gone
+    gc.collect()
+    time.sleep(1.5)               # deletion (if wrongly triggered) lands
+    # the actor's borrow keeps the object alive
+    assert ray_tpu.get(h.read.remote(), timeout=30) == 5.0
+    # once the actor drops its borrow, the deferred decref frees it
+    assert ray_tpu.get(h.drop.remote(), timeout=30)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if rt.controller.unreferenced(oid) and not rt.store.contains(oid):
+            break
+        time.sleep(0.2)
+    assert rt.controller.unreferenced(oid)
+    assert not rt.store.contains(oid), "borrow release did not free object"
+
+
+def test_put_containing_refs_keeps_inner_alive(rt):
+    inner = ray_tpu.put(np.full(200_000, 3.0))
+    inner_id = inner.object_id
+    outer = ray_tpu.put([inner, "meta"])
+    del inner                     # outer's containment keeps it counted
+    gc.collect()
+    time.sleep(1.0)
+    got = ray_tpu.get(outer, timeout=30)
+    assert float(ray_tpu.get(got[0], timeout=30)[0]) == 3.0
+    del got
+    # deleting the outer object cascades to the inner
+    del outer
+    gc.collect()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if not rt.store.contains(inner_id):
+            break
+        time.sleep(0.2)
+    assert not rt.store.contains(inner_id), "containment release leaked"
+
+
+def test_task_returning_ref(rt):
+    @ray_tpu.remote
+    def make():
+        return [ray_tpu.put(np.full(150_000, 7.0))]
+
+    box = ray_tpu.get(make.remote(), timeout=60)
+    gc.collect()
+    time.sleep(1.0)               # worker-side borrow decrefs land
+    assert float(ray_tpu.get(box[0], timeout=30)[0]) == 7.0
+
+
+def test_borrow_across_remote_agent(rt):
+    """The borrow/decref messages relay through a real node agent."""
+    from ray_tpu.cluster_utils import NodeAgentProcess
+    agent = NodeAgentProcess(num_cpus=2, resources={"bor": 4.0})
+    try:
+        deadline = time.monotonic() + 30
+        while (len(rt.cluster.alive_nodes()) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        assert len(rt.cluster.alive_nodes()) >= 2
+
+        data = ray_tpu.put(np.full(250_000, 9.0))
+        h = Holder.options(resources={"bor": 1.0}).remote()
+        assert ray_tpu.get(h.set.remote([data]), timeout=90)
+        del data
+        gc.collect()
+        time.sleep(1.5)
+        assert ray_tpu.get(h.read.remote(), timeout=60) == 9.0
+    finally:
+        agent.terminate()

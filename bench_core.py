@@ -41,7 +41,9 @@ def _frame_stats(s0: dict, n_tasks: int) -> dict:
 
 def _drain_with_frames(n_tasks: int) -> dict:
     """Fresh runtime under the CURRENT env: drain n nop tasks and
-    report frames per completed task."""
+    report frames per completed task plus head-process CPU µs/task
+    (process_time covers every thread in the head — the Python/C split
+    of the frame engine shows up here, not in wall time)."""
     import ray_tpu
     from ray_tpu._private import protocol
     from ray_tpu._private.config import CONFIG
@@ -55,19 +57,104 @@ def _drain_with_frames(n_tasks: int) -> dict:
     for _ in range(3):
         ray_tpu.get([nop.remote() for _ in range(30)])       # warm pool
     s0 = dict(protocol.WIRE_STATS)
+    c0 = time.process_time()
     t0 = time.perf_counter()
     refs = [nop.remote() for _ in range(n_tasks)]
     ray_tpu.get(refs, timeout=300)
     dt = time.perf_counter() - t0
+    cpu = time.process_time() - c0
     stats = _frame_stats(s0, n_tasks)
     ray_tpu.shutdown()
     return {"n": n_tasks, "seconds": round(dt, 4),
             "per_second": round(n_tasks / dt, 1), "unit": "tasks",
+            "head_cpu_us_per_task": round(cpu / n_tasks * 1e6, 1),
             **stats}
+
+
+def _codec_bench() -> dict:
+    """Codec-only cost: encode+decode µs for the hot frame shapes,
+    native engine vs pure-Python protobuf (RAY_TPU_WIRE_NATIVE=0 —
+    in-process equivalent of RAY_TPU_DISABLE_NATIVE for the wire
+    paths). No runtime, no sockets: isolates the envelope tax the r7
+    C codec attacks."""
+    import os as _os
+    from ray_tpu._private import wire
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu._private.specs import TaskSpec
+
+    spec = TaskSpec(task_id="t" * 16, func_id="f" * 16,
+                    args=(1, 2.5, "x", b"b" * 64), kwargs={"k": [1, 2]},
+                    return_ids=["t" * 16 + "r0"],
+                    resources={"CPU": 1.0})
+    task = {"type": "task", "rid": 123, "spec": spec}
+    done = {"type": "task_done", "rid": 124, "task_id": "t" * 16,
+            "results": ["r" * 18], "error": None}
+    # all Python-plane subs: a structural sub anywhere makes
+    # dumps_batch take the one-shot protobuf path (by design), which
+    # would turn this row into a protobuf-vs-protobuf comparison
+    batch64 = [dict(done, rid=1000 + i) for i in range(64)]
+    from google.protobuf.internal import api_implementation
+    backend = api_implementation.Type()
+    N = 3000
+    out: dict = {}
+    for mode in ("native", "python"):
+        if mode == "python":
+            _os.environ["RAY_TPU_WIRE_NATIVE"] = "0"
+        else:
+            # force the C codec: 'auto' would defer to a C-backed
+            # protobuf, and this scenario measures the codec itself
+            _os.environ["RAY_TPU_WIRE_NATIVE_CODEC"] = "1"
+        CONFIG.reload()
+        try:
+            rec = {}
+            for name, fn in (
+                    ("task_us", lambda: wire.loads(wire.dumps(task))),
+                    ("task_done_us",
+                     lambda: wire.loads(wire.dumps(done))),
+                    ("batch64_us",
+                     lambda: wire.loads(wire.dumps_batch(batch64)))):
+                fn()                                     # warm
+                n = N // 10 if name == "batch64_us" else N
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    fn()
+                rec[name] = round(
+                    (time.perf_counter() - t0) / n * 1e6, 2)
+            out[f"wire_codec_{mode}"] = {
+                "n": N, "unit": "roundtrips",
+                "pb_backend": backend,
+                # False here means the forced C codec could NOT engage
+                # (no compiler / RAY_TPU_DISABLE_NATIVE) and this row
+                # degenerated to a protobuf-vs-protobuf comparison
+                "c_codec_active": wire._native_codec() is not None,
+                **rec}
+        finally:
+            _os.environ.pop("RAY_TPU_WIRE_NATIVE", None)
+            _os.environ.pop("RAY_TPU_WIRE_NATIVE_CODEC", None)
+            CONFIG.reload()
+    return out
 
 
 def main(as_json: bool = False) -> dict:
     results: dict = {}
+
+    # ----------------------- wire codec: native vs pure Python (r7)
+    results.update(_codec_bench())
+
+    # ------------- native frame engine: 5k drain A/B (r7)
+    # Back-to-back fresh runtimes, same box, same tree — the OFF run
+    # first (workers inherit the env at spawn), then the identical ON
+    # run, so the pair is the tightest native-vs-python comparison the
+    # bench produces (scenarios further down drift with box load).
+    os.environ["RAY_TPU_DISABLE_NATIVE"] = "1"
+    try:
+        results["drain_5k_nonative"] = _drain_with_frames(5000)
+    finally:
+        os.environ.pop("RAY_TPU_DISABLE_NATIVE", None)
+    results["drain_5k_native"] = _drain_with_frames(5000)
+    results["drain_5k_native"]["native_speedup"] = round(
+        results["drain_5k_native"]["per_second"]
+        / results["drain_5k_nonative"]["per_second"], 2)
 
     # ------------------- control-frame coalescing: off vs on (r6)
     # The OFF run goes first in its own runtime (workers inherit the
@@ -292,15 +379,18 @@ def main(as_json: bool = False) -> dict:
     from ray_tpu._private import protocol as _protocol
     K = 5000
     s0 = dict(_protocol.WIRE_STATS)
+    c0 = time.process_time()
     t0 = time.perf_counter()
     refs = [nop.remote() for _ in range(K)]
     dt_submit = time.perf_counter() - t0
     ray_tpu.get(refs, timeout=300)
     dt_total = time.perf_counter() - t0
+    cpu = time.process_time() - c0
     results["queue_5k_tasks"] = {
         "n": K, "seconds": round(dt_total, 4),
         "submit_per_second": round(K / dt_submit, 1),
         "per_second": round(K / dt_total, 1), "unit": "tasks",
+        "head_cpu_us_per_task": round(cpu / K * 1e6, 1),
         **_frame_stats(s0, K)}
 
     # ----------------------------- 100k queued: O(1) submit check

@@ -325,20 +325,41 @@ class ClusterTaskManager:
                     feasible = preferred
         if not feasible:
             return None
+        # AT MOST one effective_avail snapshot (= one scheduler-lock
+        # round trip) per node per selection, taken lazily: the
+        # pack/spread phases below previously re-took that hot lock
+        # 3-5x per submit, serializing submission against dispatch/
+        # completion processing — a large share of per-submit head CPU
+        # under a drain (r7 profile). Lazy, so the common case (first
+        # node passes the pack check) still touches one node.
+        eff_cache: dict = {}
+        util_cache: dict = {}
+
+        def _eff(n):
+            e = eff_cache.get(id(n))
+            if e is None:
+                e = eff_cache[id(n)] = n.scheduler.effective_avail()
+            return e
+
+        def _util(n):
+            u = util_cache.get(id(n))
+            if u is None:
+                u = util_cache[id(n)] = Scheduler.utilization_from(
+                    _eff(n), n.scheduler.total)
+            return u
+
         # Pack phase: first node (stable order) with enough room now and
         # below the utilization threshold (both incl. queued demand).
         for n in feasible:
-            if (n.scheduler.utilization() < _HYBRID_THRESHOLD
-                    and fits(n.scheduler.effective_avail(), need)):
+            if _util(n) < _HYBRID_THRESHOLD and fits(_eff(n), need):
                 return n
         # Spread phase: least-utilized node that fits now.
-        fitting = [n for n in feasible
-                   if fits(n.scheduler.effective_avail(), need)]
+        fitting = [n for n in feasible if fits(_eff(n), need)]
         if fitting:
-            return min(fitting, key=lambda n: n.scheduler.utilization())
+            return min(fitting, key=_util)
         # Nothing fits *now*: queue on the least-utilized feasible node;
         # its dispatch loop waits for resources (or spills back later).
-        return min(feasible, key=lambda n: n.scheduler.utilization())
+        return min(feasible, key=_util)
 
     def _retry_infeasible(self) -> None:
         with self._lock:

@@ -146,6 +146,28 @@ _define("wire_batch_delay_ms", 1.0,
         "~this long plus the flusher thread-wake latency. Reply-"
         "bearing and other eager sends bypass the queue entirely (and "
         "flush it first, preserving per-connection FIFO order).")
+_define("wire_native", True,
+        "Use the native frame engine (GIL-released socket read pump, "
+        "scatter-gather flush, C envelope codec in "
+        "native/core.c) for the wire hot path when the native library "
+        "is available. 0 restores the pure-Python wire paths without "
+        "touching the other native users (channel waits, CRC32C); "
+        "RAY_TPU_DISABLE_NATIVE=1 disables all of them.")
+_define("wire_native_codec", "auto",
+        "Envelope codec selection when the native frame engine is on. "
+        "'auto' (default): use the C codec only when the installed "
+        "protobuf backend is the pure-Python one (~3x encode/decode "
+        "there; the upb/C++ backends already serialize in C and beat "
+        "per-frame ctypes calls). '1' forces the C codec, '0' forces "
+        "the protobuf codec. Large pickled bodies always take the "
+        "zero-copy scatter-gather emit path regardless.")
+_define("wire_max_frame_bytes", 1 << 30,
+        "Sanity bound on a frame's length prefix. A frame claiming to "
+        "be larger is treated as a corrupt/hostile stream and the "
+        "connection dies immediately — instead of the reader "
+        "attempting a multi-GB allocation. Must comfortably exceed "
+        "the largest legitimate frame (pull chunks are 4 MB; state "
+        "replies can reach tens of MB).")
 _define("shm_pool", True,
         "Reuse freed shm segments for subsequent large-object puts via "
         "a size-classed free pool (segments are renamed, not "
@@ -168,6 +190,12 @@ class _Config:
 
     def __init__(self):
         self._cache: Dict[str, Any] = {}
+        # Bumped by reload(): per-call-site memos of derived config
+        # state (e.g. native.frame_engine_enabled on the per-frame hot
+        # path) key on this instead of re-reading the environment.
+        # Contract: flipping a RAY_TPU_* env var takes effect after
+        # CONFIG.reload() — which the tests and bench already call.
+        self._gen: int = 0
 
     def __getattr__(self, name: str) -> Any:
         if name.startswith("_"):
@@ -187,6 +215,7 @@ class _Config:
     def reload(self) -> None:
         """Drop cached values so env overrides re-apply (tests)."""
         self.__dict__["_cache"].clear()
+        self.__dict__["_gen"] += 1
 
     def describe(self) -> Dict[str, Dict[str, Any]]:
         """All knobs with current value, default, env var name, doc."""

@@ -333,6 +333,10 @@ class NodeAgent:
                 self.head.send({
                     "type": protocol.NODE_HEARTBEAT,
                     "node_id": self.node_id,
+                    # agent-process frame counters (r7 frame engine
+                    # telemetry): plain int dict, rides the structural
+                    # node plane like the rest of the heartbeat
+                    "wire": dict(protocol.WIRE_STATS),
                     **self.scheduler.heartbeat_snapshot(),
                 })
             except protocol.ConnectionClosed:
@@ -462,6 +466,9 @@ class NodeAgent:
         mtype = msg["type"]
         if mtype == protocol.REGISTER:
             self.scheduler.on_worker_registered(msg["worker_id"], conn)
+            # surfaced via workers_snapshot rows in heartbeats
+            conn.meta["wire_native"] = bool(
+                msg.get("wire_native", False))
         elif mtype == protocol.TASK_DONE:
             self._on_task_done(conn, msg)
         elif mtype == protocol.GET_OBJECT:

@@ -28,8 +28,10 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from ray_tpu import native
 from ray_tpu._private.wire import (BATCH_MIN_MINOR, BATCH_TYPE, WIRE_MAJOR,
                                    WireVersionError, dumps, dumps_batch,
+                                   encode_batch_parts, encode_frame_parts,
                                    loads_ex)
 
 _LEN = struct.Struct("<Q")
@@ -90,6 +92,13 @@ PULL_CHUNK = "pull_chunk"              # any -> holder (reply: data)
 
 class ConnectionClosed(Exception):
     pass
+
+
+class FrameTooLarge(ConnectionClosed):
+    """A frame's length prefix exceeds wire_max_frame_bytes: corrupt
+    (or hostile) stream. The connection dies before the reader
+    attempts a multi-GB allocation; existing ConnectionClosed handling
+    covers recovery."""
 
 
 def _auth_token() -> Optional[bytes]:
@@ -286,29 +295,74 @@ class Connection:
         single BatchFrame envelope when the peer negotiated batch
         support, else the individual frames concatenated (one syscall
         either way; the latter is valid toward ANY same-major peer).
-        Caller holds _send_lock."""
+        With the native engine the write is one scatter-gather
+        sendmsg(2) over (length-prefix, header, payload) buffers — GIL
+        released, and a Python-plane frame's pickled body goes from
+        the pickler to the kernel with zero copies; the fallback joins
+        and sendall()s. Caller holds _send_lock."""
+        eng_on = native.frame_engine_enabled()
         if len(frames) > 1 and self._peer_speaks_batch():
-            data = dumps_batch(frames)
-            payload = _LEN.pack(len(data)) + data
+            parts = (encode_batch_parts(frames) if eng_on
+                     else [dumps_batch(frames)])
+            bufs = [_LEN.pack(sum(map(len, parts))), *parts]
             WIRE_STATS["tx_frames"] += 1
         else:
-            parts = []
+            bufs = []
             for msg in frames:
-                data = dumps(msg)
-                parts.append(_LEN.pack(len(data)))
-                parts.append(data)
-            payload = b"".join(parts)
+                parts = (encode_frame_parts(msg) if eng_on
+                         else [dumps(msg)])
+                bufs.append(_LEN.pack(sum(map(len, parts))))
+                bufs.extend(parts)
             WIRE_STATS["tx_frames"] += len(frames)
         WIRE_STATS["tx_msgs"] += len(frames)
+        total = sum(map(len, bufs))
         try:
-            self._sock.sendall(payload)
+            # Scatter-gather pays for its per-buffer setup once the
+            # emit is a real burst or carries a big payload; a lone
+            # small frame is cheaper joined. sendmsg(2) — not a raw-fd
+            # C writev — so the fd stays owned by the socket object: a
+            # concurrent close() surfaces as EBADF instead of racing
+            # fd reuse and writing this frame into an unrelated
+            # connection (the reader pins its fd with a dup for the
+            # same reason).
+            if eng_on and (len(bufs) > 4 or total >= 1 << 16):
+                self._sendmsg_all(bufs, total)
+            else:
+                self._sock.sendall(b"".join(bufs))
         except OSError as e:
-            # A failed sendall may have written a PARTIAL frame
+            # A failed write may have put a PARTIAL frame on the wire
             # (e.g. the SO_SNDTIMEO budget expired mid-write); the
             # stream is desynced, so the connection must die — a
             # later send would be parsed as garbage by the peer.
             self.close()
             raise ConnectionClosed(str(e)) from e
+
+    def _sendmsg_all(self, bufs: list, total: int) -> None:
+        """Write every buffer as few scatter-gather sendmsg(2)
+        syscalls as possible (GIL released per call): chunked at 1024
+        buffers (IOV_MAX), partial sends resumed with memoryview
+        slices — no byte is ever copied into a joined payload. Raises
+        OSError like sendall (EAGAIN = SO_SNDTIMEO expired: stream
+        desynced, caller kills the connection)."""
+        sent_total = 0
+        pos = 0
+        while sent_total < total:
+            chunk = bufs[pos:pos + 1024]
+            want = sum(map(len, chunk))
+            sent = self._sock.sendmsg(chunk)
+            sent_total += sent
+            if sent == want:
+                pos += len(chunk)
+                continue
+            # partial send (kernel buffer full): drop fully-written
+            # buffers, slice the straddled one, retry from there
+            bufs = bufs[pos:]
+            pos = 0
+            while sent >= len(bufs[0]):
+                sent -= len(bufs[0])
+                bufs.pop(0)
+            if sent:
+                bufs[0] = memoryview(bufs[0])[sent:]
 
     def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
         """Send a request and block for the matching reply."""
@@ -353,23 +407,81 @@ class Connection:
             remaining -= len(chunk)
         return b"".join(chunks)
 
+    def _handle_frame(self, data: bytes) -> None:
+        """Decode one framed body and dispatch its message(s)."""
+        msg, version = loads_ex(data)
+        self.peer_wire_version = version
+        WIRE_STATS["rx_frames"] += 1
+        if msg.get("type") == BATCH:
+            for sub in msg["frames"]:
+                WIRE_STATS["rx_msgs"] += 1
+                self._dispatch(sub)
+        else:
+            WIRE_STATS["rx_msgs"] += 1
+            self._dispatch(msg)
+
+    def _native_read_loop(self) -> None:
+        """Native pump: blocking read(2) + length-prefix reassembly
+        run in C with the GIL RELEASED — the Python loop below holds
+        the GIL for every chunk recv and header parse, actively
+        starving the handler/sender threads on few-core hosts. One
+        pump call returns every complete frame it buffered."""
+        from ray_tpu._private.config import CONFIG
+        reader = native.FrameReader(self._sock.fileno(),
+                                    CONFIG.wire_max_frame_bytes)
+        try:
+            while True:
+                try:
+                    frames = reader.pump()
+                except native.PumpClosed:
+                    raise ConnectionClosed("peer closed") from None
+                except native.PumpOversized as e:
+                    raise FrameTooLarge(str(e)) from None
+                for frame in frames:
+                    self._handle_frame(frame)
+        finally:
+            reader.close()
+
+    def _py_read_loop(self) -> None:
+        """Pure-Python fallback: one reassembly bytearray per
+        connection (amortized append, no per-chunk bytes concat), with
+        the same max-frame-size guard as the native pump."""
+        from ray_tpu._private.config import CONFIG
+        max_frame = CONFIG.wire_max_frame_bytes
+        buf = bytearray()
+        while True:
+            while len(buf) < _LEN.size:
+                chunk = self._sock.recv(1 << 20)
+                if not chunk:
+                    raise ConnectionClosed("peer closed")
+                buf += chunk
+            (length,) = _LEN.unpack_from(buf)
+            if length > max_frame:
+                raise FrameTooLarge(
+                    f"frame length prefix {length} exceeds "
+                    f"wire_max_frame_bytes ({max_frame})")
+            total = _LEN.size + length
+            while len(buf) < total:
+                chunk = self._sock.recv(1 << 20)
+                if not chunk:
+                    raise ConnectionClosed("peer closed")
+                buf += chunk
+            frame = bytes(memoryview(buf)[_LEN.size:total])
+            del buf[:total]
+            self._handle_frame(frame)
+
     def _read_loop(self) -> None:
         try:
             if self._server and not self._check_auth():
                 return
-            while True:
-                header = self._read_exact(_LEN.size)
-                (length,) = _LEN.unpack(header)
-                msg, version = loads_ex(self._read_exact(length))
-                self.peer_wire_version = version
-                WIRE_STATS["rx_frames"] += 1
-                if msg.get("type") == BATCH:
-                    for sub in msg["frames"]:
-                        WIRE_STATS["rx_msgs"] += 1
-                        self._dispatch(sub)
-                else:
-                    WIRE_STATS["rx_msgs"] += 1
-                    self._dispatch(msg)
+            if native.frame_engine_enabled():
+                self._native_read_loop()
+            else:
+                self._py_read_loop()
+        except FrameTooLarge as e:
+            import sys as _sys
+            _sys.stderr.write(
+                f"ray_tpu: killing connection ({self.name}): {e}\n")
         except (ConnectionClosed, OSError):
             pass
         except WireVersionError as e:

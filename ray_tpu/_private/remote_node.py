@@ -45,6 +45,7 @@ class RemoteNodeHandle:
         self._work: dict[str, tuple[Any, bool]] = {}
         # worker_id -> actor_id (or None) as reported by dispatch events.
         self._workers: dict[str, Optional[str]] = {}
+        self.wire_stats: dict[str, int] = {}
         self._dead = False
 
     # ------------------------------------------------------- heartbeat
@@ -56,6 +57,9 @@ class RemoteNodeHandle:
             self._pending_shapes = list(msg.get("pending_shapes", []))
             self._idle = bool(msg.get("is_idle", False))
             self._last_workers = list(msg.get("workers", []))
+            # agent-process frame counters (r7 telemetry; {} from
+            # pre-r7 agents) — debug surface for per-node wire load
+            self.wire_stats = dict(msg.get("wire", {}))
 
     def workers_snapshot(self) -> list:
         """Worker table rows as of the last heartbeat."""

@@ -253,6 +253,24 @@ class Runtime(_context.BaseContext):
     def _scheduler_for_worker(self, worker_id: str):
         return self.cluster.scheduler_for_worker(worker_id)
 
+    def _sched_for_conn(self, conn: protocol.Connection):
+        """Scheduler owning this worker connection, cached on the
+        connection at REGISTER. A worker never migrates between nodes
+        and the cache dies with the connection on worker death, so the
+        entry can't go stale — and the per-message probe it replaces
+        took EVERY node's hot scheduler lock on every received
+        TASK_DONE/GET/WAIT (r7 profile: a top head-CPU cost under
+        drains, serializing reader threads against dispatch)."""
+        sched = conn.meta.get("sched")
+        if sched is None:
+            wid = conn.meta.get("worker_id")
+            if not wid:
+                return None
+            sched = self.cluster.scheduler_for_worker(wid)
+            if sched is not None:
+                conn.meta["sched"] = sched
+        return sched
+
     # ================= connection plumbing =================
     def _accept_loop(self) -> None:
         while not self._shutdown:
@@ -417,6 +435,10 @@ class Runtime(_context.BaseContext):
             sched = self._scheduler_for_worker(msg["worker_id"])
             if sched is not None:
                 sched.on_worker_registered(msg["worker_id"], conn)
+                conn.meta["sched"] = sched     # hot-path cache
+                # surfaced via workers_snapshot / list_workers
+                conn.meta["wire_native"] = bool(
+                    msg.get("wire_native", False))
             else:
                 conn.close()              # worker from a dead/old node
         elif mtype == protocol.TASK_DONE:
@@ -527,7 +549,7 @@ class Runtime(_context.BaseContext):
             if self.controller.unreferenced(stored.object_id):
                 self._delete_everywhere(stored.object_id)
         worker_id = conn.meta.get("worker_id", "")
-        wsched = self._scheduler_for_worker(worker_id)
+        wsched = self._sched_for_conn(conn)
         if msg.get("is_actor_create"):
             actor_id = msg["actor_id"]
             if wsched is not None:
@@ -773,7 +795,7 @@ class Runtime(_context.BaseContext):
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
         wid = conn.meta.get("worker_id")
-        wsched = self._scheduler_for_worker(wid) if wid else None
+        wsched = self._sched_for_conn(conn)
         if self.store.contains(oid) or self.controller.has_location(oid):
             self._restore_pool.submit(
                 self._blocking_get_reply, conn, msg, oid, deadline,
@@ -969,7 +991,7 @@ class Runtime(_context.BaseContext):
             conn.reply(msg, ready=ready_now[:num_returns])
             return
         wid = conn.meta.get("worker_id")
-        wsched = self._scheduler_for_worker(wid) if wid else None
+        wsched = self._sched_for_conn(conn)
         if wsched is not None:
             wsched.worker_blocked(wid)
 
@@ -1272,6 +1294,14 @@ class Runtime(_context.BaseContext):
             return self.cluster.available_resources()
         if op == "scheduler_stats":
             return self.scheduler.stats()
+        if op == "wire_stats":
+            # head-process frame counters + which wire engine is live
+            # (native read pump / writev / codec vs pure Python) — the
+            # r7 frame engine's observability hook
+            from ray_tpu import native
+            return {**protocol.WIRE_STATS,
+                    "native_frame_engine": native.frame_engine_enabled(),
+                    "native_available": native.available()}
         if op == "cluster_stats":
             return self.cluster.stats()
         if op == "object_store_stats":

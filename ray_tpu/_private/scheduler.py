@@ -435,6 +435,10 @@ class Scheduler:
                 "blocked_depth": r.blocked_depth,
                 "env_hash": r.env_hash,
                 "age_s": round(now - r.started_at, 1),
+                # which wire engine the worker registered with (r7):
+                # a mixed-mode fleet is a perf-debugging smell
+                "wire_native": (r.conn.meta.get("wire_native")
+                                if r.conn is not None else None),
             } for r in self._workers.values()]
 
     def worker_running_task(self, task_id: str):
@@ -743,15 +747,23 @@ class Scheduler:
             return all(abs(self.avail.get(k, 0.0) - v) < 1e-6
                        for k, v in self.total.items())
 
-    def utilization(self) -> float:
-        """Max per-resource utilization fraction incl. queued demand
-        (hybrid-policy input; may exceed 1.0 under backlog)."""
-        eff = self.effective_avail()
+    @staticmethod
+    def utilization_from(eff: dict[str, float],
+                         total: dict[str, float]) -> float:
+        """utilization() over a caller-held effective_avail snapshot —
+        the hybrid selection loop takes ONE snapshot per node and
+        derives both its fits() check and this from it, instead of
+        re-taking the hot scheduler lock for every phase."""
         u = 0.0
-        for k, tot in self.total.items():
+        for k, tot in total.items():
             if tot > 0:
                 u = max(u, 1.0 - eff.get(k, 0.0) / tot)
         return u
+
+    def utilization(self) -> float:
+        """Max per-resource utilization fraction incl. queued demand
+        (hybrid-policy input; may exceed 1.0 under backlog)."""
+        return self.utilization_from(self.effective_avail(), self.total)
 
     def live_actors(self) -> dict[str, str]:
         """actor_id -> worker_id for actors with a live worker here —

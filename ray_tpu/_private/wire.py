@@ -41,10 +41,11 @@ from __future__ import annotations
 
 import io
 import pickle
-from typing import Any
+from typing import Any, Optional
 
 import cloudpickle
 
+from ray_tpu import native as _native
 from ray_tpu._private import wire_pb2 as pb
 
 WIRE_MAJOR = 1
@@ -188,25 +189,162 @@ def _fill_envelope(env: "pb.Envelope", msg: dict) -> None:
             env.py_body = _pickle(rest)
 
 
-def dumps(msg: dict) -> bytes:
-    """Encode a message dict as a versioned Envelope frame body."""
-    if msg.get("type") == BATCH_TYPE:
-        return dumps_batch(msg["frames"])
+# ---- native codec fast path (r7) ----
+# The hot Envelope shape — Python-plane header + opaque py_body, and
+# BatchFrame assembly/splitting — can encode and decode through
+# native/core.c: no protobuf message objects on the per-frame path.
+# Whether that wins depends on the installed protobuf backend: against
+# the pure-Python backend the C codec is ~3x; against upb/C++ the
+# per-frame ctypes call overhead LOSES to protobuf's own C serializer,
+# so 'auto' picks the C codec only on pure-Python-protobuf hosts
+# (wire_native_codec forces either way). The structural plane
+# (node-neutral field-by-field Values) and anything the C parser flags
+# as irregular always stay on the real protobuf codec, which remains
+# the arbiter of malformed input.
+
+_pb_pure_python: Optional[bool] = None
+_codec_memo: tuple = (-1, None)
+
+# Pickled bodies at least this large always ride the scatter-gather
+# emit (C header + body as separate iovecs): the join/serialize copy
+# they'd otherwise pay dwarfs a ctypes call. Small bodies only do when
+# the C codec is selected.
+_ZEROCOPY_MIN_BODY = 16 * 1024
+
+
+def _native_codec():
+    """The native module when the C envelope codec should be used for
+    dumps/loads, else None. Memoized per CONFIG generation (this runs
+    per frame); flip modes in-process with env var + CONFIG.reload()."""
+    global _codec_memo, _pb_pure_python
+    if not _native.frame_engine_enabled():
+        return None
+    from ray_tpu._private.config import CONFIG
+    gen = CONFIG._gen
+    memo = _codec_memo
+    if memo[0] == gen:
+        return memo[1]
+    mode = str(CONFIG.wire_native_codec).strip().lower()
+    if mode in ("auto", ""):
+        if _pb_pure_python is None:
+            from google.protobuf.internal import api_implementation
+            _pb_pure_python = api_implementation.Type() == "python"
+        on = _pb_pure_python
+    else:
+        on = mode in ("1", "true", "yes", "on")
+    eng = _native if on else None
+    _codec_memo = (gen, eng)
+    return eng
+
+
+def _encode_one(msg: dict, eng=None) -> bytes:
+    """Serialize ONE message to Envelope bytes (never a batch)."""
+    mtype = msg.get("type", "")
+    if eng is None:
+        eng = _native_codec()
+    if eng is not None and mtype not in STRUCTURAL_TYPES:
+        rest = {k: v for k, v in msg.items()
+                if k != "type" and k != "rid"}
+        body = _pickle(rest) if rest else b""
+        return eng.env_encode(WIRE_VERSION, mtype.encode(),
+                              msg.get("rid", 0), body)
     env = pb.Envelope()
     _fill_envelope(env, msg)
     return env.SerializeToString()
 
 
+def dumps(msg: dict) -> bytes:
+    """Encode a message dict as a versioned Envelope frame body."""
+    if msg.get("type") == BATCH_TYPE:
+        return dumps_batch(msg["frames"])
+    return _encode_one(msg)
+
+
 def dumps_batch(msgs: list[dict]) -> bytes:
     """Encode N message dicts as ONE BatchFrame envelope: one frame on
     the wire, N sub-frames delivered in order at the receiver. Only
-    valid toward a peer that negotiated batch support (MINOR >= 1)."""
+    valid toward a peer that negotiated batch support (MINOR >= 1).
+    The native assembly is used only when every sub-frame is Python-
+    plane: structural sub-frames would each pay a separate protobuf
+    serialize, where the one-shot protobuf batch encode amortizes."""
+    eng = _native_codec()
+    if eng is not None and all(
+            m.get("type", "") not in STRUCTURAL_TYPES for m in msgs):
+        subs = [_encode_one(m, eng) for m in msgs]
+        return eng.batch_encode(WIRE_VERSION, BATCH_TYPE.encode(), subs)
     env = pb.Envelope(version=WIRE_VERSION, type=BATCH_TYPE)
     batch = env.batch
     batch.SetInParent()
     for msg in msgs:
         _fill_envelope(batch.frames.add(), msg)
     return env.SerializeToString()
+
+
+def _pb_varint(n: int) -> bytes:
+    out = bytearray()
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out)
+
+
+def encode_frame_parts(msg: dict, eng=None) -> list[bytes]:
+    """ONE frame's Envelope bytes as a buffer list for scatter-gather
+    emit (protocol._emit_locked -> sendmsg): [C-encoded header, pickled
+    body] when the C codec is selected or the body clears the
+    zero-copy threshold — the body bytes then go from the pickler to
+    the kernel without ever being copied into a joined frame.
+    Structural/batch/other frames collapse to [dumps(msg)]. The
+    buffer-list concatenation is byte-identical to dumps(msg)."""
+    if eng is None:
+        eng = _native_codec()
+    mtype = msg.get("type", "")
+    if mtype in STRUCTURAL_TYPES or mtype == BATCH_TYPE:
+        return [dumps(msg)]
+    rest = {k: v for k, v in msg.items() if k != "type" and k != "rid"}
+    if not rest:
+        return [dumps(msg)] if eng is None else [
+            eng.env_encode_header(WIRE_VERSION, mtype.encode(),
+                                  msg.get("rid", 0), 0, 0)]
+    body = _pickle(rest)
+    zero_copy = (eng is not None
+                 or (len(body) >= _ZEROCOPY_MIN_BODY
+                     and _native.frame_engine_enabled()))
+    if not zero_copy:
+        env = pb.Envelope()                   # protobuf codec, body
+        env.version = WIRE_VERSION            # already pickled above
+        env.type = mtype
+        env.rid = msg.get("rid", 0)
+        env.py_body = body
+        return [env.SerializeToString()]
+    hdr = _native.env_encode_header(WIRE_VERSION, mtype.encode(),
+                                    msg.get("rid", 0), 0x2A, len(body))
+    return [hdr, body]
+
+
+def encode_batch_parts(msgs: list[dict], eng=None) -> list[bytes]:
+    """One BatchFrame envelope as a buffer list for scatter-gather
+    emit: outer header + per-sub (frame-key prefix, sub buffers...).
+    Byte-stream-identical to dumps_batch(msgs). Only used with the C
+    codec selected — per-sub protobuf serializes would lose to the
+    one-shot protobuf batch encode."""
+    if eng is None:
+        eng = _native_codec()
+    if eng is None:
+        return [dumps_batch(msgs)]
+    parts: list[bytes] = []
+    inner = 0
+    for m in msgs:
+        sub = encode_frame_parts(m, eng)
+        sub_len = sum(len(p) for p in sub)
+        pre = b"\x0a" + _pb_varint(sub_len)     # BatchFrame.frames key
+        parts.append(pre)
+        parts.extend(sub)
+        inner += len(pre) + sub_len
+    hdr = eng.env_encode_header(WIRE_VERSION, BATCH_TYPE.encode(), 0,
+                                0x32, inner)
+    return [hdr, *parts]
 
 
 def _decode_envelope(env: "pb.Envelope") -> dict:
@@ -221,12 +359,83 @@ def _decode_envelope(env: "pb.Envelope") -> dict:
     return msg
 
 
+def _native_decode_one(eng, data: bytes) -> Optional[dict]:
+    """Decode ONE (non-batch-dispatching) envelope via the C parser.
+    Returns None when the frame needs the full protobuf codec: a
+    structural-plane frame (non-empty `fields`), invalid UTF-8 in
+    `type`, or anything the fast parser flags as irregular."""
+    view = eng.env_decode(data)
+    if view is None:
+        return None
+    _, rid, tbytes, body, fields_len, _, _ = view
+    if body:
+        msg = pickle.loads(body)
+    elif fields_len > 0:
+        return None                  # structural plane: protobuf path
+    else:
+        msg = {}
+    try:
+        msg["type"] = tbytes.decode()
+    except UnicodeDecodeError:
+        return None
+    if rid:
+        msg["rid"] = rid
+    return msg
+
+
+def _native_loads_ex(eng, data: bytes) -> Optional[tuple[dict, int]]:
+    """Native-codec mirror of loads_ex; None defers to protobuf."""
+    view = eng.env_decode(data)
+    if view is None:
+        return None
+    version, rid, tbytes, body, fields_len, batch_off, batch_len = view
+    if version // 100 != WIRE_MAJOR:
+        raise WireVersionError(
+            f"peer wire version {version} is incompatible with "
+            f"ours ({WIRE_VERSION}): major "
+            f"{version // 100} != {WIRE_MAJOR}")
+    try:
+        mtype = tbytes.decode()
+    except UnicodeDecodeError:
+        return None
+    if mtype == BATCH_TYPE:
+        frames: list[dict] = []
+        if batch_off >= 0:
+            spans = eng.batch_split(data, batch_off, batch_len)
+            if spans is None:
+                return None
+            for off, length in spans:
+                sub = _native_decode_one(eng, data[off:off + length])
+                if sub is None:
+                    # mixed batch (structural sub-frame): decode that
+                    # sub with the real protobuf parser
+                    sub = _decode_envelope(pb.Envelope.FromString(
+                        data[off:off + length]))
+                frames.append(sub)
+        return {"type": BATCH_TYPE, "frames": frames}, version
+    if body:
+        msg = pickle.loads(body)
+    elif fields_len > 0:
+        return None                  # structural plane: protobuf path
+    else:
+        msg = {}
+    msg["type"] = mtype
+    if rid:
+        msg["rid"] = rid
+    return msg, version
+
+
 def loads_ex(data: bytes) -> tuple[dict, int]:
     """Decode an Envelope frame body -> (msg, sender wire version);
     refuses foreign major versions before touching any pickled leaf.
     A type=="batch" envelope decodes to
     {"type": "batch", "frames": [msg, ...]} preserving sub-frame
     order."""
+    eng = _native_codec()
+    if eng is not None:
+        out = _native_loads_ex(eng, data)
+        if out is not None:
+            return out
     env = pb.Envelope.FromString(data)
     if env.version // 100 != WIRE_MAJOR:
         raise WireVersionError(

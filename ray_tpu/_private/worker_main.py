@@ -666,8 +666,13 @@ def main() -> None:
     _context.set_ctx(ctx)
     executor = WorkerExecutor(ctx)
     executor_box["exec"] = executor
+    from ray_tpu import native as _native
     conn.send({"type": protocol.REGISTER, "worker_id": args.worker_id,
-               "pid": os.getpid()})
+               "pid": os.getpid(),
+               # which wire engine this worker runs (native frame
+               # pump/codec vs pure Python) — lets the head spot
+               # mixed-mode fleets when debugging perf regressions
+               "wire_native": _native.frame_engine_enabled()})
     executor.stop_event.wait()
     executor.flush_events()
     try:

@@ -1,19 +1,24 @@
 """Native core loader: build-on-first-use C library + ctypes bindings.
 
-`core.c` holds the GIL-free channel wait primitive and the CRC32C used
-by TFRecord IO (see its header comment for the reference parity map).
-The library is compiled once per host with the system C compiler into
+`core.c` holds the GIL-free channel wait primitive, the CRC32C used by
+TFRecord IO, and the r7 wire frame engine (GIL-released socket read
+pump, scatter-gather writev flush, and the hot-path Envelope codec) —
+see its header comment for the reference parity map. The library is
+compiled once per host with the system C compiler into
 ``~/.ray_tpu/native/<source-hash>.so`` (override the cache root with
-``RAY_TPU_RUNTIME_ENV_DIR``'s sibling ``RAY_TPU_NATIVE_DIR``) and
-loaded via ctypes — no pybind11/setuptools dependency, and every
-caller keeps a pure-Python fallback, so a host without a compiler
-still works (``RAY_TPU_DISABLE_NATIVE=1`` forces the fallbacks).
+``RAY_TPU_NATIVE_DIR``; extra build flags via ``RAY_TPU_NATIVE_CFLAGS``,
+used by tools/native_sanity.py for sanitizer builds) and loaded via
+ctypes — no pybind11/setuptools dependency, and every caller keeps a
+pure-Python fallback, so a host without a compiler still works
+(``RAY_TPU_DISABLE_NATIVE=1`` forces the fallbacks; the wire paths
+alone can be disabled with ``RAY_TPU_WIRE_NATIVE=0``).
 """
 from __future__ import annotations
 
 import ctypes
 import hashlib
 import os
+import shlex
 import subprocess
 import sys
 import threading
@@ -33,14 +38,19 @@ def _cache_dir() -> str:
 def _build() -> Optional[str]:
     with open(_SRC, "rb") as f:
         src = f.read()
-    tag = hashlib.sha1(src).hexdigest()[:16]
+    extra = os.environ.get("RAY_TPU_NATIVE_CFLAGS", "")
+    tag = hashlib.sha1(src + extra.encode()).hexdigest()[:16]
     out = os.path.join(_cache_dir(), f"core_{tag}.so")
     if os.path.exists(out):
         return out
     cc = os.environ.get("CC") or "cc"
     os.makedirs(_cache_dir(), exist_ok=True)
     tmp = out + f".tmp{os.getpid()}"
-    cmd = [cc, "-O2", "-shared", "-fPIC", "-o", tmp, _SRC]
+    # -Wall -Werror: the on-demand build is this repo's only compile
+    # gate for core.c, so warnings must fail it loudly rather than ride
+    # silently into every host's cache.
+    cmd = [cc, "-O2", "-Wall", "-Werror", "-shared", "-fPIC",
+           *shlex.split(extra), "-o", tmp, _SRC]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=60)
@@ -58,6 +68,24 @@ def _build() -> Optional[str]:
         import contextlib
         with contextlib.suppress(OSError):
             os.unlink(tmp)              # failure paths leave no litter
+
+
+class _IOVec(ctypes.Structure):
+    _fields_ = [("iov_base", ctypes.c_void_p),
+                ("iov_len", ctypes.c_size_t)]
+
+
+class _EnvView(ctypes.Structure):
+    _fields_ = [("version", ctypes.c_uint32),
+                ("rid", ctypes.c_uint64),
+                ("type_off", ctypes.c_int64),
+                ("type_len", ctypes.c_int64),
+                ("body_off", ctypes.c_int64),
+                ("body_len", ctypes.c_int64),
+                ("fields_off", ctypes.c_int64),
+                ("fields_len", ctypes.c_int64),
+                ("batch_off", ctypes.c_int64),
+                ("batch_len", ctypes.c_int64)]
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -86,12 +114,77 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.rtpu_masked_crc32c.argtypes = [ctypes.c_char_p,
                                            ctypes.c_size_t]
         lib.rtpu_masked_crc32c.restype = ctypes.c_uint32
+        # ---- frame engine ----
+        lib.rtpu_reader_new.argtypes = [ctypes.c_uint64]
+        lib.rtpu_reader_new.restype = ctypes.c_void_p
+        lib.rtpu_reader_free.argtypes = [ctypes.c_void_p]
+        lib.rtpu_reader_free.restype = None
+        lib.rtpu_reader_pump.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rtpu_reader_pump.restype = ctypes.c_long
+        lib.rtpu_reader_next.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_uint64)]
+        lib.rtpu_reader_next.restype = ctypes.c_void_p
+        lib.rtpu_writev_full.argtypes = [ctypes.c_int,
+                                         ctypes.POINTER(_IOVec),
+                                         ctypes.c_long]
+        lib.rtpu_writev_full.restype = ctypes.c_long
+        lib.rtpu_env_decode.argtypes = [ctypes.c_char_p,
+                                        ctypes.c_uint64,
+                                        ctypes.POINTER(_EnvView)]
+        lib.rtpu_env_decode.restype = ctypes.c_int
+        lib.rtpu_batch_split.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_long]
+        lib.rtpu_batch_split.restype = ctypes.c_long
+        lib.rtpu_env_encode.argtypes = [
+            ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64]
+        lib.rtpu_env_encode.restype = ctypes.c_long
+        lib.rtpu_env_encode_header.argtypes = [
+            ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64]
+        lib.rtpu_env_encode_header.restype = ctypes.c_long
+        lib.rtpu_batch_encode.argtypes = [
+            ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_long,
+            ctypes.c_char_p, ctypes.c_uint64]
+        lib.rtpu_batch_encode.restype = ctypes.c_long
         _lib = lib
     return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+_engine_memo: tuple = (-1, False)
+_config = None
+
+
+def frame_engine_enabled() -> bool:
+    """Whether the wire hot path (read pump / writev flush / envelope
+    codec) should go native: the library is loadable AND neither
+    RAY_TPU_DISABLE_NATIVE nor RAY_TPU_WIRE_NATIVE=0 is set. Memoized
+    per CONFIG generation — this runs several times per frame, so it
+    must cost a dict hit, not env lookups; tests and bench A/B runs
+    flip modes in-process with env var + CONFIG.reload()."""
+    global _engine_memo, _config
+    cfg = _config
+    if cfg is None:
+        from ray_tpu._private.config import CONFIG
+        cfg = _config = CONFIG
+    gen = cfg._gen
+    memo = _engine_memo
+    if memo[0] == gen:
+        return memo[1]
+    on = (not os.environ.get("RAY_TPU_DISABLE_NATIVE")
+          and bool(cfg.wire_native) and _load() is not None)
+    _engine_memo = (gen, on)
+    return on
 
 
 def wait_u64s_ge(mv: memoryview, offset: int, count: int, value: int,
@@ -116,3 +209,167 @@ def masked_crc32c(data: bytes) -> int:
     lib = _load()
     assert lib is not None
     return int(lib.rtpu_masked_crc32c(data, len(data)))
+
+
+# ======================= frame engine bindings =======================
+
+class PumpClosed(Exception):
+    """Read pump hit EOF: the peer closed the stream."""
+
+
+class PumpOversized(Exception):
+    """A frame's length prefix exceeds the max-frame sanity bound:
+    corrupt (or hostile) stream."""
+
+
+class FrameReader:
+    """Per-connection GIL-released read pump over a dup of the socket
+    fd. The dup pins the open file description so a concurrent
+    ``Connection.close()`` (shutdown + close of the original fd) wakes
+    the blocked read with EOF instead of racing fd reuse; the dup is
+    closed here, by the owning reader thread, on exit."""
+
+    def __init__(self, fd: int, max_frame: int):
+        lib = _load()
+        assert lib is not None, "check frame_engine_enabled() first"
+        self._lib = lib
+        self._fd = os.dup(fd)
+        self._handle = lib.rtpu_reader_new(max(0, int(max_frame)))
+        if not self._handle:
+            os.close(self._fd)
+            raise MemoryError("rtpu_reader_new failed")
+
+    def pump(self) -> list[bytes]:
+        """Block (GIL released) until at least one complete frame is
+        buffered; returns all complete frame bodies. Raises PumpClosed
+        on EOF, PumpOversized on a corrupt length prefix, OSError on a
+        read error."""
+        n = self._lib.rtpu_reader_pump(self._handle, self._fd)
+        if n > 0:
+            frames = []
+            length = ctypes.c_uint64()
+            while True:
+                ptr = self._lib.rtpu_reader_next(
+                    self._handle, ctypes.byref(length))
+                if not ptr:
+                    break
+                frames.append(ctypes.string_at(ptr, length.value))
+            return frames
+        if n == 0:
+            raise PumpClosed("peer closed")
+        if n == -2:
+            raise PumpOversized(
+                "frame length prefix exceeds wire_max_frame_bytes")
+        raise OSError("native frame read failed")
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.rtpu_reader_free(self._handle)
+            self._handle = None
+        if self._fd >= 0:
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = -1
+
+
+def writev_all(fd: int, bufs: list[bytes]) -> None:
+    """Write every buffer to the RAW fd as one scatter-gather flush
+    (GIL released; partial writes and EINTR handled in C). Raises
+    OSError with the failing errno — EAGAIN means the fd's SO_SNDTIMEO
+    budget expired mid-write (stream desynced, kill the connection).
+    Caller owns the fd's lifetime for the duration of the call: for
+    sockets shared across threads prefer ``sock.sendmsg`` (as
+    protocol._sendmsg_all does) — a raw fd captured before a
+    concurrent close() can be reused by an unrelated connection."""
+    lib = _load()
+    assert lib is not None
+    n = len(bufs)
+    iov = (_IOVec * n)()
+    for i, b in enumerate(bufs):
+        iov[i].iov_base = ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
+        iov[i].iov_len = len(b)
+    rc = lib.rtpu_writev_full(fd, iov, n)
+    if rc != 0:
+        raise OSError(int(-rc), os.strerror(int(-rc)))
+
+
+def env_encode(version: int, mtype: bytes, rid: int,
+               body: bytes) -> bytes:
+    """Serialize a Python-plane Envelope (header + opaque py_body)."""
+    lib = _load()
+    cap = 40 + len(mtype) + len(body)
+    out = ctypes.create_string_buffer(cap)
+    n = lib.rtpu_env_encode(version, mtype, len(mtype), rid,
+                            body, len(body), out, cap)
+    assert n >= 0, "env_encode capacity bound violated"
+    return ctypes.string_at(out, n)
+
+
+def env_encode_header(version: int, mtype: bytes, rid: int,
+                      last_tag: int, payload_len: int) -> bytes:
+    """Envelope header bytes only: the payload (py_body pickle /
+    batch interior) is announced via last_tag (0x2a / 0x32; 0 = none)
+    + payload_len but NOT copied — the emit path sends it as its own
+    writev iovec straight from the object that produced it."""
+    lib = _load()
+    cap = 64 + len(mtype)
+    out = ctypes.create_string_buffer(cap)
+    n = lib.rtpu_env_encode_header(version, mtype, len(mtype), rid,
+                                   last_tag, payload_len, out, cap)
+    assert n >= 0, "env_encode_header capacity bound violated"
+    return ctypes.string_at(out, n)
+
+
+def env_decode(data: bytes):
+    """Parse the top-level Envelope fields of `data`. Returns
+    ``(version, rid, type_bytes, body_bytes|None, fields_len,
+    batch_off, batch_len)`` with fields_len = -1 / batch_off = -1 when
+    absent, or None when the fast parser can't handle the input (the
+    caller falls back to the real protobuf codec)."""
+    lib = _load()
+    view = _EnvView()
+    if lib.rtpu_env_decode(data, len(data), ctypes.byref(view)) != 0:
+        return None
+    mtype = (data[view.type_off:view.type_off + view.type_len]
+             if view.type_off >= 0 else b"")
+    body = (data[view.body_off:view.body_off + view.body_len]
+            if view.body_off >= 0 else None)
+    return (view.version, view.rid, mtype, body,
+            view.fields_len if view.fields_off >= 0 else -1,
+            view.batch_off, view.batch_len)
+
+
+def batch_split(data: bytes, off: int, length: int):
+    """Split the BatchFrame submessage at data[off:off+length] into
+    absolute (offset, length) sub-Envelope views, or None on malformed
+    input."""
+    lib = _load()
+    batch = data[off:off + length]
+    cap = 128
+    while True:
+        offs = (ctypes.c_uint64 * cap)()
+        lens = (ctypes.c_uint64 * cap)()
+        n = lib.rtpu_batch_split(batch, length, offs, lens, cap)
+        if n < 0:
+            return None
+        if n <= cap:
+            return [(off + offs[i], lens[i]) for i in range(n)]
+        cap = n
+
+
+def batch_encode(version: int, mtype: bytes,
+                 subs: list[bytes]) -> bytes:
+    """Assemble one BatchFrame Envelope from pre-serialized sub-
+    Envelope buffers."""
+    lib = _load()
+    n = len(subs)
+    ptrs = (ctypes.c_char_p * n)(*subs)
+    lens = (ctypes.c_uint64 * n)(*[len(s) for s in subs])
+    cap = 40 + len(mtype) + sum(len(s) + 11 for s in subs)
+    out = ctypes.create_string_buffer(cap)
+    written = lib.rtpu_batch_encode(version, mtype, len(mtype),
+                                    ptrs, lens, n, out, cap)
+    assert written >= 0, "batch_encode capacity bound violated"
+    return ctypes.string_at(out, written)

@@ -27,6 +27,39 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(params=["native", "python"])
+def wire_engine_mode(request):
+    """Run a test under BOTH wire engines: the r7 native frame engine
+    (C read pump / writev / envelope codec, codec force-enabled so the
+    C paths are exercised even on C-protobuf hosts where 'auto' would
+    defer) and the pure-Python paths (RAY_TPU_WIRE_NATIVE=0). Opt-in
+    per test/file — wire-contract suites also attach it autouse."""
+    import os
+
+    from ray_tpu import native
+    from ray_tpu._private.config import CONFIG
+
+    if request.param == "native" and not native.available():
+        pytest.skip("no C compiler: native frame engine unavailable")
+    prev = {k: os.environ.get(k) for k in
+            ("RAY_TPU_WIRE_NATIVE", "RAY_TPU_WIRE_NATIVE_CODEC")}
+    if request.param == "native":
+        os.environ["RAY_TPU_WIRE_NATIVE"] = "1"
+        os.environ["RAY_TPU_WIRE_NATIVE_CODEC"] = "1"
+    else:
+        os.environ["RAY_TPU_WIRE_NATIVE"] = "0"
+    CONFIG.reload()
+    try:
+        yield request.param
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        CONFIG.reload()
+
+
 @pytest.fixture()
 def ray_cluster():
     """Shared runtime: reuses a live runtime if present, (re)creates one

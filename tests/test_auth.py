@@ -36,9 +36,15 @@ def test_auth_token_gates_listener(tmp_path):
             try:
                 for fr in first_frames:
                     s.sendall(LEN.pack(len(fr)) + fr)
-                # server must close without replying
+                # server must close without replying. A clean FIN
+                # (recv -> b"") and an RST (ConnectionResetError) are
+                # BOTH rejection: the server closes with our trailing
+                # frame still unread, so the kernel may reset — which
+                # race wins depends on box load (this was a flake).
                 try:
                     data = s.recv(1024)
+                except ConnectionResetError:
+                    return True           # reset == refused, no data
                 except (TimeoutError, OSError):
                     return False          # no close, no data: fail
                 return data == b""        # clean close == rejected

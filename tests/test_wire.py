@@ -4,6 +4,7 @@ Parity: the reference pins its wire in src/ray/protobuf/*.proto; here
 the contract is ray_tpu/protos/wire.proto + the codec policy in
 _private/wire.py (structural node plane, pickled Python plane).
 """
+import os
 import socket
 import struct
 import threading
@@ -13,6 +14,16 @@ import pytest
 
 from ray_tpu._private import protocol, wire
 from ray_tpu._private import wire_pb2 as pb
+
+
+@pytest.fixture(autouse=True)
+def _wire_mode_autouse(wire_engine_mode):
+    """Every wire-contract test runs under BOTH engines (the shared
+    conftest `wire_engine_mode` fixture): the r7 native frame engine
+    and the pure-Python protobuf paths. The contract — bytes on the
+    wire AND decoded messages — must be indistinguishable; the two
+    modes interoperate on one connection in production."""
+    yield
 
 
 # ------------------------------------------------------------- codec

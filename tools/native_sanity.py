@@ -1,0 +1,146 @@
+"""Sanitizer gate for ray_tpu/native/core.c.
+
+Usage:
+    python tools/native_sanity.py [--keep] [--no-pytest]
+
+Rebuilds the native core with ``-fsanitize=undefined,address`` and runs
+it two ways:
+
+1. C harness (tools/native_sanity_check.c, compiled together with
+   core.c): reader pump against a forked dribbling writer, oversized
+   rejection, writev past IOV_MAX, envelope/batch codec roundtrips —
+   buffer-math bugs abort with a sanitizer report instead of shipping.
+2. Best effort: the native pytest subset (tests/test_native.py,
+   tests/test_native_frame.py, tests/test_wire.py) against a sanitized
+   .so, via ``RAY_TPU_NATIVE_CFLAGS`` + a scratch ``RAY_TPU_NATIVE_DIR``
+   and LD_PRELOADed libasan. Skipped (cleanly) when libasan can't be
+   preloaded under this Python.
+
+Exits 0 with a SKIP message when the compiler lacks sanitizer support
+(so CI on minimal images stays green), 1 on any real failure.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORE = os.path.join(REPO, "ray_tpu", "native", "core.c")
+HARNESS = os.path.join(REPO, "tools", "native_sanity_check.c")
+SAN_FLAGS = ["-fsanitize=undefined,address", "-fno-sanitize-recover=all",
+             "-g", "-O1"]
+
+
+def _cc() -> str:
+    return os.environ.get("CC") or "cc"
+
+
+def _sanitizers_supported(tmp: str) -> bool:
+    probe = os.path.join(tmp, "probe.c")
+    with open(probe, "w") as f:
+        f.write("int main(void){return 0;}\n")
+    r = subprocess.run(
+        [_cc(), *SAN_FLAGS, "-o", os.path.join(tmp, "probe"), probe],
+        capture_output=True, text=True, timeout=60)
+    return r.returncode == 0
+
+
+def run_harness(tmp: str) -> bool:
+    exe = os.path.join(tmp, "sanity_check")
+    build = subprocess.run(
+        [_cc(), "-Wall", "-Werror", *SAN_FLAGS, "-o", exe,
+         HARNESS, CORE],
+        capture_output=True, text=True, timeout=120)
+    if build.returncode != 0:
+        print(f"FAIL: harness build:\n{build.stderr}")
+        return False
+    run = subprocess.run([exe], capture_output=True, text=True,
+                         timeout=300,
+                         env={**os.environ,
+                              "ASAN_OPTIONS": "detect_leaks=1"})
+    sys.stderr.write(run.stderr)
+    if run.returncode != 0:
+        print("FAIL: sanitized C harness (see report above)")
+        return False
+    print("ok: C harness clean under UBSan+ASan")
+    return True
+
+
+def _find_libasan() -> str | None:
+    r = subprocess.run([_cc(), "-print-file-name=libasan.so"],
+                       capture_output=True, text=True, timeout=30)
+    path = r.stdout.strip()
+    if r.returncode == 0 and path and os.path.sep in path \
+            and os.path.exists(path):
+        return path
+    return None
+
+
+def run_pytest_subset(tmp: str) -> bool | None:
+    """True/False = ran and passed/failed; None = skipped cleanly."""
+    libasan = _find_libasan()
+    if libasan is None:
+        print("skip: libasan.so not found; pytest-under-ASan stage "
+              "skipped")
+        return None
+    env = {
+        **os.environ,
+        "RAY_TPU_NATIVE_DIR": os.path.join(tmp, "native-cache"),
+        "RAY_TPU_NATIVE_CFLAGS": " ".join(SAN_FLAGS),
+        "LD_PRELOAD": libasan,
+        # Python itself leaks by ASan's standards; intercept only the
+        # native lib's real bugs. halt_on_error keeps failures loud.
+        "ASAN_OPTIONS": "detect_leaks=0:halt_on_error=1",
+        "JAX_PLATFORMS": "cpu",
+    }
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "from ray_tpu import native; assert native.available()"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    if probe.returncode != 0:
+        print("skip: this Python cannot run under LD_PRELOADed "
+              f"libasan ({probe.stderr.strip().splitlines()[-1:]}); "
+              "pytest-under-ASan stage skipped")
+        return None
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         "tests/test_native.py", "tests/test_native_frame.py",
+         "tests/test_wire.py"],
+        timeout=1200, env=env, cwd=REPO)
+    if r.returncode != 0:
+        print("FAIL: native test subset under sanitizers")
+        return False
+    print("ok: native test subset clean under ASan")
+    return True
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="native_sanity")
+    p.add_argument("--keep", action="store_true",
+                   help="keep the scratch build directory")
+    p.add_argument("--no-pytest", action="store_true",
+                   help="only run the C harness stage")
+    args = p.parse_args(argv)
+    tmp = tempfile.mkdtemp(prefix="rtpu-native-sanity-")
+    try:
+        if not _sanitizers_supported(tmp):
+            print("SKIP: compiler lacks -fsanitize=undefined,address "
+                  "support; nothing to check")
+            return 0
+        ok = run_harness(tmp)
+        if ok and not args.no_pytest:
+            ok = run_pytest_subset(tmp) is not False
+        return 0 if ok else 1
+    finally:
+        if args.keep:
+            print(f"scratch dir kept: {tmp}")
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,239 @@
+/* Sanitizer harness for ray_tpu/native/core.c (driven by
+ * tools/native_sanity.py): compiled TOGETHER with core.c under
+ * -fsanitize=undefined,address and exercised over the same shapes the
+ * Python tests use — reader pump against a dribbling writer (torn
+ * frames, EINTR-free fork/pipe), oversized rejection, EOF, writev
+ * past IOV_MAX, envelope encode/decode with unknown fields, batch
+ * encode/split — so buffer math bugs in the frame engine surface as
+ * sanitizer aborts, not as production memory corruption. */
+#include <assert.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+/* core.c exports */
+typedef struct rtpu_reader rtpu_reader;
+rtpu_reader *rtpu_reader_new(uint64_t max_frame);
+void rtpu_reader_free(rtpu_reader *r);
+long rtpu_reader_pump(rtpu_reader *r, int fd);
+const uint8_t *rtpu_reader_next(rtpu_reader *r, uint64_t *len_out);
+long rtpu_writev_full(int fd, struct iovec *iov, long cnt);
+typedef struct {
+    uint32_t version;
+    uint64_t rid;
+    int64_t type_off, type_len;
+    int64_t body_off, body_len;
+    int64_t fields_off, fields_len;
+    int64_t batch_off, batch_len;
+} rtpu_env_view;
+int rtpu_env_decode(const uint8_t *buf, uint64_t len, rtpu_env_view *v);
+long rtpu_batch_split(const uint8_t *buf, uint64_t len,
+                      uint64_t *offs, uint64_t *lens, long max);
+long rtpu_env_encode(uint32_t version, const uint8_t *type,
+                     uint64_t type_len, uint64_t rid,
+                     const uint8_t *body, uint64_t body_len,
+                     uint8_t *out, uint64_t cap);
+long rtpu_batch_encode(uint32_t version, const uint8_t *type,
+                       uint64_t type_len, const uint8_t *const *subs,
+                       const uint64_t *sub_lens, long n,
+                       uint8_t *out, uint64_t cap);
+uint32_t rtpu_crc32c(const uint8_t *buf, size_t len);
+
+static void put_u64le(uint8_t *p, uint64_t v) {
+    for (int i = 0; i < 8; i++)
+        p[i] = (uint8_t)(v >> (8 * i));
+}
+
+static void check_reader(void) {
+    int fds[2];
+    assert(pipe(fds) == 0);
+    /* three frames: "alpha", 70000 x 'B' (forces buffer growth past
+     * the 64 KiB initial capacity), "c" — dribbled in 7-byte chunks
+     * by a forked writer so the reader sees torn boundaries */
+    size_t blen = 70000;
+    uint8_t *payload = malloc(8 + 5 + 8 + blen + 8 + 1);
+    size_t off = 0;
+    put_u64le(payload + off, 5);
+    memcpy(payload + off + 8, "alpha", 5);
+    off += 13;
+    put_u64le(payload + off, blen);
+    memset(payload + off + 8, 'B', blen);
+    off += 8 + blen;
+    put_u64le(payload + off, 1);
+    payload[off + 8] = 'c';
+    off += 9;
+
+    pid_t pid = fork();
+    assert(pid >= 0);
+    if (pid == 0) {
+        close(fds[0]);
+        for (size_t i = 0; i < off; i += 4096) {
+            size_t n = off - i < 4096 ? off - i : 4096;
+            assert(write(fds[1], payload + i, n) == (ssize_t)n);
+            usleep(500);
+        }
+        close(fds[1]);
+        _exit(0);
+    }
+    close(fds[1]);
+    rtpu_reader *r = rtpu_reader_new(1 << 20);
+    assert(r);
+    uint64_t len;
+    const uint8_t *f;
+    int got = 0;
+    for (;;) {
+        long n = rtpu_reader_pump(r, fds[0]);
+        if (n == 0)
+            break;                              /* EOF */
+        assert(n > 0);
+        while ((f = rtpu_reader_next(r, &len)) != NULL) {
+            if (got == 0)
+                assert(len == 5 && memcmp(f, "alpha", 5) == 0);
+            else if (got == 1) {
+                assert(len == blen);
+                for (uint64_t i = 0; i < len; i++)
+                    assert(f[i] == 'B');
+            } else
+                assert(len == 1 && f[0] == 'c');
+            got++;
+        }
+    }
+    assert(got == 3);
+    rtpu_reader_free(r);
+    close(fds[0]);
+    free(payload);
+    int st;
+    waitpid(pid, &st, 0);
+
+    /* oversized length prefix: reject before any allocation */
+    assert(pipe(fds) == 0);
+    uint8_t hdr[8];
+    put_u64le(hdr, (uint64_t)1 << 40);
+    assert(write(fds[1], hdr, 8) == 8);
+    r = rtpu_reader_new(1 << 20);
+    assert(rtpu_reader_pump(r, fds[0]) == -2);
+    rtpu_reader_free(r);
+    close(fds[0]);
+    close(fds[1]);
+    fprintf(stderr, "reader ok\n");
+}
+
+static void check_writev(void) {
+    int sv[2];
+    assert(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+    /* 3000 iovecs (past the 1024 chunk) totalling ~3 MB, drained by a
+     * forked reader so partial writes happen */
+    long cnt = 3000;
+    struct iovec *iov = calloc(cnt, sizeof *iov);
+    size_t total = 0;
+    for (long i = 0; i < cnt; i++) {
+        size_t n = (size_t)(i % 2048) + 1;
+        iov[i].iov_base = malloc(n);
+        memset(iov[i].iov_base, (int)(i & 0xff), n);
+        iov[i].iov_len = n;
+        total += n;
+    }
+    pid_t pid = fork();
+    assert(pid >= 0);
+    if (pid == 0) {
+        close(sv[0]);
+        size_t seen = 0;
+        uint8_t buf[65536];
+        ssize_t n;
+        while ((n = read(sv[1], buf, sizeof buf)) > 0)
+            seen += (size_t)n;
+        _exit(seen == total ? 0 : 1);
+    }
+    close(sv[1]);
+    assert(rtpu_writev_full(sv[0], iov, cnt) == 0);
+    close(sv[0]);
+    int st;
+    waitpid(pid, &st, 0);
+    assert(WIFEXITED(st) && WEXITSTATUS(st) == 0);
+    for (long i = 0; i < cnt; i++)
+        free(iov[i].iov_base);
+    free(iov);
+    fprintf(stderr, "writev ok\n");
+}
+
+static void check_codec(void) {
+    uint8_t out[4096];
+    long n = rtpu_env_encode(101, (const uint8_t *)"task_done", 9,
+                             12345, (const uint8_t *)"BODYBYTES", 9,
+                             out, sizeof out);
+    assert(n > 0);
+    rtpu_env_view v;
+    assert(rtpu_env_decode(out, (uint64_t)n, &v) == 0);
+    assert(v.version == 101 && v.rid == 12345);
+    assert(v.type_len == 9
+           && memcmp(out + v.type_off, "task_done", 9) == 0);
+    assert(v.body_len == 9
+           && memcmp(out + v.body_off, "BODYBYTES", 9) == 0);
+    assert(v.fields_off == -1 && v.batch_off == -1);
+
+    /* unknown trailing fields (future MINORs) are skipped */
+    uint8_t ext[4120];
+    memcpy(ext, out, (size_t)n);
+    const uint8_t extra[] = {0x38, 0x05, 0x7a, 0x03, 'a', 'b', 'c'};
+    memcpy(ext + n, extra, sizeof extra);
+    assert(rtpu_env_decode(ext, (uint64_t)n + sizeof extra, &v) == 0);
+    assert(v.version == 101 && v.type_len == 9);
+
+    /* truncated varint and short buffers must fail, not overread */
+    const uint8_t trunc[] = {0x08, 0x80};
+    assert(rtpu_env_decode(trunc, 2, &v) == -1);
+    const uint8_t shortlen[] = {0x2a, 0x20, 'x'};
+    assert(rtpu_env_decode(shortlen, 3, &v) == -1);
+
+    /* batch encode -> split roundtrip, past a small first-pass cap */
+    enum { NSUB = 300 };
+    const uint8_t *subs[NSUB];
+    uint64_t sub_lens[NSUB];
+    uint8_t sub[64];
+    long sn = rtpu_env_encode(101, (const uint8_t *)"ping", 4, 7,
+                              NULL, 0, sub, sizeof sub);
+    assert(sn > 0);
+    for (int i = 0; i < NSUB; i++) {
+        subs[i] = sub;
+        sub_lens[i] = (uint64_t)sn;
+    }
+    size_t cap = 64 + NSUB * ((size_t)sn + 11);
+    uint8_t *batch = malloc(cap);
+    long bn = rtpu_batch_encode(101, (const uint8_t *)"batch", 5,
+                                subs, sub_lens, NSUB, batch, cap);
+    assert(bn > 0);
+    assert(rtpu_env_decode(batch, (uint64_t)bn, &v) == 0);
+    assert(v.batch_off >= 0);
+    uint64_t offs[8], lens[8];                  /* deliberately small */
+    long total = rtpu_batch_split(batch + v.batch_off,
+                                  (uint64_t)v.batch_len, offs, lens, 8);
+    assert(total == NSUB);
+    uint64_t *offs2 = calloc(total, sizeof *offs2);
+    uint64_t *lens2 = calloc(total, sizeof *lens2);
+    assert(rtpu_batch_split(batch + v.batch_off, (uint64_t)v.batch_len,
+                            offs2, lens2, total) == NSUB);
+    for (long i = 0; i < total; i++) {
+        assert(lens2[i] == (uint64_t)sn);
+        assert(memcmp(batch + v.batch_off + offs2[i], sub,
+                      (size_t)sn) == 0);
+    }
+    free(offs2);
+    free(lens2);
+    free(batch);
+
+    assert(rtpu_crc32c((const uint8_t *)"123456789", 9) == 0xE3069283u);
+    fprintf(stderr, "codec ok\n");
+}
+
+int main(void) {
+    check_codec();
+    check_reader();
+    check_writev();
+    fprintf(stderr, "native_sanity_check: ALL OK\n");
+    return 0;
+}

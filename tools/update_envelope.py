@@ -26,6 +26,11 @@ END = "<!-- bench:latest:end -->"
 # scenario key -> human row label (table order follows this list; keys
 # absent from the JSON are skipped, unknown keys are appended as-is)
 LABELS = [
+    ("wire_codec_native", "wire codec, C forced (encode+decode µs)"),
+    ("wire_codec_python",
+     "wire codec, protobuf backend (encode+decode µs)"),
+    ("drain_5k_nonative", "5k drain, RAY_TPU_DISABLE_NATIVE=1"),
+    ("drain_5k_native", "5k drain, native frame engine"),
     ("tasks_sync_per_s", "tasks, sync round-trip"),
     ("tasks_batch_per_s", "tasks, batched"),
     ("actor_calls_sync_per_s", "actor calls, sync"),
@@ -54,15 +59,24 @@ def _fmt_result(rec: dict) -> str:
             out += f" (pool speedup {rec['pool_speedup']}x)"
         if "channel_speedup" in rec:
             out += f" (channel speedup {rec['channel_speedup']}x)"
+        if "native_speedup" in rec:
+            out += f" (native speedup {rec['native_speedup']}x)"
         return out
-    extras = {k: v for k, v in rec.items() if k not in ("n", "unit")}
+    extras = {k: v for k, v in rec.items()
+              if k not in ("n", "unit", "frames_per_task",
+                           "head_cpu_us_per_task")}
     return ", ".join(f"{k}={v}" for k, v in extras.items())
 
 
 def _fmt_frames(rec: dict) -> str:
+    """The r6 frames/task counter, joined with the r7 head-CPU µs/task
+    timer when the scenario records one."""
+    parts = []
     if "frames_per_task" in rec:
-        return str(rec["frames_per_task"])
-    return "—"
+        parts.append(str(rec["frames_per_task"]))
+    if "head_cpu_us_per_task" in rec:
+        parts.append(f"{rec['head_cpu_us_per_task']} µs")
+    return " · ".join(parts) if parts else "—"
 
 
 def render_block(results: dict) -> str:
@@ -74,7 +88,7 @@ def render_block(results: dict) -> str:
     lines = [BEGIN,
              "### Latest `bench_core.py` run (machine-generated)",
              "",
-             "| Scenario | Result | frames/task |",
+             "| Scenario | Result | frames/task · head-CPU/task |",
              "|---|---|---|"]
     for label, rec in rows:
         lines.append(f"| {label} | {_fmt_result(rec)} | "

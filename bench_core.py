@@ -135,11 +135,67 @@ def _codec_bench() -> dict:
     return out
 
 
+def _broadcast_bench(n_nodes: int = 8, mb: int = 64) -> dict:
+    """Tree vs all-pull-from-source A/B (r8 object plane): one `mb`-MB
+    object distributed to `n_nodes` real agent subprocesses. `flat`
+    fans every node directly off the source (the pre-tree topology);
+    `tree` runs the fanout cascade — the source serves <= fanout
+    transfers and completed pullers serve their subtrees. Aggregate
+    GB/s counts every delivered copy."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import NodeAgentProcess
+    from ray_tpu._private.config import CONFIG
+    CONFIG.reload()
+    rt = ray_tpu.init(num_cpus=2)
+    agents = [NodeAgentProcess(num_cpus=1) for _ in range(n_nodes)]
+    out: dict = {}
+    try:
+        deadline = time.time() + 180
+        while (time.time() < deadline
+               and len(rt.cluster.alive_nodes()) < n_nodes + 1):
+            time.sleep(0.2)
+        joined = len(rt.cluster.alive_nodes()) - 1
+        payload = np.arange(mb * 1024 * 1024 // 8, dtype=np.float64)
+        for name, fanout in (("flat", max(64, joined)), ("tree", 2)):
+            ref = ray_tpu.put(payload * (1.0 if name == "flat" else 2.0))
+            t0 = time.perf_counter()
+            st = rt.broadcast_object(ref.object_id, fanout=fanout,
+                                     timeout=600)
+            dt = time.perf_counter() - t0
+            src_serves = rt._pull_server.serves_per_object().get(
+                ref.object_id, 0)
+            gb = st["nbytes"] * st["completed"] / 2 ** 30
+            out[f"bcast_{mb}mb_{name}"] = {
+                "n": st["completed"], "unit": "GB",
+                "seconds": round(dt, 4),
+                "per_second": round(gb / dt, 3),
+                "fanout": fanout, "depth": st["depth"],
+                "source_serves": src_serves,
+                "failed": len(st["failed"])}
+            del ref                      # free agent copies before B run
+            time.sleep(1.0)
+        flat = out[f"bcast_{mb}mb_flat"]
+        tree = out[f"bcast_{mb}mb_tree"]
+        if flat["per_second"]:
+            tree["tree_speedup"] = round(
+                tree["per_second"] / flat["per_second"], 2)
+    finally:
+        for a in agents:
+            a.terminate()
+        for a in agents:
+            a.wait(10)
+        ray_tpu.shutdown()
+    return out
+
+
 def main(as_json: bool = False) -> dict:
     results: dict = {}
 
     # ----------------------- wire codec: native vs pure Python (r7)
     results.update(_codec_bench())
+
+    # ------- object plane: broadcast tree vs all-pull-from-source (r8)
+    results.update(_broadcast_bench())
 
     # ------------- native frame engine: 5k drain A/B (r7)
     # Back-to-back fresh runtimes, same box, same tree — the OFF run

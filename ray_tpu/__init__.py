@@ -14,8 +14,8 @@ from ray_tpu._private.refs import ObjectRef  # noqa: F401
 from ray_tpu._private.runtime import init, shutdown  # noqa: F401
 from ray_tpu.actor import ActorClass, ActorHandle  # noqa: F401
 from ray_tpu.api import (cancel, available_resources,  # noqa: F401
-                         cluster_resources, get, get_actor, kill, method,
-                         put, remote, wait)
+                         broadcast, cluster_resources, get, get_actor,
+                         kill, method, put, remote, wait)
 from ray_tpu import exceptions  # noqa: F401
 
 
@@ -25,7 +25,7 @@ def is_initialized() -> bool:
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "method", "get", "put",
-    "wait", "kill", "cancel", "get_actor", "cluster_resources",
-    "available_resources", "ObjectRef", "ActorClass", "ActorHandle",
-    "exceptions", "__version__",
+    "wait", "kill", "cancel", "broadcast", "get_actor",
+    "cluster_resources", "available_resources", "ObjectRef", "ActorClass",
+    "ActorHandle", "exceptions", "__version__",
 ]

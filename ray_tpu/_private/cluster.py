@@ -348,6 +348,28 @@ class ClusterTaskManager:
                     _eff(n), n.scheduler.total)
             return u
 
+        # Locality phase (reference locality-aware hybrid policy:
+        # scheduling prefers nodes already holding the task's argument
+        # bytes): consult the cluster object directory for where the
+        # spec's pinned refs live, and take the best-scoring feasible
+        # node if it can run the task NOW. Directory misses (inline
+        # args, single-node, head-resident objects) cost one empty-dict
+        # check.
+        pinned = getattr(spec, "pinned_refs", None)
+        if pinned and _CFG.scheduler_locality:
+            ctrl = getattr(self._rt, "controller", None)
+            directory = getattr(ctrl, "directory", None) if ctrl else None
+            if directory is not None and not directory.empty():
+                scores = directory.locality_bytes(
+                    pinned, [n.node_id for n in feasible])
+                if scores:
+                    local = [n for n in feasible
+                             if scores.get(n.node_id)]
+                    local.sort(key=lambda n: -scores[n.node_id])
+                    for n in local:
+                        if fits(_eff(n), need):
+                            return n
+
         # Pack phase: first node (stable order) with enough room now and
         # below the utilization threshold (both incl. queued demand).
         for n in feasible:

@@ -183,6 +183,44 @@ _define("node_rejoin_grace_s", 20.0,
         "After a head restart, how long rehydrated nodes have to "
         "re-register before they are declared dead and their actors/"
         "objects recovered.")
+_define("pull_concurrency", 4,
+        "Max concurrent object transfers a pull manager runs per "
+        "process (reference pull_manager.cc active-pull bound); "
+        "excess pulls queue. Requests for an object already in "
+        "flight dedup onto the existing transfer regardless.")
+_define("pull_max_inflight_bytes", 256 * 1024 * 1024,
+        "Byte budget for in-flight pulled objects per pull manager "
+        "(reference pull_manager.cc num_bytes_available_): a pull "
+        "whose size would exceed it waits for running transfers to "
+        "land. A single object larger than the budget is admitted "
+        "alone. 0 = unbounded.")
+_define("pull_pipeline_depth", 4,
+        "Chunk requests a puller keeps in flight per transfer "
+        "(reference object_buffer_pool chunked reads are windowed the "
+        "same way): 1 restores strict request/reply lockstep, which "
+        "makes every transfer latency-bound.")
+_define("pull_chunk_retries", 2,
+        "Per-pull retries after a dropped/expired chunk: the puller "
+        "re-opens a session with the holder and resumes from the "
+        "failed chunk index before giving up on that source.")
+_define("pull_session_ttl_s", 120.0,
+        "Pull-session idle TTL on the serving side: sessions a dead "
+        "puller abandoned are reaped on the next pull/chunk message "
+        "(lazy sweep) and on the puller's connection close, "
+        "releasing the materialized blob and the object pin.")
+_define("bcast_fanout", 4,
+        "Tree-broadcast fanout: each node that completes its copy "
+        "serves at most this many children, so the source serves "
+        "<= fanout transfers instead of N (reference object-manager "
+        "push parity for the 1 GiB x 50-node envelope row).")
+_define("bcast_timeout_s", 120.0,
+        "Per-broadcast deadline: nodes still missing the object when "
+        "it expires are reported as failed in the broadcast result.")
+_define("scheduler_locality", True,
+        "Locality-aware node selection: prefer placing a task on a "
+        "feasible node already holding the most argument bytes "
+        "(object-directory lookup; reference locality_task_spreading "
+        "hybrid-policy input). 0 restores pure pack/spread.")
 
 
 class _Config:

@@ -75,11 +75,13 @@ class Controller:
         self._pins: dict[str, int] = collections.defaultdict(int)
         self._pgs: dict[str, dict] = {}
         self._nodes: dict[str, NodeTableRecord] = {}
-        # Object directory: object_id -> {node_id} holding a copy
-        # (reference ownership_based_object_directory.cc role; here the
-        # head IS the owner of record for every object).
-        self._locations: dict[str, set[str]] = {}
-        self._location_nbytes: dict[str, int] = {}
+        # Cluster object directory: object_id -> {node_id} holding a
+        # copy (reference ownership_based_object_directory.cc role; the
+        # head IS the owner of record for every object). Extracted to
+        # its own subsystem so getters, the scheduler locality hint,
+        # and the broadcast coordinator share one location service.
+        from ray_tpu._private.object_directory import ObjectDirectory
+        self.directory = ObjectDirectory()
         # Lineage: return object_id -> producing TaskSpec, kept while
         # the object is referenced so a lost copy can be re-executed
         # (reference task_manager.h:269 ResubmitTask,
@@ -170,50 +172,26 @@ class Controller:
             return (self._refcounts.get(object_id, 0) == 0
                     and self._pins[object_id] == 0)
 
-    # ---- object directory (ownership_based_object_directory parity) ----
+    # ---- object directory (delegates to the ObjectDirectory
+    # subsystem; these remain the control-plane entry points) ----
     def add_location(self, object_id: str, node_id: str,
                      nbytes: int = 0) -> None:
-        with self._lock:
-            self._locations.setdefault(object_id, set()).add(node_id)
-            if nbytes:
-                self._location_nbytes[object_id] = nbytes
+        self.directory.add(object_id, node_id, nbytes)
 
     def remove_location(self, object_id: str,
                         node_id: Optional[str] = None) -> None:
-        with self._lock:
-            if node_id is None:
-                self._locations.pop(object_id, None)
-                self._location_nbytes.pop(object_id, None)
-                return
-            s = self._locations.get(object_id)
-            if s is not None:
-                s.discard(node_id)
-                if not s:
-                    self._locations.pop(object_id, None)
-                    self._location_nbytes.pop(object_id, None)
+        self.directory.remove(object_id, node_id)
 
     def locations(self, object_id: str) -> list[str]:
-        with self._lock:
-            return list(self._locations.get(object_id, ()))
+        return self.directory.locations(object_id)
 
     def has_location(self, object_id: str) -> bool:
-        with self._lock:
-            return bool(self._locations.get(object_id))
+        return self.directory.has(object_id)
 
     def purge_node_locations(self, node_id: str) -> list[str]:
         """Drop `node_id` from every directory entry; returns object ids
         that now have NO copy anywhere (lineage-recovery candidates)."""
-        orphaned: list[str] = []
-        with self._lock:
-            for oid in list(self._locations):
-                s = self._locations[oid]
-                if node_id in s:
-                    s.discard(node_id)
-                    if not s:
-                        self._locations.pop(oid, None)
-                        self._location_nbytes.pop(oid, None)
-                        orphaned.append(oid)
-        return orphaned
+        return self.directory.purge_node(node_id)
 
     # ---- nested-ref ownership ----
     def register_contained(self, object_id: str,
@@ -367,8 +345,8 @@ class Controller:
 
     # ---- persistence (GCS storage parity) ----
     _SNAPSHOT_TABLES = ("_kv", "_actors", "_named_actors", "_refcounts",
-                        "_pins", "_pgs", "_nodes", "_locations",
-                        "_location_nbytes", "_lineage", "_contained")
+                        "_pins", "_pgs", "_nodes", "_lineage",
+                        "_contained")
 
     def snapshot_state(self) -> bytes:
         """Snapshot every table into one blob (reference GCS tables are
@@ -382,11 +360,11 @@ class Controller:
         with self._lock:
             state = {name: dict(getattr(self, name))
                      for name in self._SNAPSHOT_TABLES}
-            # location values are sets mutated in place — copy them, or
-            # the out-of-lock pickle races concurrent add/discard
-            state["_locations"] = {k: set(v)
-                                   for k, v in state["_locations"].items()}
             state["_task_events"] = list(self._task_events)
+        # the directory snapshots under its own lock (its table keys
+        # keep the pre-extraction names for blob continuity)
+        (state["_locations"],
+         state["_location_nbytes"]) = self.directory.snapshot()
         # cloudpickle, not stdlib pickle: lineage/KV hold raw user task
         # args (lambdas, closures) that the wire layer supports — a
         # snapshot that crashes on them silently disables head FT
@@ -409,6 +387,8 @@ class Controller:
                            if not r.is_head}
             self._nodes.update(current)
             self._task_events.extend(state.get("_task_events", ()))
+        self.directory.restore(state.get("_locations", {}),
+                               state.get("_location_nbytes", {}))
 
     # ---- task events (GcsTaskManager parity) ----
     def record_task_event(self, task_id: str, name: str, state: str,

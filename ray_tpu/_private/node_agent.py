@@ -41,8 +41,9 @@ from ray_tpu._private import protocol
 from ray_tpu._private.config import CONFIG as _CFG
 from ray_tpu._private.object_store import (LocalStore, StoredObject,
                                            unlink_segment)
-from ray_tpu._private.object_transfer import (PullServer, materialize,
-                                              pull_object)
+from ray_tpu._private.object_transfer import (OBJECT_PLANE_STATS,
+                                              PullServer, materialize)
+from ray_tpu._private.pull_manager import PullManager
 from ray_tpu._private.scheduler import Scheduler
 from ray_tpu._private.specs import ActorSpec
 
@@ -91,6 +92,15 @@ class NodeAgent:
         # peer agent data connections, keyed by (host, port)
         self._peers: dict[tuple[str, int], protocol.Connection] = {}
         self._peer_lock = threading.Lock()
+        # Pull manager (reference pull_manager.cc): dedups concurrent
+        # fetches of one object into one transfer, bounds in-flight
+        # transfers/bytes, and sources chunks from ANY holder the
+        # directory reports — completed pulls register this node as a
+        # replica so it can serve its broadcast subtree / later readers.
+        self._pull_mgr = PullManager(
+            self.store, sources_fn=self._pull_sources,
+            on_complete=self._on_pull_complete,
+            on_source_failed=self._on_pull_source_failed)
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -165,6 +175,9 @@ class NodeAgent:
 
     # ------------------------------------------------------ lifecycles
     def _on_head_closed(self, conn) -> None:
+        # the head pulls over its control connection: reap any pull
+        # sessions it abandoned before deciding what the outage means
+        self._pull_server.on_conn_closed(conn)
         if self._stop.is_set():
             return
         window = _CFG.agent_reconnect_window_s
@@ -328,8 +341,22 @@ class NodeAgent:
 
     # ------------------------------------------------------- heartbeat
     def _heartbeat_loop(self) -> None:
+        last_spo: dict = {}
         while not self._stop.is_set():
             try:
+                # per-object serve counts ride the heartbeat only when
+                # they CHANGED (the head merges, keeping its last copy):
+                # a steady-state cluster must not pay for a 128-entry
+                # debug table twice a second per node
+                spo = self._pull_server.serves_per_object()
+                plane = {
+                    **OBJECT_PLANE_STATS,
+                    "sessions": self._pull_server.session_count(),
+                    **{"pull_" + k: v
+                       for k, v in self._pull_mgr.stats().items()},
+                }
+                if spo != last_spo:
+                    plane["serves_per_object"] = spo
                 self.head.send({
                     "type": protocol.NODE_HEARTBEAT,
                     "node_id": self.node_id,
@@ -337,8 +364,13 @@ class NodeAgent:
                     # telemetry): plain int dict, rides the structural
                     # node plane like the rest of the heartbeat
                     "wire": dict(protocol.WIRE_STATS),
+                    # object-plane counters (r8): transfers, bytes,
+                    # dedup hits, per-object serve counts — the head
+                    # aggregates these in object_plane_stats
+                    "object_plane": plane,
                     **self.scheduler.heartbeat_snapshot(),
                 })
+                last_spo = spo          # only after a successful send
             except protocol.ConnectionClosed:
                 # head outage: keep the thread alive — self.head is
                 # swapped for a fresh connection on successful rejoin
@@ -427,10 +459,27 @@ class NodeAgent:
             self._pull_server.handle_pull(conn, msg)
         elif mtype == protocol.PULL_CHUNK:
             self._pull_server.handle_chunk(conn, msg)
+        elif mtype == protocol.BCAST_PLAN:
+            OBJECT_PLANE_STATS["bcast_plans"] += 1
+            self._fetch_pool.submit(self._run_bcast_plan, msg)
         elif mtype == protocol.NODE_SHUTDOWN:
             self.shutdown()
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)
+
+    def _run_bcast_plan(self, msg: dict) -> None:
+        """Tree-broadcast leg: pull the object from the parent the head
+        named (falling back to any directory holder), store it, and
+        register — which unlocks this node's own subtree head-side."""
+        oid = msg["object_id"]
+        if self.store.contains(oid):
+            # already hold a copy through another path: (re)register so
+            # the coordinator sees this node complete
+            self.send_event("object_at", object_id=oid,
+                            nbytes=msg.get("nbytes", 0), addref=False)
+            return
+        self._pull_mgr.pull(oid, prefer=msg.get("source"),
+                            timeout=_CFG.bcast_timeout_s)
 
     # ------------------------------------------------ local connections
     def _accept_loop(self) -> None:
@@ -445,6 +494,9 @@ class NodeAgent:
             conn.start()
 
     def _on_local_closed(self, conn: protocol.Connection) -> None:
+        # peer/head pullers dial the local listener: reap the pull
+        # sessions a dying puller left open (blob + object pin)
+        self._pull_server.on_conn_closed(conn)
         wid = conn.meta.get("worker_id")
         if wid is None or self._stop.is_set():
             return
@@ -603,8 +655,10 @@ class NodeAgent:
     def _fetch(self, oid: str,
                timeout: Optional[float]) -> Optional[StoredObject]:
         """Local store (incl. spill restore), else head lookup, else
-        peer pull. The head lookup BLOCKS head-side until the object
-        exists somewhere or the timeout passes — the agent never polls."""
+        pull-manager transfer from any holder. The head lookup BLOCKS
+        head-side until the object exists somewhere or the timeout
+        passes — the agent never polls; the actual transfer dedups,
+        bounds, and multi-sources through the pull manager."""
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
         while True:
@@ -623,49 +677,86 @@ class NodeAgent:
             if rep.get("stored") is not None:
                 return rep["stored"]
             if rep.get("head_pull"):
-                # big head-resident object: chunked pull over the
-                # existing control connection (no extra dial needed)
-                try:
-                    return pull_object(self.head, oid, timeout=remaining)
-                except (protocol.ConnectionClosed, TimeoutError):
-                    return None
-            loc = rep.get("location")
-            if loc is None:
-                return None              # head-side timeout
-            host, port = loc["host"], loc["port"]
-            if (host, port) == tuple(self.advertise_addr):
-                # our own (deleted-in-flight) copy: loop re-checks
-                if deadline is not None and time.monotonic() > deadline:
-                    return None
-                time.sleep(0.05)
-                continue
-            stored = self._pull_from_peer((host, port), oid)
+                prefer = {"head": True}
+            else:
+                loc = rep.get("location")
+                if loc is None:
+                    return None          # head-side timeout
+                prefer = (loc if loc.get("node_id") != self.node_id
+                          else None)
+            stored = self._pull_mgr.pull(oid, prefer=prefer,
+                                         timeout=remaining)
             if stored is not None:
-                self.store.put_stored(stored)
-                # replica registration: future readers may pull from us,
-                # and the head's delete fan-out will reach this copy
-                self.send_event("object_at", object_id=oid,
-                                nbytes=stored.nbytes, addref=False)
                 return stored
-            # holder lost it (died / evicted): drop the stale location
-            # and retry until our deadline
-            self.send_event("location_gone", object_id=oid,
-                            holder=loc.get("node_id"))
+            # every source failed (holders died / evicted, or the only
+            # registered copy is our own deleted-in-flight one): the
+            # stale locations were dropped via on_source_failed —
+            # re-enter the lookup until our deadline so lineage
+            # resubmission has time to regenerate the object
             if deadline is not None and time.monotonic() > deadline:
                 return None
             time.sleep(0.1)
 
-    def _pull_from_peer(self, addr: tuple[str, int],
-                        oid: str) -> Optional[StoredObject]:
-        conn = self._peer_conn(addr)
-        if conn is None:
-            return None
+    # ---------------------------------------------- pull-manager hooks
+    def _pull_sources(self, oid: str, prefer):
+        """Source iterator for the pull manager: the preferred source
+        first (broadcast parent / lookup hint), then every holder the
+        directory reports (shuffled for load spread), then the head
+        itself. Peer connections are dialed lazily per yield."""
+        import random
+        seen: set = set()
+        my_addr = tuple(self.advertise_addr)
+
+        def peer(loc):
+            addr = (loc["host"], int(loc["port"]))
+            if addr == my_addr:
+                return None
+            return self._peer_conn(addr)
+
+        if prefer:
+            if prefer.get("head"):
+                seen.add("head")
+                yield ("head", self.head)
+            elif prefer.get("host") is not None:
+                conn = peer(prefer)
+                if conn is not None:
+                    seen.add(prefer.get("node_id"))
+                    yield (prefer.get("node_id") or
+                           f"{prefer['host']}:{prefer['port']}", conn)
         try:
-            return pull_object(conn, oid)
+            rep = self.head.request(
+                {"type": protocol.LOCATE_OBJECT, "object_id": oid},
+                timeout=10.0)
         except (protocol.ConnectionClosed, TimeoutError):
-            with self._peer_lock:
-                self._peers.pop(addr, None)
-            return None
+            rep = {}
+        locs = list(rep.get("locations") or ())
+        random.shuffle(locs)
+        for loc in locs:
+            nid = loc.get("node_id")
+            if nid == self.node_id or nid in seen:
+                continue
+            conn = peer(loc)
+            if conn is not None:
+                seen.add(nid)
+                yield (nid, conn)
+        if rep.get("head_has") and "head" not in seen:
+            yield ("head", self.head)
+
+    def _on_pull_complete(self, oid: str, stored, source_id) -> None:
+        """Replica registration: future readers may pull from us, the
+        head's delete fan-out will reach this copy, and an active
+        broadcast unlocks our subtree."""
+        self._send_to_head({"type": protocol.OBJECT_ADDED,
+                            "object_id": oid, "node_id": self.node_id,
+                            "nbytes": stored.nbytes, "addref": False})
+
+    def _on_pull_source_failed(self, oid: str, source_id) -> None:
+        """Holder lost it (died / evicted): tell the directory so the
+        stale location stops being handed out."""
+        if source_id and source_id != "head":
+            self._send_to_head({"type": protocol.OBJECT_REMOVED,
+                                "object_id": oid,
+                                "node_id": source_id})
 
     def _peer_conn(self, addr) -> Optional[protocol.Connection]:
         with self._peer_lock:

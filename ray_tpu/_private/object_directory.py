@@ -1,0 +1,154 @@
+"""Cluster object directory: object_id -> holder node set.
+
+The head-resident location service of the distributed object plane
+(reference src/ray/object_manager/ownership_based_object_directory.cc;
+here the head IS the owner of record for every object). Updated on
+seal/put (NODE_TASK_DONE ``located`` entries, OBJECT_ADDED), on
+pull-complete (a puller registers its replica and immediately serves
+it), and on evict/holder-death (OBJECT_REMOVED, node purge). Read by:
+
+- getters (head ``_pull_remote`` + agent multi-source pulls via
+  LOCATE_OBJECT),
+- the scheduler's locality hint (place a task where its argument
+  bytes already live — ``locality_bytes``),
+- the tree-broadcast coordinator (location-added listeners drive the
+  dispatch cascade: a node's registration unlocks its subtree).
+
+Listeners fire OUTSIDE the directory lock (they send frames / touch
+other subsystem locks).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional
+
+
+class ObjectDirectory:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._locations: dict[str, set[str]] = {}
+        self._nbytes: dict[str, int] = {}
+        self._listeners: list[Callable[[str, str], None]] = []
+        # counters for the object_plane_stats surface
+        self.adds = 0
+        self.removes = 0
+
+    # ------------------------------------------------------- mutation
+    def add_listener(self, fn: Callable[[str, str], None]) -> None:
+        """``fn(object_id, node_id)`` runs after every NEW location
+        registration (not on re-adds), outside the directory lock."""
+        self._listeners.append(fn)
+
+    def add(self, object_id: str, node_id: str, nbytes: int = 0) -> bool:
+        """Register a copy; returns True (and notifies listeners) only
+        when the holder set actually grew."""
+        with self._lock:
+            s = self._locations.setdefault(object_id, set())
+            new = node_id not in s
+            s.add(node_id)
+            if nbytes:
+                self._nbytes[object_id] = nbytes
+            if new:
+                self.adds += 1
+        if new:
+            for fn in self._listeners:
+                try:
+                    fn(object_id, node_id)
+                except Exception:
+                    pass
+        return new
+
+    def remove(self, object_id: str,
+               node_id: Optional[str] = None) -> None:
+        """Drop one holder, or the whole entry when node_id is None."""
+        with self._lock:
+            if node_id is None:
+                if self._locations.pop(object_id, None) is not None:
+                    self.removes += 1
+                self._nbytes.pop(object_id, None)
+                return
+            s = self._locations.get(object_id)
+            if s is not None and node_id in s:
+                s.discard(node_id)
+                self.removes += 1
+                if not s:
+                    self._locations.pop(object_id, None)
+                    self._nbytes.pop(object_id, None)
+
+    def purge_node(self, node_id: str) -> list[str]:
+        """Drop `node_id` from every entry; returns object ids left
+        with NO copy anywhere (lineage-recovery candidates)."""
+        orphaned: list[str] = []
+        with self._lock:
+            for oid in list(self._locations):
+                s = self._locations[oid]
+                if node_id in s:
+                    s.discard(node_id)
+                    self.removes += 1
+                    if not s:
+                        self._locations.pop(oid, None)
+                        self._nbytes.pop(oid, None)
+                        orphaned.append(oid)
+        return orphaned
+
+    # --------------------------------------------------------- queries
+    def locations(self, object_id: str) -> list[str]:
+        with self._lock:
+            return list(self._locations.get(object_id, ()))
+
+    def has(self, object_id: str) -> bool:
+        with self._lock:
+            return bool(self._locations.get(object_id))
+
+    def holds(self, object_id: str, node_id: str) -> bool:
+        with self._lock:
+            return node_id in self._locations.get(object_id, ())
+
+    def nbytes(self, object_id: str) -> int:
+        with self._lock:
+            return self._nbytes.get(object_id, 0)
+
+    def empty(self) -> bool:
+        return not self._locations          # atomic read; hint only
+
+    def locality_bytes(self, object_ids: Iterable[str],
+                       node_ids: Iterable[str]) -> dict[str, int]:
+        """node_id -> total known bytes of `object_ids` resident there
+        (objects with unknown size count 1 byte: presence still
+        matters). Only nodes in `node_ids` are scored; nodes holding
+        nothing are absent from the result."""
+        wanted = set(node_ids)
+        out: dict[str, int] = {}
+        with self._lock:
+            for oid in object_ids:
+                holders = self._locations.get(oid)
+                if not holders:
+                    continue
+                size = max(self._nbytes.get(oid, 0), 1)
+                for nid in holders:
+                    if nid in wanted:
+                        out[nid] = out.get(nid, 0) + size
+        return out
+
+    # ---------------------------------------------------- persistence
+    def snapshot(self) -> tuple[dict, dict]:
+        """(locations, nbytes) table copies for the head snapshot."""
+        with self._lock:
+            return ({k: set(v) for k, v in self._locations.items()},
+                    dict(self._nbytes))
+
+    def restore(self, locations: dict, nbytes: dict) -> None:
+        with self._lock:
+            self._locations = {k: set(v) for k, v in locations.items()}
+            self._nbytes = dict(nbytes)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "objects": len(self._locations),
+                "replicas": sum(len(s)
+                                for s in self._locations.values()),
+                "tracked_bytes": sum(self._nbytes.values()),
+                "adds": self.adds,
+                "removes": self.removes,
+            }

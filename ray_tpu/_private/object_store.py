@@ -507,6 +507,11 @@ class LocalStore:
         self._spill_cancelled: set[str] = set()  # deleted mid-spill
         self._restoring: set[str] = set()        # spill-file read in flight
         self._restore_cancelled: set[str] = set()  # deleted mid-restore
+        # process-local pins (pull sessions serving an object): the
+        # spill policy must not evict these mid-transfer. Orthogonal to
+        # the distributed pin set (pinned_fn) the head computes.
+        self._local_pins: "collections.Counter[str]" = (
+            collections.Counter())
         self._bytes = 0
         self._spilled_bytes_total = 0
         self._restored_bytes_total = 0
@@ -620,6 +625,7 @@ class LocalStore:
         if self.capacity_bytes is None or self._bytes <= self.capacity_bytes:
             return []
         pinned = set(self._pinned_fn())
+        pinned.update(oid for oid, n in self._local_pins.items() if n > 0)
         now = time.monotonic()
         victims: list[tuple[str, StoredObject]] = []
 
@@ -751,6 +757,23 @@ class LocalStore:
         if self.on_seal is not None:
             self.on_seal(oid)
         return obj
+
+    # ------------------------------------------------- local pinning
+    def pin_local(self, object_id: str) -> None:
+        """Keep `object_id` resident (not spillable) while a transfer
+        serves it — pull sessions hold one for their lifetime so an
+        LRU pass can't unlink segments mid-pull."""
+        with self._lock:
+            self._local_pins[object_id] += 1
+
+    def unpin_local(self, object_id: str) -> None:
+        with self._cv:
+            n = self._local_pins[object_id] - 1
+            if n > 0:
+                self._local_pins[object_id] = n
+            else:
+                self._local_pins.pop(object_id, None)
+            self._cv.notify_all()       # backpressure may be waiting
 
     # ------------------------------------------------------------- get
     def held_objects(self) -> list[tuple[str, int]]:

@@ -12,7 +12,24 @@ The holder serializes the StoredObject — materializing any POSIX-shm
 segments into inline bytes, since shm names are host-local — and serves
 it in fixed-size chunks so one giant object never occupies a connection
 for a single monolithic frame (and the puller can bound memory).
-Sessions expire after a TTL to survive pullers that die mid-pull.
+
+Serving side (PullServer):
+- a pull session PINS its object in the local store for its lifetime
+  (`pin_local`), so the LRU spill pass cannot unlink segments
+  mid-transfer; if the object was ALREADY spilled (or spills in the
+  probe->encode window), the serve path restores from the spill file
+  and retries instead of failing the segment map;
+- sessions expire after `pull_session_ttl_s`: the sweep runs lazily on
+  every pull/chunk message AND on the puller's connection close, so
+  pullers that die mid-pull cannot leak materialized blobs or pins;
+- concurrent pulls of one object share a single encoded blob (the
+  broadcast fan-out case: N children of one tree node cost one encode).
+
+Client side (``pull_object``): a dropped/expired chunk re-opens the
+session with the holder and resumes from the failed index, up to
+`pull_chunk_retries` times. Transfer/serve/retry counters accumulate in
+``OBJECT_PLANE_STATS`` (surfaced via the ``object_plane_stats`` state
+op and node heartbeats).
 """
 from __future__ import annotations
 
@@ -21,14 +38,33 @@ import pickle
 import threading
 import time
 import uuid
+import weakref
+from dataclasses import dataclass, field
 from typing import Optional
 
 from ray_tpu._private import protocol
+from ray_tpu._private.config import CONFIG as _CFG
 from ray_tpu._private.object_store import (StoredObject, _map_segment,
                                            guard_segments)
 
 CHUNK_BYTES = 4 * 1024 * 1024
-_SESSION_TTL_S = 120.0
+
+# Process-wide object-plane counters (this process's transfers only):
+# plain int increments under the GIL, same discipline as
+# protocol.WIRE_STATS. Agents carry a copy on heartbeats; the head
+# aggregates per node in the object_plane_stats state op.
+OBJECT_PLANE_STATS = {
+    "pulls_started": 0,       # transfers this process initiated
+    "pulls_completed": 0,
+    "pulls_failed": 0,
+    "pull_bytes": 0,
+    "pull_dedup_hits": 0,     # pulls that joined an in-flight transfer
+    "chunk_retries": 0,       # chunk-level session re-opens
+    "serves_started": 0,      # pull sessions opened by remote pullers
+    "serves_completed": 0,
+    "serve_bytes": 0,
+    "bcast_plans": 0,         # BCAST_PLAN messages acted on (agents)
+}
 
 
 def materialize(obj: StoredObject) -> StoredObject:
@@ -64,6 +100,21 @@ def _decode(data: bytes) -> StoredObject:
     return pickle.loads(data)
 
 
+class PullBudgetExceeded(Exception):
+    """The in-flight byte budget could not admit this transfer before
+    the deadline — NOT a source failure (the holder is fine), so pull
+    managers must not drop the location over it."""
+
+
+@dataclass
+class _PullSession:
+    blob: bytes
+    object_id: str
+    touched: float
+    conn_id: Optional[int] = None       # id(conn) of the puller
+    pinned: bool = False
+
+
 class PullServer:
     """Serves PULL_OBJECT / PULL_CHUNK against a LocalStore. Mixed into
     any endpoint that holds objects (head runtime, node agent).
@@ -73,17 +124,93 @@ class PullServer:
     multi-GB restore can never stall heartbeat processing on a shared
     control connection."""
 
+    # bounded per-object serve-count table (object_plane_stats surface;
+    # the broadcast tests assert per-node serve counts from it)
+    _SERVES_PER_OBJECT_CAP = 128
+
     def __init__(self, store, executor=None):
         self._store = store
         self._executor = executor
-        self._sessions: dict[str, tuple[bytes, float]] = {}
+        self._sessions: dict[str, _PullSession] = {}
         self._slock = threading.Lock()
+        self._last_sweep = time.monotonic()
+        # oid -> (weakref to the StoredObject encoded, blob): while the
+        # store still holds that exact instance, concurrent sessions
+        # share one encode (a re-put/restore swaps the instance, so a
+        # stale blob can never be served)
+        self._blob_cache: dict[str, tuple] = {}
+        self._serves_per_object: dict[str, int] = {}
 
+    # ----------------------------------------------------- sessions
+    def _drop_session_locked(self, pull_id: str) -> None:
+        sess = self._sessions.pop(pull_id, None)
+        if sess is None:
+            return
+        if sess.pinned:
+            self._unpin(sess.object_id)
+        # last session of this object gone: release the shared blob —
+        # the cache exists to amortize CONCURRENT sessions (tree
+        # children), not to hold multi-GB bytes on an idle node
+        if not any(s.object_id == sess.object_id
+                   for s in self._sessions.values()):
+            self._blob_cache.pop(sess.object_id, None)
+
+    def _unpin(self, oid: str) -> None:
+        unpin = getattr(self._store, "unpin_local", None)
+        if unpin is not None:
+            try:
+                unpin(oid)
+            except Exception:
+                pass
+
+    def sweep(self, force: bool = False) -> int:
+        """Lazy TTL sweep: reap sessions idle past pull_session_ttl_s.
+        Runs (throttled) on every pull/chunk message so expiry does not
+        depend on further traffic for the SAME session — pullers that
+        die mid-pull cannot leak materialized blobs/pins."""
+        now = time.monotonic()
+        if not force and now - self._last_sweep < 1.0:
+            return 0
+        ttl = _CFG.pull_session_ttl_s
+        with self._slock:
+            self._last_sweep = now
+            dead = [k for k, s in self._sessions.items()
+                    if now - s.touched > ttl]
+            for k in dead:
+                self._drop_session_locked(k)
+            # blob-cache entries whose StoredObject died (deleted /
+            # re-put) or that went idle are dropped with the sessions
+            for oid in list(self._blob_cache):
+                ref, _, created = self._blob_cache[oid]
+                if ref() is None or now - created > ttl:
+                    self._blob_cache.pop(oid, None)
+        return len(dead)
+
+    def on_conn_closed(self, conn) -> None:
+        """Reap every session the closing connection's puller opened —
+        the other half of dead-puller cleanup (the lazy sweep covers
+        holders that never hear from anyone again)."""
+        cid = id(conn)
+        with self._slock:
+            for k in [k for k, s in self._sessions.items()
+                      if s.conn_id == cid]:
+                self._drop_session_locked(k)
+
+    def session_count(self) -> int:
+        with self._slock:
+            return len(self._sessions)
+
+    def serves_per_object(self) -> dict[str, int]:
+        with self._slock:
+            return dict(self._serves_per_object)
+
+    # ------------------------------------------------------- serving
     def handle_pull(self, conn: protocol.Connection, msg: dict) -> None:
         """Runs on the connection reader thread: answer only the cheap
         not-found case inline; ALL serving (the _encode of a possibly
         multi-GB object, and any spill restore) goes to the executor so
         the reader thread never stalls heartbeats/control traffic."""
+        self.sweep()
         oid = msg["object_id"]
         stored = self._store.get_stored(oid, timeout=0, restore=False)
         if stored is None and not self._store.contains(oid):
@@ -109,30 +236,89 @@ class PullServer:
         except protocol.ConnectionClosed:
             pass
 
+    def _encode_shared(self, stored) -> bytes:
+        """Encode `stored`, sharing the blob across concurrent sessions
+        of the same object while the store holds that exact instance
+        (tree broadcast: fanout children of one node pay one encode)."""
+        oid = stored.object_id
+        with self._slock:
+            ent = self._blob_cache.get(oid)
+            if ent is not None and ent[0]() is stored:
+                return ent[1]
+        blob = _encode(stored)
+        with self._slock:
+            if len(self._blob_cache) >= 4:       # bounded: oldest out
+                oldest = min(self._blob_cache,
+                             key=lambda k: self._blob_cache[k][2])
+                self._blob_cache.pop(oldest, None)
+            self._blob_cache[oid] = (weakref.ref(stored), blob,
+                                     time.monotonic())
+        return blob
+
     def _serve(self, conn: protocol.Connection, msg: dict,
                stored) -> None:
-        blob = _encode(stored)
+        oid = stored.object_id
+        # Pin for the life of the session: the spill pass must not
+        # unlink this object's segments (or evict the restored copy)
+        # while chunks are still being read.
+        pin = getattr(self._store, "pin_local", None)
+        pinned = False
+        if pin is not None:
+            pin(oid)
+            pinned = True
+        blob = None
+        try:
+            for _attempt in range(3):
+                try:
+                    blob = self._encode_shared(stored)
+                    break
+                except FileNotFoundError:
+                    # segments unlinked in the probe->map window (LRU
+                    # spill raced us, before the pin landed): re-fetch —
+                    # the store restores from the spill file, coming
+                    # back with inline buffers
+                    stored = self._store.get_stored(oid, timeout=10)
+                    if stored is None:
+                        break
+        except BaseException:
+            if pinned:
+                self._unpin(oid)
+            raise
+        if blob is None:
+            if pinned:
+                self._unpin(oid)
+            conn.reply(msg, found=False)
+            return
         pull_id = uuid.uuid4().hex[:12]
-        now = time.monotonic()
+        sess = _PullSession(blob=blob, object_id=oid,
+                            touched=time.monotonic(), conn_id=id(conn),
+                            pinned=pinned)
         with self._slock:
-            self._sessions[pull_id] = (blob, now)
-            # TTL sweep inline (sessions are few; no timer thread)
-            dead = [k for k, (_, t) in self._sessions.items()
-                    if now - t > _SESSION_TTL_S]
-            for k in dead:
-                self._sessions.pop(k, None)
+            self._sessions[pull_id] = sess
+            self._serves_per_object[oid] = (
+                self._serves_per_object.get(oid, 0) + 1)
+            while len(self._serves_per_object) > self._SERVES_PER_OBJECT_CAP:
+                self._serves_per_object.pop(
+                    next(iter(self._serves_per_object)))
+        OBJECT_PLANE_STATS["serves_started"] += 1
         nchunks = max(1, (len(blob) + CHUNK_BYTES - 1) // CHUNK_BYTES)
-        conn.reply(msg, found=True, pull_id=pull_id, nchunks=nchunks,
-                   size=len(blob))
+        try:
+            conn.reply(msg, found=True, pull_id=pull_id, nchunks=nchunks,
+                       size=len(blob))
+        except protocol.ConnectionClosed:
+            with self._slock:
+                self._drop_session_locked(pull_id)
+            raise
 
     def handle_chunk(self, conn: protocol.Connection, msg: dict) -> None:
+        self.sweep()
         pull_id, index = msg["pull_id"], msg["index"]
         with self._slock:
-            entry = self._sessions.get(pull_id)
-            if entry is not None:
-                blob = entry[0]
-                self._sessions[pull_id] = (blob, time.monotonic())
-        if entry is None:
+            sess = self._sessions.get(pull_id)
+            if sess is not None:
+                blob = sess.blob
+                sess.touched = time.monotonic()
+        if sess is None:
             conn.reply(msg, data=None)
             return
         start = index * CHUNK_BYTES
@@ -140,13 +326,24 @@ class PullServer:
         last = start + CHUNK_BYTES >= len(blob)
         if last:
             with self._slock:
-                self._sessions.pop(pull_id, None)
+                self._drop_session_locked(pull_id)
+            OBJECT_PLANE_STATS["serves_completed"] += 1
+        OBJECT_PLANE_STATS["serve_bytes"] += len(data)
         conn.reply(msg, data=data)
 
 
 def pull_object(conn: protocol.Connection, object_id: str,
-                timeout: Optional[float] = 60.0) -> Optional[StoredObject]:
-    """Client side: chunked fetch of one object over `conn`."""
+                timeout: Optional[float] = 60.0,
+                retries: Optional[int] = None,
+                budget=None) -> Optional[StoredObject]:
+    """Client side: chunked fetch of one object over `conn`. A dropped
+    chunk (session expired / holder restarted serving state) re-opens
+    the session and resumes from the failed index, `retries` times
+    (default pull_chunk_retries). `budget`, when given, is a
+    reserve/release byte-accounting object (see pull_manager): the
+    transfer holds `size` of it from meta until return."""
+    if retries is None:
+        retries = _CFG.pull_chunk_retries
     deadline = None if timeout is None else time.monotonic() + timeout
 
     def remaining() -> Optional[float]:
@@ -158,13 +355,56 @@ def pull_object(conn: protocol.Connection, object_id: str,
                          "object_id": object_id}, timeout=remaining())
     if not meta.get("found"):
         return None
-    parts: list[bytes] = []
-    for i in range(meta["nchunks"]):
-        rep = conn.request({"type": protocol.PULL_CHUNK,
-                            "pull_id": meta["pull_id"], "index": i},
-                           timeout=remaining())
-        data = rep.get("data")
-        if data is None:
-            return None                  # session expired / holder lost it
-        parts.append(data)
-    return _decode(b"".join(parts))
+    size = meta["size"]
+    nchunks = meta["nchunks"]
+    reserved = False
+    if budget is not None:
+        if not budget.reserve(size, timeout=remaining()):
+            raise PullBudgetExceeded(
+                f"{object_id}: {size} bytes did not fit the in-flight "
+                f"budget before the deadline")
+        reserved = True
+    try:
+        # Windowed chunk fetch: keep pull_pipeline_depth requests in
+        # flight so the transfer is bandwidth-bound, not one-RTT-per-
+        # chunk lockstep (tree broadcast compounds per-transfer latency
+        # across its depth, so this matters doubly there).
+        depth = max(1, _CFG.pull_pipeline_depth)
+        parts: list = [None] * nchunks
+        window: list[tuple[int, object]] = []   # (index, future)
+        done = 0
+        next_req = 0
+        while done < nchunks:
+            while next_req < nchunks and len(window) < depth:
+                fut = conn.request_async(
+                    {"type": protocol.PULL_CHUNK,
+                     "pull_id": meta["pull_id"], "index": next_req})
+                window.append((next_req, fut))
+                next_req += 1
+            idx, fut = window.pop(0)
+            rep = fut.result(timeout=remaining())
+            data = rep.get("data")
+            if data is None:
+                # session expired / holder lost it mid-pull: re-open and
+                # resume from this index (chunking is deterministic).
+                # Outstanding window futures reference the dead session
+                # and would answer None too — discard them.
+                if retries <= 0:
+                    return None
+                retries -= 1
+                OBJECT_PLANE_STATS["chunk_retries"] += 1
+                window.clear()
+                next_req = idx
+                meta = conn.request({"type": protocol.PULL_OBJECT,
+                                     "object_id": object_id},
+                                    timeout=remaining())
+                if not meta.get("found") or meta["size"] != size:
+                    return None          # gone, or a different incarnation
+                continue
+            if parts[idx] is None:
+                done += 1
+            parts[idx] = data
+        return _decode(b"".join(parts))
+    finally:
+        if reserved:
+            budget.release(size)

@@ -89,6 +89,19 @@ OBJECT_LOOKUP = "object_lookup"        # agent -> head (reply: stored |
 PULL_OBJECT = "pull_object"            # any -> holder (reply: pull meta)
 PULL_CHUNK = "pull_chunk"              # any -> holder (reply: data)
 
+# ---- object plane v2 (reference object_manager/object_directory.cc +
+# pull_manager.cc): cluster object directory + multi-source pulls +
+# tree broadcast ----
+LOCATE_OBJECT = "locate_object"        # any -> head (reply: locations,
+                                       #   head_has, nbytes) — non-blocking
+                                       #   directory read for multi-source
+OBJECT_ADDED = "object_added"          # agent -> head: local copy sealed
+OBJECT_REMOVED = "object_removed"      # agent -> head: copy gone (holder
+                                       #   lost it / stale location)
+BCAST_PLAN = "bcast_plan"              # head -> agent: pull object_id from
+                                       #   the given parent, then serve
+                                       #   your subtree
+
 
 class ConnectionClosed(Exception):
     pass

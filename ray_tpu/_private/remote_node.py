@@ -46,6 +46,9 @@ class RemoteNodeHandle:
         # worker_id -> actor_id (or None) as reported by dispatch events.
         self._workers: dict[str, Optional[str]] = {}
         self.wire_stats: dict[str, int] = {}
+        # object-plane counters (r8: transfers/serves/dedup/bytes) as
+        # of the last heartbeat — aggregated by object_plane_stats
+        self.object_plane: dict = {}
         self._dead = False
 
     # ------------------------------------------------------- heartbeat
@@ -60,6 +63,15 @@ class RemoteNodeHandle:
             # agent-process frame counters (r7 telemetry; {} from
             # pre-r7 agents) — debug surface for per-node wire load
             self.wire_stats = dict(msg.get("wire", {}))
+            op = dict(msg.get("object_plane", {}))
+            if op:
+                # serves_per_object rides heartbeats only when it
+                # changed agent-side: keep the last received table
+                if ("serves_per_object" not in op
+                        and "serves_per_object" in self.object_plane):
+                    op["serves_per_object"] = (
+                        self.object_plane["serves_per_object"])
+                self.object_plane = op
 
     def workers_snapshot(self) -> list:
         """Worker table rows as of the last heartbeat."""

@@ -138,6 +138,17 @@ class Runtime(_context.BaseContext):
                                      is_head=True,
                                      labels=self._head_labels)
         self.head_node_id = head.node_id
+        # Object plane v2: the head's own pull manager (deduped,
+        # bounded, multi-source fetches from agent holders) and the
+        # tree-broadcast coordinator, driven by directory add events.
+        from ray_tpu._private.broadcast import BroadcastCoordinator
+        from ray_tpu._private.pull_manager import PullManager
+        self._pull_mgr = PullManager(
+            self.store, sources_fn=self._head_pull_sources,
+            on_source_failed=lambda oid, nid:
+                self.controller.remove_location(oid, nid))
+        self.bcast = BroadcastCoordinator(self)
+        self.controller.directory.add_listener(self.bcast.on_location)
         self._init_head_persistence()
 
     # ================= head fault tolerance =================
@@ -284,6 +295,8 @@ class Runtime(_context.BaseContext):
             conn.start()
 
     def _on_conn_closed(self, conn: protocol.Connection) -> None:
+        # reap pull sessions this peer (agent or worker) had open
+        self._pull_server.on_conn_closed(conn)
         if self._shutdown:
             return
         nid = conn.meta.get("node_id")
@@ -500,6 +513,27 @@ class Runtime(_context.BaseContext):
                     self.controller.pubsub.add_waiter(
                         kwargs["channel"], kwargs.get("cursor", 0),
                         float(kwargs["timeout"]), _reply)
+                elif msg["op"] == "broadcast_object":
+                    # blocks until the whole tree completes — never on
+                    # a connection reader thread
+                    def _bc(conn=conn, msg=msg, kwargs=kwargs):
+                        try:
+                            conn.reply(msg, value=self.state_op(
+                                "broadcast_object", **kwargs))
+                        except protocol.ConnectionClosed:
+                            pass
+                        except Exception as e:
+                            # api.broadcast re-raises from this shape,
+                            # so remote callers see the same exception
+                            # contract as the in-process driver path
+                            try:
+                                conn.reply(msg, value={
+                                    "error": str(e),
+                                    "error_type": type(e).__name__})
+                            except protocol.ConnectionClosed:
+                                pass
+                    threading.Thread(target=_bc, name="rtpu-bcast",
+                                     daemon=True).start()
                 else:
                     conn.reply(msg, value=self.state_op(
                         msg["op"], **kwargs))
@@ -532,6 +566,13 @@ class Runtime(_context.BaseContext):
             self._on_node_task_done(conn, msg)
         elif mtype == protocol.OBJECT_LOOKUP:
             self._on_object_lookup(conn, msg)
+        elif mtype == protocol.LOCATE_OBJECT:
+            self._on_locate_object(conn, msg)
+        elif mtype == protocol.OBJECT_ADDED:
+            self._on_object_added(msg)
+        elif mtype == protocol.OBJECT_REMOVED:
+            self.controller.remove_location(msg["object_id"],
+                                            msg.get("node_id"))
         elif mtype == protocol.PULL_OBJECT:
             self._pull_server.handle_pull(conn, msg)
         elif mtype == protocol.PULL_CHUNK:
@@ -632,13 +673,7 @@ class Runtime(_context.BaseContext):
                 proxy.on_finished(proxy._key(msg["spec"]))
             self.on_unplaceable(msg["spec"], msg["reason"])
         elif kind == "object_at":
-            self._seal_contained(msg["object_id"],
-                                 msg.get("contained", []))
-            if msg.get("addref"):
-                self.controller.addref(msg["object_id"])
-            self.controller.add_location(msg["object_id"], msg["node_id"],
-                                         msg.get("nbytes", 0))
-            self.waiters.notify(msg["object_id"])
+            self._on_object_added(msg)
         elif kind == "location_gone":
             holder = msg.get("holder")
             if holder:
@@ -716,6 +751,38 @@ class Runtime(_context.BaseContext):
             state = "FAILED" if msg.get("error") else "FINISHED"
             self.controller.record_task_event(spec.task_id, spec.name,
                                               state, worker_id=worker_id)
+
+    def _on_object_added(self, msg: dict) -> None:
+        """A node sealed/pulled a copy (OBJECT_ADDED, or the legacy
+        object_at node event): register the location — the directory
+        listener cascades any active broadcast — and wake getters."""
+        oid = msg["object_id"]
+        self._seal_contained(oid, msg.get("contained") or [])
+        if msg.get("addref"):
+            self.controller.addref(oid)
+        self.controller.add_location(oid, msg["node_id"],
+                                     msg.get("nbytes", 0))
+        self.waiters.notify(oid)
+
+    def _on_locate_object(self, conn: protocol.Connection,
+                          msg: dict) -> None:
+        """Non-blocking directory read (LOCATE_OBJECT): every alive
+        holder's dial address, for multi-source pulls. Unlike
+        OBJECT_LOOKUP this never parks — pull managers use it to
+        rotate sources mid-transfer."""
+        oid = msg["object_id"]
+        locs = []
+        alive = {n.node_id: n for n in self.cluster.alive_nodes()}
+        for nid in self.controller.locations(oid):
+            rec = alive.get(nid)
+            addr = (getattr(rec.scheduler, "advertise_addr", None)
+                    if rec else None)
+            if addr is not None:
+                locs.append({"host": addr[0], "port": int(addr[1]),
+                             "node_id": nid})
+        conn.reply(msg, locations=locs,
+                   head_has=self.store.contains(oid),
+                   nbytes=self.controller.directory.nbytes(oid))
 
     def _on_object_lookup(self, conn: protocol.Connection,
                           msg: dict) -> None:
@@ -857,9 +924,15 @@ class Runtime(_context.BaseContext):
                     conn.reply(msg, stored=got)
                     return
                 if self.controller.has_location(oid):
-                    got = self._pull_remote(oid)
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    got = self._pull_remote(oid, timeout=remaining)
                     if got is not None:
                         conn.reply(msg, stored=got)
+                        return
+                    if (deadline is not None
+                            and time.monotonic() >= deadline):
+                        conn.reply(msg, stored=None, timeout=True)
                         return
                     continue            # stale location dropped; re-check
                 if (deadline is not None
@@ -891,9 +964,17 @@ class Runtime(_context.BaseContext):
             if stored is not None:
                 return stored
             if self.controller.has_location(oid):
-                stored = self._pull_remote(oid)
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                stored = self._pull_remote(oid, timeout=remaining)
                 if stored is not None:
                     return stored
+                # a failed pull no longer guarantees a location was
+                # dropped (semaphore/budget/dedup-join timeouts keep
+                # them by design): honour the caller's deadline here
+                # or contention turns this loop into a busy spin
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
                 continue                 # stale location dropped; retry
             remaining = (None if deadline is None
                          else deadline - time.monotonic())
@@ -910,29 +991,71 @@ class Runtime(_context.BaseContext):
                 if not self.controller.has_location(oid):
                     return None
 
-    def _pull_remote(self, oid: str) -> Optional[StoredObject]:
-        """Pull one object from any alive agent holding it; caches the
-        bytes in the head store (LRU/spill governs them from there).
-        Returns None after dropping every stale location."""
-        from ray_tpu._private.object_transfer import pull_object
-        for nid in self.controller.locations(oid):
+    def _head_pull_sources(self, oid: str, prefer=None):
+        """Pull-manager source iterator: every alive agent holding a
+        copy, over its existing control connection (shuffled for load
+        spread). Dead / in-process locations are dropped from the
+        directory as they are encountered — same stale-location
+        hygiene the pre-pull-manager loop had."""
+        import random
+        nids = self.controller.locations(oid)
+        random.shuffle(nids)
+        for nid in nids:
             rec = self.cluster.get_node(nid)
             if rec is None or not rec.alive:
                 self.controller.remove_location(oid, nid)
                 continue
             conn = getattr(rec.scheduler, "conn", None)
-            if conn is None:       # local in-process node: nothing to pull
+            if conn is None:   # local in-process node: nothing to pull
                 self.controller.remove_location(oid, nid)
                 continue
-            try:
-                stored = pull_object(conn, oid)
-            except (protocol.ConnectionClosed, TimeoutError):
-                stored = None
-            if stored is not None:
-                self.store.put_stored(stored)
-                return stored
-            self.controller.remove_location(oid, nid)
-        return None
+            yield (nid, conn)
+
+    def _pull_remote(self, oid: str,
+                     timeout: Optional[float] = None
+                     ) -> Optional[StoredObject]:
+        """Pull one object from any alive agent holding it, through the
+        head's pull manager (dedup: N parked getters of one object cost
+        one transfer; bounded in-flight bytes); caches the bytes in the
+        head store (LRU/spill governs them from there). Returns None
+        once every registered location proved stale. `timeout` bounds
+        this attempt to the caller's remaining budget (default 30s for
+        deadline-less gets, so a single attempt can't park forever)."""
+        if timeout is None:
+            timeout = 30.0
+        return self._pull_mgr.pull(oid, timeout=max(0.1, timeout))
+
+    def broadcast_object(self, object_id: str,
+                         fanout: Optional[int] = None,
+                         timeout: Optional[float] = None) -> dict:
+        """Distribute one object to every alive node in a fanout tree
+        (the source serves <= fanout transfers; each completed puller
+        serves its subtree). Returns the tree/completion stats."""
+        return self.bcast.broadcast(object_id, fanout=fanout,
+                                    timeout=timeout)
+
+    def _object_plane_stats(self) -> dict:
+        """Object-plane observability: head counters + per-node
+        heartbeat-carried counters + directory/broadcast state."""
+        from ray_tpu._private.object_transfer import OBJECT_PLANE_STATS
+        nodes = {}
+        for n in self.cluster.alive_nodes():
+            op = getattr(n.scheduler, "object_plane", None)
+            if op:
+                nodes[n.node_id] = dict(op)
+        return {
+            "head": {
+                **OBJECT_PLANE_STATS,
+                "sessions": self._pull_server.session_count(),
+                "serves_per_object":
+                    self._pull_server.serves_per_object(),
+                **{"pull_" + k: v
+                   for k, v in self._pull_mgr.stats().items()},
+            },
+            "nodes": nodes,
+            "directory": self.controller.directory.stats(),
+            "broadcast": self.bcast.stats(),
+        }
 
     def _delete_everywhere(self, oid: str) -> None:
         """Deletion fan-out: local store + every agent holding a copy.
@@ -1306,6 +1429,12 @@ class Runtime(_context.BaseContext):
             return self.cluster.stats()
         if op == "object_store_stats":
             return self.store.stats()
+        if op == "object_plane_stats":
+            return self._object_plane_stats()
+        if op == "broadcast_object":
+            return self.broadcast_object(kwargs["object_id"],
+                                         fanout=kwargs.get("fanout"),
+                                         timeout=kwargs.get("timeout"))
         if op == "waiter_stats":
             return self.waiters.stats()
         if op == "pubsub_poll":

@@ -76,6 +76,7 @@ STRUCTURAL_TYPES = frozenset({
     "node_register", "node_heartbeat", "node_event",
     "node_kill_worker", "node_delete_object", "node_shutdown",
     "object_lookup", "pull_object", "pull_chunk",
+    "locate_object", "object_added", "object_removed", "bcast_plan",
 })
 
 
@@ -432,6 +433,13 @@ def loads_ex(data: bytes) -> tuple[dict, int]:
     {"type": "batch", "frames": [msg, ...]} preserving sub-frame
     order."""
     eng = _native_codec()
+    if (eng is None and len(data) >= _ZEROCOPY_MIN_BODY
+            and _native.frame_engine_enabled()):
+        # Large frames always take the C parser + zero-copy body view,
+        # codec mode notwithstanding — the decode mirror of the
+        # >=_ZEROCOPY_MIN_BODY emit rule: protobuf's FromString copies
+        # a multi-MB py_body (pull chunks!) just to hand it to pickle.
+        eng = _native
     if eng is not None:
         out = _native_loads_ex(eng, data)
         if out is not None:

@@ -276,6 +276,35 @@ def wait(object_refs: Sequence[ObjectRef], *, num_returns: int = 1,
             [by_id[i] for i in not_ready_ids])
 
 
+def broadcast(ref: ObjectRef, *, fanout: Optional[int] = None,
+              timeout: Optional[float] = None) -> dict:
+    """Distribute `ref`'s object to every alive node in a fanout tree
+    (``RAY_TPU_BCAST_FANOUT``, default 4): the source serves at most
+    `fanout` transfers and each completed puller immediately serves its
+    subtree, so a weight broadcast costs the producer O(fanout) instead
+    of O(nodes). Blocks until every node holds a copy (or `timeout`);
+    returns the tree stats (nodes, depth, failed, seconds). Objects
+    already resident everywhere return immediately."""
+    if not isinstance(ref, ObjectRef):
+        raise TypeError("broadcast() expects an ObjectRef, got "
+                        f"{type(ref).__name__}")
+    ctx = _context.get_ctx()
+    if hasattr(ctx, "broadcast_object"):
+        return ctx.broadcast_object(ref.object_id, fanout=fanout,
+                                    timeout=timeout)
+    # workers / remote drivers reach the coordinator over the wire;
+    # head-side exceptions come back as an error dict (job snapshots
+    # always carry "object_id") — re-raise so both paths share one
+    # contract
+    out = ctx.state_op("broadcast_object", object_id=ref.object_id,
+                       fanout=fanout, timeout=timeout)
+    if isinstance(out, dict) and "error" in out and "object_id" not in out:
+        if out.get("error_type") == "TimeoutError":
+            raise TimeoutError(out["error"])
+        raise RuntimeError(out["error"])
+    return out
+
+
 def kill(actor, *, no_restart: bool = True) -> None:
     from ray_tpu.actor import ActorHandle
     if not isinstance(actor, ActorHandle):

@@ -334,7 +334,10 @@ def env_decode(data: bytes):
         return None
     mtype = (data[view.type_off:view.type_off + view.type_len]
              if view.type_off >= 0 else b"")
-    body = (data[view.body_off:view.body_off + view.body_len]
+    # body as a zero-copy view: callers hand it straight to
+    # pickle.loads, and a bytes slice would copy multi-MB pull chunks
+    # a second time on every frame
+    body = (memoryview(data)[view.body_off:view.body_off + view.body_len]
             if view.body_off >= 0 else None)
     return (view.version, view.rid, mtype, body,
             view.fields_len if view.fields_off >= 0 else -1,

@@ -38,6 +38,9 @@ LABELS = [
     ("put_small_per_s", "put (small objects)"),
     ("put_gbps", "put throughput (8 MB)"),
     ("get_gbps", "get throughput (8 MB)"),
+    ("bcast_64mb_flat",
+     "broadcast 64 MB x 8 nodes, all-pull-from-source"),
+    ("bcast_64mb_tree", "broadcast 64 MB x 8 nodes, fanout tree"),
     ("shm_cycle_pooled_gbps", "shm put+free cycle, pooled (8 MB)"),
     ("shm_cycle_unpooled_gbps", "shm put+free cycle, unpooled (8 MB)"),
     ("wait_1k_refs", "wait on 1k refs"),
@@ -61,6 +64,13 @@ def _fmt_result(rec: dict) -> str:
             out += f" (channel speedup {rec['channel_speedup']}x)"
         if "native_speedup" in rec:
             out += f" (native speedup {rec['native_speedup']}x)"
+        if "source_serves" in rec:
+            # r8 broadcast columns: aggregate GB/s is per_second; the
+            # serve count is the tree property (source <= fanout)
+            out += (f" (source serves {rec['source_serves']}, "
+                    f"depth {rec.get('depth', '?')})")
+        if "tree_speedup" in rec:
+            out += f" (tree speedup {rec['tree_speedup']}x)"
         return out
     extras = {k: v for k, v in rec.items()
               if k not in ("n", "unit", "frames_per_task",

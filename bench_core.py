@@ -212,6 +212,24 @@ def main(as_json: bool = False) -> dict:
         results["drain_5k_native"]["per_second"]
         / results["drain_5k_nonative"]["per_second"], 2)
 
+    # ------------- tracing plane: trace-off vs trace-on 3k drain (r9)
+    # Machine-checks the "near-zero at default settings" claim: with
+    # tracing ON (the default) every task records its submit/queue/
+    # lease/recv/exec/put/done spans and task-plane frames carry 18
+    # bytes of trace context — throughput, frames/task, and head-CPU
+    # µs/task must stay within noise of the traced-off run.
+    os.environ["RAY_TPU_TRACE"] = "0"
+    try:
+        results["drain_3k_notrace"] = _drain_with_frames(3000)
+    finally:
+        os.environ.pop("RAY_TPU_TRACE", None)
+    results["drain_3k_trace"] = _drain_with_frames(3000)
+    _base = results["drain_3k_notrace"]["per_second"]
+    if _base:
+        results["drain_3k_trace"]["trace_overhead_pct"] = round(
+            (_base / results["drain_3k_trace"]["per_second"] - 1) * 100,
+            1)
+
     # ------------------- control-frame coalescing: off vs on (r6)
     # The OFF run goes first in its own runtime (workers inherit the
     # env at spawn); the ON run is the normal 5k-drain below, which

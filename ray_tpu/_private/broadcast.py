@@ -23,6 +23,7 @@ import time
 from typing import Optional
 
 from ray_tpu._private import protocol
+from ray_tpu._private import tracing_plane as _tp
 from ray_tpu._private.config import CONFIG as _CFG
 
 
@@ -60,6 +61,10 @@ class _Job:
         self.failed: set[str] = set()
         self.done = threading.Event()
         self.started = time.monotonic()
+        # tracing plane: (trace_id, span_id) of the coordinator's
+        # broadcast span; every BCAST_PLAN hop carries it so the
+        # cascade's per-node pulls stitch under one timeline root
+        self.trace: Optional[tuple] = None
 
     def snapshot(self) -> dict:
         return {
@@ -126,10 +131,13 @@ class BroadcastCoordinator:
         ok = False
         if conn is not None and rec.alive:
             try:
-                conn.send({"type": protocol.BCAST_PLAN,
-                           "object_id": job.object_id,
-                           "nbytes": job.nbytes,
-                           "source": self._describe(parent)})
+                plan = {"type": protocol.BCAST_PLAN,
+                        "object_id": job.object_id,
+                        "nbytes": job.nbytes,
+                        "source": self._describe(parent)}
+                if job.trace is not None:
+                    plan["_trace"] = job.trace
+                conn.send(plan)
                 ok = True
             except protocol.ConnectionClosed:
                 ok = False
@@ -157,6 +165,12 @@ class BroadcastCoordinator:
         """Distribute `object_id` to every alive agent node; blocks
         until all copies register (or timeout). Returns job stats.
         Concurrent broadcasts of one object join the active job."""
+        with _tp.span("bcast", "bcast:" + object_id[:16], root=True):
+            return self._broadcast_inner(object_id, fanout, timeout)
+
+    def _broadcast_inner(self, object_id: str,
+                         fanout: Optional[int] = None,
+                         timeout: Optional[float] = None) -> dict:
         fanout = max(1, int(fanout or _CFG.bcast_fanout))
         timeout = timeout if timeout is not None else _CFG.bcast_timeout_s
         rt = self._rt
@@ -196,6 +210,7 @@ class BroadcastCoordinator:
                     snap["timed_out"] = False   # same shape everywhere
                     return snap
                 job = _Job(object_id, nbytes, fanout, [source] + targets)
+                job.trace = _tp.wire_ctx()
                 self._jobs[object_id] = job
                 self.trees_built += 1
                 owner = True

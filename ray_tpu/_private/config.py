@@ -216,6 +216,19 @@ _define("bcast_fanout", 4,
 _define("bcast_timeout_s", 120.0,
         "Per-broadcast deadline: nodes still missing the object when "
         "it expires are reported as failed in the broadcast result.")
+_define("trace", True,
+        "Master switch for the distributed tracing plane (r9): span "
+        "emission into the per-process flight recorder and trace-"
+        "context propagation on the wire (Envelope trace_id/"
+        "parent_span fields, MINOR >= 2 peers). 0 disables both — "
+        "no spans are recorded and envelopes carry zero extra bytes "
+        "(proto3 omits unset fields).")
+_define("trace_ring", 4096,
+        "Per-process flight-recorder capacity in span events (each a "
+        "small tuple; 4096 ~ a few hundred KB). The ring wraps — "
+        "newest events win, the watermark keeps counting so drops are "
+        "visible. 0 disables recording (same effect as "
+        "RAY_TPU_TRACE=0).")
 _define("scheduler_locality", True,
         "Locality-aware node selection: prefer placing a task on a "
         "feasible node already holding the most argument bytes "

@@ -38,6 +38,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
 from ray_tpu._private import protocol
+from ray_tpu._private import tracing_plane as _tp
 from ray_tpu._private.config import CONFIG as _CFG
 from ray_tpu._private.object_store import (LocalStore, StoredObject,
                                            unlink_segment)
@@ -114,6 +115,7 @@ class NodeAgent:
         # node id for the same reason.
         import uuid as _uuid
         self.node_id = node_id or ("node_" + _uuid.uuid4().hex[:8])
+        _tp.set_role("agent", self.node_id)
         self.scheduler = Scheduler(
             _AgentFacade(self), dict(resources),
             ("127.0.0.1", port),   # workers are host-local: loopback
@@ -368,6 +370,9 @@ class NodeAgent:
                     # dedup hits, per-object serve counts — the head
                     # aggregates these in object_plane_stats
                     "object_plane": plane,
+                    # tracing plane (r9): watermark ONLY — events move
+                    # via the trace_dump pull, never on heartbeats
+                    "trace_watermark": _tp.recorder().watermark(),
                     **self.scheduler.heartbeat_snapshot(),
                 })
                 last_spo = spo          # only after a successful send
@@ -462,10 +467,48 @@ class NodeAgent:
         elif mtype == protocol.BCAST_PLAN:
             OBJECT_PLANE_STATS["bcast_plans"] += 1
             self._fetch_pool.submit(self._run_bcast_plan, msg)
+        elif mtype == protocol.TRACE_DUMP:
+            # collection fans out to this node's workers: run on a
+            # dedicated thread — never on the head connection's reader
+            # (it must keep reading the worker replies), and never on
+            # the fetch pool (its threads block up to bcast_timeout_s
+            # in object pulls — exactly when timelines get requested)
+            threading.Thread(target=self._trace_dump_reply,
+                             args=(conn, msg),
+                             name="rtpu-agent-trace-dump",
+                             daemon=True).start()
         elif mtype == protocol.NODE_SHUTDOWN:
             self.shutdown()
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)
+
+    def _trace_dump_reply(self, conn: protocol.Connection,
+                          msg: dict) -> None:
+        """Drain this node's recorders: the agent's own first (the
+        head keys its clock alignment off it), then each local
+        worker's, with worker clock offsets relative to THIS agent
+        (the head adds its agent offset transitively)."""
+        procs = [dict(_tp.dump(), offset_ns=0, node_id=self.node_id)]
+        # parallel fan-out under one shared deadline inside the
+        # head's collection budget (carried on the message; a margin
+        # is reserved for the reply hop): a few wedged workers must
+        # not push this node past the head's deadline and drop the
+        # whole node (incl. healthy workers) from the dump
+        budget = max(0.5, float(msg.get("timeout", 3.0)) - 1.0)
+        for wid, t0, t1, rep in _tp.fanout_dumps(
+                list(self.scheduler.worker_conns()), budget):
+            d = rep.get("dump")
+            if d:
+                procs.append(dict(
+                    d, node_id=self.node_id,
+                    offset_ns=_tp.rtt_offset(t0, t1, d["now_ns"])))
+        try:
+            # fresh clock sample AFTER the worker drain: the head
+            # derives this node's offset from it, and an entry-time
+            # sample would be stale by however long the drain took
+            conn.reply(msg, processes=procs, now_ns=_tp.now())
+        except protocol.ConnectionClosed:
+            pass
 
     def _run_bcast_plan(self, msg: dict) -> None:
         """Tree-broadcast leg: pull the object from the parent the head
@@ -478,8 +521,13 @@ class NodeAgent:
             self.send_event("object_at", object_id=oid,
                             nbytes=msg.get("nbytes", 0), addref=False)
             return
-        self._pull_mgr.pull(oid, prefer=msg.get("source"),
-                            timeout=_CFG.bcast_timeout_s)
+        # each tree hop is one span parented under the coordinator's
+        # broadcast span (envelope-carried), so the cascade's depth
+        # and stalls read straight off the timeline
+        with _tp.span("bcast", "hop:" + oid[:12],
+                      ctx=msg.get("_trace")):
+            self._pull_mgr.pull(oid, prefer=msg.get("source"),
+                                timeout=_CFG.bcast_timeout_s)
 
     # ------------------------------------------------ local connections
     def _accept_loop(self) -> None:
@@ -641,7 +689,8 @@ class NodeAgent:
     def _fetch_and_reply(self, conn, msg, oid: str,
                          wid: Optional[str]) -> None:
         try:
-            stored = self._fetch(oid, msg.get("timeout"))
+            stored = self._fetch(oid, msg.get("timeout"),
+                                 trace=msg.get("_trace"))
             if stored is not None:
                 conn.reply(msg, stored=stored)
             else:
@@ -652,8 +701,8 @@ class NodeAgent:
             if wid:
                 self.scheduler.worker_unblocked(wid)
 
-    def _fetch(self, oid: str,
-               timeout: Optional[float]) -> Optional[StoredObject]:
+    def _fetch(self, oid: str, timeout: Optional[float],
+               trace: Optional[tuple] = None) -> Optional[StoredObject]:
         """Local store (incl. spill restore), else head lookup, else
         pull-manager transfer from any holder. The head lookup BLOCKS
         head-side until the object exists somewhere or the timeout
@@ -685,7 +734,8 @@ class NodeAgent:
                 prefer = (loc if loc.get("node_id") != self.node_id
                           else None)
             stored = self._pull_mgr.pull(oid, prefer=prefer,
-                                         timeout=remaining)
+                                         timeout=remaining,
+                                         trace_ctx=trace)
             if stored is not None:
                 return stored
             # every source failed (holders died / evicted, or the only

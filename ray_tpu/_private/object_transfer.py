@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ray_tpu._private import protocol
+from ray_tpu._private import tracing_plane as _tp
 from ray_tpu._private.config import CONFIG as _CFG
 from ray_tpu._private.object_store import (StoredObject, _map_segment,
                                            guard_segments)
@@ -258,6 +259,11 @@ class PullServer:
     def _serve(self, conn: protocol.Connection, msg: dict,
                stored) -> None:
         oid = stored.object_id
+        # tracing plane: the serve span (pin + blob encode + session
+        # open) parents under the puller's envelope-carried pull span,
+        # putting the holder side of every transfer on the timeline
+        tr = msg.get(_tp.TRACE_KEY)
+        t_tr = _tp.recv_t0(msg)
         # Pin for the life of the session: the spill pass must not
         # unlink this object's segments (or evict the restored copy)
         # while chunks are still being read.
@@ -301,6 +307,10 @@ class PullServer:
                 self._serves_per_object.pop(
                     next(iter(self._serves_per_object)))
         OBJECT_PLANE_STATS["serves_started"] += 1
+        if t_tr is not None:
+            _tp.record("serve", "serve:" + oid[:16], t_tr, _tp.now(),
+                       tr[0], _tp.new_id(), tr[1],
+                       {"nbytes": len(blob)})
         nchunks = max(1, (len(blob) + CHUNK_BYTES - 1) // CHUNK_BYTES)
         try:
             conn.reply(msg, found=True, pull_id=pull_id, nchunks=nchunks,
@@ -351,8 +361,14 @@ def pull_object(conn: protocol.Connection, object_id: str,
             return None
         return max(0.1, deadline - time.monotonic())
 
-    meta = conn.request({"type": protocol.PULL_OBJECT,
-                         "object_id": object_id}, timeout=remaining())
+    def _open_msg() -> dict:
+        # stamped: the holder's serve span parents under the caller's
+        # pull span (PULL_CHUNKs stay unstamped — one span per
+        # session, not one per chunk)
+        return _tp.stamp({"type": protocol.PULL_OBJECT,
+                          "object_id": object_id})
+
+    meta = conn.request(_open_msg(), timeout=remaining())
     if not meta.get("found"):
         return None
     size = meta["size"]
@@ -395,9 +411,7 @@ def pull_object(conn: protocol.Connection, object_id: str,
                 OBJECT_PLANE_STATS["chunk_retries"] += 1
                 window.clear()
                 next_req = idx
-                meta = conn.request({"type": protocol.PULL_OBJECT,
-                                     "object_id": object_id},
-                                    timeout=remaining())
+                meta = conn.request(_open_msg(), timeout=remaining())
                 if not meta.get("found") or meta["size"] != size:
                     return None          # gone, or a different incarnation
                 continue

@@ -29,7 +29,8 @@ import time
 from typing import Any, Callable, Optional
 
 from ray_tpu import native
-from ray_tpu._private.wire import (BATCH_MIN_MINOR, BATCH_TYPE, WIRE_MAJOR,
+from ray_tpu._private.wire import (BATCH_MIN_MINOR, BATCH_TYPE, TRACE_KEY,
+                                   TRACE_MIN_MINOR, WIRE_MAJOR,
                                    WireVersionError, dumps, dumps_batch,
                                    encode_batch_parts, encode_frame_parts,
                                    loads_ex)
@@ -66,6 +67,9 @@ REPLY = "reply"                  # either (generic reply)
 STATE_OP = "state_op"            # worker -> driver: state/metrics queries
 DECREF_BATCH = "decref_batch"    # worker -> driver: N ref-count releases
 BATCH = BATCH_TYPE               # either: coalesced sub-frames (MINOR>=1)
+TRACE_DUMP = "trace_dump"        # collector -> any: drain the peer's
+                                 #   flight recorder (reply: dump/processes
+                                 #   + monotonic now for clock alignment)
 
 # ---- multi-host: node agent <-> head (reference raylet <-> GCS,
 # gcs_node_manager.h:62 HandleRegisterNode; ray_syncer.h:88 resource
@@ -303,6 +307,16 @@ class Connection:
         v = self.peer_wire_version
         return v // 100 == WIRE_MAJOR and v % 100 >= BATCH_MIN_MINOR
 
+    def _peer_speaks_trace(self) -> bool:
+        """Whether trace context may ride this connection's envelopes.
+        Unknown (0: nothing received yet) counts as yes — trace fields
+        are SKIPPABLE unknown fields to any proto3 peer, so the worst
+        case is a few wasted bytes on the first frames; once an older
+        MINOR is observed, the sender stops spending them."""
+        v = self.peer_wire_version
+        return v == 0 or (v // 100 == WIRE_MAJOR
+                          and v % 100 >= TRACE_MIN_MINOR)
+
     def _emit_locked(self, frames: list[dict]) -> None:
         """Encode + write a group of frames as ONE socket write: a
         single BatchFrame envelope when the peer negotiated batch
@@ -313,6 +327,12 @@ class Connection:
         released, and a Python-plane frame's pickled body goes from
         the pickler to the kernel with zero copies; the fallback joins
         and sendall()s. Caller holds _send_lock."""
+        if not self._peer_speaks_trace():
+            # old-wire peer: strip trace context rather than spend
+            # bytes it will skip (copies, not mutation — callers may
+            # reuse their message dicts)
+            frames = [({k: v for k, v in m.items() if k != TRACE_KEY}
+                       if TRACE_KEY in m else m) for m in frames]
         eng_on = native.frame_engine_enabled()
         if len(frames) > 1 and self._peer_speaks_batch():
             parts = (encode_batch_parts(frames) if eng_on

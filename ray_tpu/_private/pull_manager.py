@@ -31,6 +31,7 @@ import time
 from typing import Callable, Iterable, Optional
 
 from ray_tpu._private import protocol
+from ray_tpu._private import tracing_plane as _tp
 from ray_tpu._private.config import CONFIG as _CFG
 from ray_tpu._private.object_transfer import (OBJECT_PLANE_STATS,
                                               PullBudgetExceeded,
@@ -100,12 +101,18 @@ class PullManager:
 
     # ------------------------------------------------------------ api
     def pull(self, object_id: str, prefer: Optional[dict] = None,
-             timeout: Optional[float] = 60.0) -> Optional[StoredObject]:
+             timeout: Optional[float] = 60.0,
+             trace_ctx: Optional[tuple] = None) -> Optional[StoredObject]:
         """Fetch `object_id` into the local store and return it (None
         on timeout/no-source). Concurrent calls for one object share a
         single transfer; `prefer` (an opaque source hint passed through
         to sources_fn, e.g. a broadcast parent) is honored by the
-        winning transfer only."""
+        winning transfer only. `trace_ctx` — an explicit
+        (trace_id, parent_span), else the calling thread's current —
+        puts the transfer on the tracing-plane timeline: the winner
+        records one "pull" span and stamps the PULL_OBJECT message so
+        the holder's serve span parents under it (joiners record
+        nothing; they did no transfer work)."""
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
         stored = self._store.get_stored(object_id, timeout=0)
@@ -128,7 +135,10 @@ class PullManager:
             # object may have sealed locally through another path)
             return self._store.get_stored(object_id, timeout=0)
         try:
-            flight.result = self._transfer(object_id, prefer, deadline)
+            with _tp.span("pull", "pull:" + object_id[:16],
+                          ctx=trace_ctx):
+                flight.result = self._transfer(object_id, prefer,
+                                               deadline)
         finally:
             with self._lock:
                 self._inflight.pop(object_id, None)

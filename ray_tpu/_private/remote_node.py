@@ -49,6 +49,10 @@ class RemoteNodeHandle:
         # object-plane counters (r8: transfers/serves/dedup/bytes) as
         # of the last heartbeat — aggregated by object_plane_stats
         self.object_plane: dict = {}
+        # flight-recorder watermark as of the last heartbeat (r9
+        # tracing plane: heartbeats carry ONLY the watermark; events
+        # move via the trace_dump pull) — surfaced by trace_stats
+        self.trace_watermark = 0
         self._dead = False
 
     # ------------------------------------------------------- heartbeat
@@ -63,6 +67,7 @@ class RemoteNodeHandle:
             # agent-process frame counters (r7 telemetry; {} from
             # pre-r7 agents) — debug surface for per-node wire load
             self.wire_stats = dict(msg.get("wire", {}))
+            self.trace_watermark = int(msg.get("trace_watermark", 0))
             op = dict(msg.get("object_plane", {}))
             if op:
                 # serves_per_object rides heartbeats only when it

@@ -25,6 +25,7 @@ log = logging.getLogger(__name__)
 
 from ray_tpu._private import context as _context
 from ray_tpu._private import protocol
+from ray_tpu._private import tracing_plane as _tp
 from ray_tpu._private.controller import (ALIVE, DEAD, PENDING, RESTARTING,
                                          Controller)
 from ray_tpu._private.object_store import LocalStore, StoredObject, deserialize
@@ -138,6 +139,7 @@ class Runtime(_context.BaseContext):
                                      is_head=True,
                                      labels=self._head_labels)
         self.head_node_id = head.node_id
+        _tp.set_role("driver", self.head_node_id)
         # Object plane v2: the head's own pull manager (deduped,
         # bounded, multi-source fetches from agent holders) and the
         # tree-broadcast coordinator, driven by directory add events.
@@ -513,6 +515,19 @@ class Runtime(_context.BaseContext):
                     self.controller.pubsub.add_waiter(
                         kwargs["channel"], kwargs.get("cursor", 0),
                         float(kwargs["timeout"]), _reply)
+                elif msg["op"] == "trace_dump":
+                    # fans TRACE_DUMP out and WAITS for replies — one
+                    # of which may arrive on THIS reader thread (the
+                    # requesting worker's own dump): never collect on
+                    # a connection reader (same rule as broadcast)
+                    def _td(conn=conn, msg=msg, kwargs=kwargs):
+                        try:
+                            conn.reply(msg, value=self._trace_dump(
+                                timeout=kwargs.get("timeout", 5.0)))
+                        except protocol.ConnectionClosed:
+                            pass
+                    threading.Thread(target=_td, name="rtpu-trace-dump",
+                                     daemon=True).start()
                 elif msg["op"] == "broadcast_object":
                     # blocks until the whole tree completes — never on
                     # a connection reader thread
@@ -581,6 +596,14 @@ class Runtime(_context.BaseContext):
             conn.reply(msg, ok=True)
 
     def _on_task_done(self, conn: protocol.Connection, msg: dict) -> None:
+        t_tr = _tp.recv_t0(msg)
+        try:
+            self._on_task_done_inner(conn, msg)
+        finally:
+            self._record_done(msg, t_tr)
+
+    def _on_task_done_inner(self, conn: protocol.Connection,
+                            msg: dict) -> None:
         results: list[StoredObject] = msg.get("results", [])
         for stored in results:
             self._seal_contained(stored.object_id, stored.contained_ids)
@@ -693,6 +716,14 @@ class Runtime(_context.BaseContext):
         """NODE_TASK_DONE: the control half of a remote TASK_DONE. Bulk
         results either arrived inline (small / errors) or stayed in the
         agent's store with a location registered here."""
+        t_tr = _tp.recv_t0(msg)
+        try:
+            self._on_node_task_done_inner(conn, msg)
+        finally:
+            self._record_done(msg, t_tr)
+
+    def _on_node_task_done_inner(self, conn: protocol.Connection,
+                                 msg: dict) -> None:
         node_id = msg["node_id"]
         proxy = self._proxy_for(node_id)
         for stored in msg.get("inline", []):
@@ -1057,6 +1088,70 @@ class Runtime(_context.BaseContext):
             "broadcast": self.bcast.stats(),
         }
 
+    # ================= tracing plane: collection =================
+    def _trace_dump(self, timeout: float = 5.0) -> dict:
+        """Drain every process's flight recorder: the head's own, each
+        local worker's, and each agent's (the agent fans out to ITS
+        workers and replies with the whole node). Pull, not push —
+        heartbeats only carry watermarks. Peer timestamps are aligned
+        onto the head's monotonic clock via the request/reply RTT
+        midpoint (tracing_plane.rtt_offset); an agent's workers are
+        aligned transitively (their offsets are relative to the
+        agent)."""
+        procs = [dict(_tp.dump(), offset_ns=0,
+                      node_id=self.head_node_id)]
+        targets: list[tuple] = []    # ((kind, node_id), connection)
+        sched = self.scheduler
+        if sched is not None:
+            for wid, conn in sched.worker_conns():
+                targets.append((("worker", self.head_node_id), conn))
+        for node in self.cluster.alive_nodes():
+            conn = getattr(node.scheduler, "conn", None)
+            # an agent that negotiated MINOR < 2 silently drops the
+            # unknown TRACE_DUMP type and would burn the shared
+            # deadline waiting for a reply that can never come
+            if conn is not None and conn._peer_speaks_trace():
+                targets.append((("agent", node.node_id), conn))
+        for (kind, nid), t0, t1, rep in _tp.fanout_dumps(
+                targets, timeout, extra={"timeout": timeout}):
+            if kind == "worker":
+                d = rep.get("dump")
+                if d:
+                    procs.append(dict(
+                        d, node_id=nid,
+                        offset_ns=_tp.rtt_offset(t0, t1, d["now_ns"])))
+            else:
+                # the agent re-samples its clock just before replying
+                # (now_ns field), AFTER its worker drain — an RTT-
+                # midpoint estimate over the whole exchange would be
+                # skewed by however long that drain took
+                if "now_ns" in rep:
+                    agent_off = int(rep["now_ns"]) - t1
+                else:
+                    agent_off = None
+                for d in rep.get("processes") or ():
+                    if agent_off is None:   # agent's own dump is first
+                        agent_off = _tp.rtt_offset(
+                            t0, t1, d.get("now_ns", 0))
+                    procs.append(dict(
+                        d, node_id=nid,
+                        offset_ns=(int(d.get("offset_ns", 0))
+                                   + agent_off)))
+        return {"processes": procs}
+
+    def _trace_stats(self) -> dict:
+        rec = _tp.recorder()
+        nodes = {}
+        for n in self.cluster.alive_nodes():
+            wm = getattr(n.scheduler, "trace_watermark", None)
+            if wm is not None:
+                nodes[n.node_id] = wm
+        return {"enabled": _tp.enabled(),
+                "head": {"watermark": rec.watermark(),
+                         "capacity": rec.capacity,
+                         "dropped": rec.dropped()},
+                "nodes": nodes}
+
     def _delete_everywhere(self, oid: str) -> None:
         """Deletion fan-out: local store + every agent holding a copy.
         Releases the counts this object held on refs pickled inside it
@@ -1213,12 +1308,55 @@ class Runtime(_context.BaseContext):
         if self.controller.decref(object_id):
             self._delete_everywhere(object_id)
 
+    # ---- tracing plane (r9) ----
+    def _stamp_trace(self, spec) -> Optional[tuple]:
+        """Open the spec's submit span: join the caller's active trace
+        (or the trace a relaying worker already stamped on the spec;
+        else start a fresh one) and point the spec's parent_span at
+        this span, so downstream scheduler/worker spans chain under
+        it. Returns (trace_id, span_id, parent, t0_ns) for
+        _record_submit, or None when tracing is off."""
+        if not _tp.enabled():
+            return None
+        tid = getattr(spec, "trace_id", 0)   # pre-r9-pickled specs
+        if tid:                              # have no trace fields
+            parent = getattr(spec, "parent_span", 0)   # relayed
+        else:
+            cur = _tp.current()
+            tid = cur[0] if cur else _tp.new_id()
+            parent = cur[1] if cur else 0
+            spec.trace_id = tid
+        sid = _tp.new_id()
+        spec.parent_span = sid
+        return (tid, sid, parent, _tp.now())
+
+    @staticmethod
+    def _record_submit(tr: Optional[tuple], spec) -> None:
+        if tr is not None:
+            tid, sid, parent, t0 = tr
+            _tp.record("submit", spec.name or spec.task_id, t0,
+                       _tp.now(), tid, sid, parent)
+
+    @staticmethod
+    def _record_done(msg: dict, t0: Optional[int]) -> None:
+        """TASK_DONE-processing span, parented under the worker's exec
+        span via the envelope-carried trace context."""
+        if t0 is None:
+            return
+        tr = msg.get("_trace")
+        if tr:
+            _tp.record("done", msg.get("name", "") or
+                       str(msg.get("task_id", "")), t0, _tp.now(),
+                       tr[0], _tp.new_id(), tr[1])
+
     def submit_spec(self, spec: TaskSpec) -> list[str]:
+        tr = self._stamp_trace(spec)
         for oid in spec.pinned_refs:
             self.controller.pin(oid)
         self.controller.record_lineage(spec)
         self.controller.record_task_event(spec.task_id, spec.name, "PENDING")
         self.cluster.submit(spec)
+        self._record_submit(tr, spec)
         return spec.return_ids
 
     submit_task = submit_spec
@@ -1244,6 +1382,14 @@ class Runtime(_context.BaseContext):
 
     def submit_actor_task_spec(self, actor_id: str,
                                spec: ActorTaskSpec) -> list[str]:
+        tr = self._stamp_trace(spec)
+        try:
+            return self._submit_actor_task_inner(actor_id, spec)
+        finally:
+            self._record_submit(tr, spec)
+
+    def _submit_actor_task_inner(self, actor_id: str,
+                                 spec: ActorTaskSpec) -> list[str]:
         for oid in spec.pinned_refs:
             self.controller.pin(oid)
         rec = self.controller.get_actor(actor_id)
@@ -1435,6 +1581,11 @@ class Runtime(_context.BaseContext):
             return self.broadcast_object(kwargs["object_id"],
                                          fanout=kwargs.get("fanout"),
                                          timeout=kwargs.get("timeout"))
+        if op == "trace_dump":
+            return self._trace_dump(
+                timeout=kwargs.get("timeout", 5.0))
+        if op == "trace_stats":
+            return self._trace_stats()
         if op == "waiter_stats":
             return self.waiters.stats()
         if op == "pubsub_poll":

@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ray_tpu._private import protocol
+from ray_tpu._private import tracing_plane as _tp
 from ray_tpu._private.runtime_env import has_container
 from ray_tpu._private.specs import ActorSpec, ActorTaskSpec, TaskSpec
 
@@ -1070,7 +1071,7 @@ class Scheduler:
                                 f"({spawn_err}); will retry\n")
                 break                 # no free worker: stop the sweep
             self._pending.remove(spec)
-            self._queued_at.pop(id(spec), None)
+            t_enq = self._queued_at.pop(id(spec), None)
             self._demand_sub(spec)
             if charged:
                 acquire(pool, need)
@@ -1090,11 +1091,38 @@ class Scheduler:
                 worker.tasks[spec.task_id] = spec
                 worker.task_res[spec.task_id] = (need, pg_key, charged)
                 self._rt.on_task_dispatched(spec, worker.worker_id)
-                outbox.append((worker.conn,
-                               {"type": protocol.TASK, "spec": spec}))
+                msg = {"type": protocol.TASK, "spec": spec}
+                # getattr: a spec pickled by a pre-r9 peer has no
+                # trace fields (dataclasses pickle via __dict__)
+                if _tp.enabled() and getattr(spec, "trace_id", 0):
+                    self._record_dispatch_spans(spec, worker, t_enq,
+                                                charged, msg)
+                outbox.append((worker.conn, msg))
             dispatched += 1
         self._send_dispatch_outbox(outbox)
         return dispatched > 0
+
+    def _record_dispatch_spans(self, spec, worker: WorkerRec,
+                               t_enq: Optional[float],
+                               charged: bool, msg: dict) -> None:
+        """Tracing plane (r9): the scheduler's two spans for a traced
+        task — "queue" (enqueue → this sweep, derived from the
+        _queued_at timestamp the queue already keeps, so enqueue pays
+        nothing) and "lease" (the dispatch decision; charged=False
+        marks a pipelined ride on a BUSY worker's grant). The TASK
+        message carries (trace_id, lease span) so the worker's recv/
+        exec spans chain under it across the process boundary."""
+        t_now = _tp.now()
+        t0 = int(t_enq * 1e9) if t_enq is not None else t_now
+        sid_q = _tp.new_id()
+        _tp.record("sched", "queue", t0, t_now, spec.trace_id, sid_q,
+                   getattr(spec, "parent_span", 0),
+                   {"node": self.node_id})
+        sid_d = _tp.new_id()
+        _tp.record("sched", "lease", t_now, _tp.now(), spec.trace_id,
+                   sid_d, sid_q,
+                   {"worker": worker.worker_id, "charged": charged})
+        msg["_trace"] = (spec.trace_id, sid_d)
 
     def _fail_if_pg_removed(self, spec) -> None:
         """A queued spec whose placement group was removed can never run;
@@ -1132,8 +1160,18 @@ class Scheduler:
             rec = self._workers.get(actor_worker_id)
             if rec is None or rec.state == DEAD or rec.conn is None:
                 return False
+            msg = {"type": protocol.ACTOR_TASK, "spec": spec}
+            if _tp.enabled() and getattr(spec, "trace_id", 0):
+                # actor tasks skip the queue: one "lease" span, no
+                # queue span (there is no queueing head-side)
+                sid = _tp.new_id()
+                t0 = _tp.now()
+                _tp.record("sched", "lease", t0, t0, spec.trace_id,
+                           sid, getattr(spec, "parent_span", 0),
+                           {"worker": actor_worker_id})
+                msg["_trace"] = (spec.trace_id, sid)
             try:
-                rec.conn.send({"type": protocol.ACTOR_TASK, "spec": spec})
+                rec.conn.send(msg)
                 return True
             except protocol.ConnectionClosed:
                 return False
@@ -1144,6 +1182,15 @@ class Scheduler:
                 if rec.actor_id == actor_id and rec.state != DEAD:
                     return rec.worker_id
         return None
+
+    def worker_conns(self) -> list[tuple]:
+        """(worker_id, connection) for every live registered worker —
+        the tracing plane's TRACE_DUMP fan-out reads recorders over
+        these (head- and agent-side alike)."""
+        with self._lock:
+            return [(r.worker_id, r.conn)
+                    for r in self._workers.values()
+                    if r.conn is not None and r.state != DEAD]
 
     # ---- introspection ----
     def stats(self) -> dict:

@@ -107,6 +107,11 @@ class TaskSpec:
     runtime_env: Optional[dict] = None
     # bookkeeping (filled by runtime)
     pinned_refs: list[str] = field(default_factory=list)
+    # tracing plane (r9): the trace this task belongs to and the span
+    # it parents under (the submit span); 0 = untraced. Travels with
+    # the pickled spec so scheduler/worker spans stitch cross-process.
+    trace_id: int = 0
+    parent_span: int = 0
 
 
 @dataclass
@@ -144,6 +149,9 @@ class ActorTaskSpec:
     retries_used: int = 0
     name: str = ""
     pinned_refs: list[str] = field(default_factory=list)
+    # tracing plane (r9): see TaskSpec
+    trace_id: int = 0
+    parent_span: int = 0
 
 
 def pickle_callable(fn: Any) -> tuple[str, bytes]:

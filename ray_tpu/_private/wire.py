@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import io
 import pickle
+import struct
 from typing import Any, Optional
 
 import cloudpickle
@@ -49,7 +50,9 @@ from ray_tpu import native as _native
 from ray_tpu._private import wire_pb2 as pb
 
 WIRE_MAJOR = 1
-WIRE_MINOR = 1          # 1: BatchFrame coalescing (negotiated by peers)
+WIRE_MINOR = 2          # 1: BatchFrame coalescing (negotiated by peers)
+                        # 2: Envelope trace_id/parent_span (tracing
+                        #    plane; old peers skip unknown fields)
 WIRE_VERSION = WIRE_MAJOR * 100 + WIRE_MINOR
 
 # First MINOR that understands a type=="batch" Envelope carrying a
@@ -57,6 +60,20 @@ WIRE_VERSION = WIRE_MAJOR * 100 + WIRE_MINOR
 # (Connection.peer_wire_version) before emitting one.
 BATCH_MIN_MINOR = 1
 BATCH_TYPE = "batch"
+
+# First MINOR whose Envelope schema has the trace_id/parent_span
+# fields. Unlike BatchFrame these are SKIPPABLE by any proto3 peer
+# (unknown fields), so the negotiation only avoids spending bytes on a
+# peer that demonstrated an older MINOR (protocol.Connection strips
+# the key before encode in that case).
+TRACE_MIN_MINOR = 2
+
+# Message-dict carrier for the Envelope trace fields: senders attach
+# msg["_trace"] = (trace_id, parent_span); codecs move it between the
+# dict and the proto fields so it never rides the pickled body. The
+# constant lives with the tracing plane (which owns stamp()/recv_t0());
+# re-exported here for the codec/protocol layer.
+from ray_tpu._private.tracing_plane import TRACE_KEY  # noqa: E402
 
 _MAX_ITEMS = 64      # larger lists/dicts -> one pickled leaf
 _MAX_DEPTH = 6
@@ -176,16 +193,20 @@ def _fill_envelope(env: "pb.Envelope", msg: dict) -> None:
     env.version = WIRE_VERSION
     env.type = mtype
     env.rid = msg.get("rid", 0)
+    tr = msg.get(TRACE_KEY)
+    if tr is not None:
+        env.trace_id = tr[0]
+        env.parent_span = tr[1]
     if mtype in STRUCTURAL_TYPES:
         fields = env.fields
         fields.SetInParent()
         for k, val in msg.items():
-            if k == "type" or k == "rid":
+            if k == "type" or k == "rid" or k == TRACE_KEY:
                 continue
             _encode_value(val, fields.fields[k], 0)
     else:
         rest = {k: v for k, v in msg.items()
-                if k != "type" and k != "rid"}
+                if k != "type" and k != "rid" and k != TRACE_KEY}
         if rest:
             env.py_body = _pickle(rest)
 
@@ -238,6 +259,23 @@ def _native_codec():
     return eng
 
 
+_FIXED64 = struct.Struct("<Q")
+
+
+def _trace_tail(tr) -> bytes:
+    """Protobuf bytes for the Envelope trace fields (field 7/8,
+    fixed64) — appended after the py_body field, which matches the
+    canonical ascending-field-number serialization exactly, so the C
+    emit paths stay byte-identical to the protobuf codec. Zero values
+    are omitted like proto3 does."""
+    out = b""
+    if tr[0]:
+        out += b"\x39" + _FIXED64.pack(tr[0])
+    if tr[1]:
+        out += b"\x41" + _FIXED64.pack(tr[1])
+    return out
+
+
 def _encode_one(msg: dict, eng=None) -> bytes:
     """Serialize ONE message to Envelope bytes (never a batch)."""
     mtype = msg.get("type", "")
@@ -245,10 +283,12 @@ def _encode_one(msg: dict, eng=None) -> bytes:
         eng = _native_codec()
     if eng is not None and mtype not in STRUCTURAL_TYPES:
         rest = {k: v for k, v in msg.items()
-                if k != "type" and k != "rid"}
+                if k != "type" and k != "rid" and k != TRACE_KEY}
         body = _pickle(rest) if rest else b""
-        return eng.env_encode(WIRE_VERSION, mtype.encode(),
+        data = eng.env_encode(WIRE_VERSION, mtype.encode(),
                               msg.get("rid", 0), body)
+        tr = msg.get(TRACE_KEY)
+        return data + _trace_tail(tr) if tr is not None else data
     env = pb.Envelope()
     _fill_envelope(env, msg)
     return env.SerializeToString()
@@ -303,11 +343,14 @@ def encode_frame_parts(msg: dict, eng=None) -> list[bytes]:
     mtype = msg.get("type", "")
     if mtype in STRUCTURAL_TYPES or mtype == BATCH_TYPE:
         return [dumps(msg)]
-    rest = {k: v for k, v in msg.items() if k != "type" and k != "rid"}
+    tr = msg.get(TRACE_KEY)
+    tail = _trace_tail(tr) if tr is not None else b""
+    rest = {k: v for k, v in msg.items()
+            if k != "type" and k != "rid" and k != TRACE_KEY}
     if not rest:
         return [dumps(msg)] if eng is None else [
             eng.env_encode_header(WIRE_VERSION, mtype.encode(),
-                                  msg.get("rid", 0), 0, 0)]
+                                  msg.get("rid", 0), 0, 0) + tail]
     body = _pickle(rest)
     zero_copy = (eng is not None
                  or (len(body) >= _ZEROCOPY_MIN_BODY
@@ -318,10 +361,13 @@ def encode_frame_parts(msg: dict, eng=None) -> list[bytes]:
         env.type = mtype
         env.rid = msg.get("rid", 0)
         env.py_body = body
+        if tr is not None:
+            env.trace_id = tr[0]
+            env.parent_span = tr[1]
         return [env.SerializeToString()]
     hdr = _native.env_encode_header(WIRE_VERSION, mtype.encode(),
                                     msg.get("rid", 0), 0x2A, len(body))
-    return [hdr, body]
+    return [hdr, body, tail] if tail else [hdr, body]
 
 
 def encode_batch_parts(msgs: list[dict], eng=None) -> list[bytes]:
@@ -357,6 +403,8 @@ def _decode_envelope(env: "pb.Envelope") -> dict:
     msg["type"] = env.type
     if env.rid:
         msg["rid"] = env.rid
+    if env.trace_id or env.parent_span:
+        msg[TRACE_KEY] = (env.trace_id, env.parent_span)
     return msg
 
 
@@ -368,7 +416,7 @@ def _native_decode_one(eng, data: bytes) -> Optional[dict]:
     view = eng.env_decode(data)
     if view is None:
         return None
-    _, rid, tbytes, body, fields_len, _, _ = view
+    _, rid, tbytes, body, fields_len, _, _, trace_id, parent_span = view
     if body:
         msg = pickle.loads(body)
     elif fields_len > 0:
@@ -381,6 +429,8 @@ def _native_decode_one(eng, data: bytes) -> Optional[dict]:
         return None
     if rid:
         msg["rid"] = rid
+    if trace_id or parent_span:
+        msg[TRACE_KEY] = (trace_id, parent_span)
     return msg
 
 
@@ -389,7 +439,8 @@ def _native_loads_ex(eng, data: bytes) -> Optional[tuple[dict, int]]:
     view = eng.env_decode(data)
     if view is None:
         return None
-    version, rid, tbytes, body, fields_len, batch_off, batch_len = view
+    (version, rid, tbytes, body, fields_len, batch_off, batch_len,
+     trace_id, parent_span) = view
     if version // 100 != WIRE_MAJOR:
         raise WireVersionError(
             f"peer wire version {version} is incompatible with "
@@ -423,6 +474,8 @@ def _native_loads_ex(eng, data: bytes) -> Optional[tuple[dict, int]]:
     msg["type"] = mtype
     if rid:
         msg["rid"] = rid
+    if trace_id or parent_span:
+        msg[TRACE_KEY] = (trace_id, parent_span)
     return msg, version
 
 

@@ -26,6 +26,7 @@ import cloudpickle
 
 from ray_tpu._private import context as _context
 from ray_tpu._private import protocol
+from ray_tpu._private import tracing_plane as _tp
 from ray_tpu._private.object_store import StoredObject, deserialize, serialize
 from ray_tpu._private.refs import ObjectRef
 from ray_tpu._private.specs import (ActorSpec, ActorTaskSpec, RefMarker,
@@ -44,9 +45,13 @@ class WorkerContext(_context.BaseContext):
 
     # ---- object plane ----
     def put(self, value: Any) -> ObjectRef:
+        with _tp.span("worker", "put"):
+            return self._put_inner(value)
+
+    def _put_inner(self, value: Any) -> ObjectRef:
         stored = serialize(value)
-        rep = self.conn.request({"type": protocol.PUT_OBJECT,
-                                 "stored": stored})
+        rep = self.conn.request(_tp.stamp(
+            {"type": protocol.PUT_OBJECT, "stored": stored}))
         if rep.get("pressure"):
             # store over cap and fully pinned: self-throttle the
             # producer (create-queueing backpressure applied in the
@@ -67,9 +72,11 @@ class WorkerContext(_context.BaseContext):
 
     def _get_one(self, oid: str, timeout):
         for attempt in (0, 1):
-            reply = self.conn.request(
+            # stamped: the serving side (head/agent) parents its pull
+            # spans under this get's span — arg pulls join the timeline
+            reply = self.conn.request(_tp.stamp(
                 {"type": protocol.GET_OBJECT, "object_id": oid,
-                 "timeout": timeout})
+                 "timeout": timeout}))
             if reply.get("timeout") or reply.get("stored") is None:
                 raise GetTimeoutError(f"get() timed out waiting for {oid}")
             stored: StoredObject = reply["stored"]
@@ -123,8 +130,14 @@ class WorkerContext(_context.BaseContext):
         if spec.func_id not in self._sent_funcs:
             fb = func_bytes
             self._sent_funcs.add(spec.func_id)
-        self.conn.request({"type": protocol.SUBMIT, "spec": spec,
-                           "func_bytes": fb})
+        # nested submission inside a traced task: the child task's
+        # trace chains under this worker-side submit span (the head's
+        # own submit span then chains under it in turn)
+        with _tp.span("submit", spec.name or spec.task_id) as tr:
+            if tr is not None:
+                spec.trace_id, spec.parent_span = tr
+            self.conn.request({"type": protocol.SUBMIT, "spec": spec,
+                               "func_bytes": fb})
         return spec.return_ids
 
     def create_actor(self, spec: ActorSpec, class_bytes: bytes = None) -> str:
@@ -138,8 +151,11 @@ class WorkerContext(_context.BaseContext):
 
     def submit_actor_task(self, actor_id: str,
                           spec: ActorTaskSpec) -> list[str]:
-        self.conn.request({"type": protocol.SUBMIT_ACTOR_TASK,
-                           "actor_id": actor_id, "spec": spec})
+        with _tp.span("submit", spec.name or spec.task_id) as tr:
+            if tr is not None:
+                spec.trace_id, spec.parent_span = tr
+            self.conn.request({"type": protocol.SUBMIT_ACTOR_TASK,
+                               "actor_id": actor_id, "spec": spec})
         return spec.return_ids
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
@@ -298,10 +314,12 @@ class WorkerExecutor:
     def handle(self, conn: protocol.Connection, msg: dict) -> None:
         mtype = msg["type"]
         if mtype == protocol.TASK:
+            spec = msg["spec"]
+            self._stamp_recv(spec, msg)
             with self._queue_lock:
-                self._queued_tasks.add(msg["spec"].task_id)
+                self._queued_tasks.add(spec.task_id)
                 self._inflight += 1
-            self._pool.submit(self._run_task, msg["spec"])
+            self._pool.submit(self._run_task, spec)
         elif mtype == protocol.ACTOR_CREATE:
             spec: ActorSpec = msg["spec"]
             if spec.max_concurrency > 1:
@@ -311,6 +329,7 @@ class WorkerExecutor:
             self._pool.submit(self._create_actor, spec)
         elif mtype == protocol.ACTOR_TASK:
             aspec: ActorTaskSpec = msg["spec"]
+            self._stamp_recv(aspec, msg)
             with self._queue_lock:
                 self._inflight += 1
             method = getattr(type(self._actor), aspec.method_name, None) \
@@ -340,10 +359,26 @@ class WorkerExecutor:
                 else:
                     ok = False
             conn.reply(msg, ok=ok)
+        elif mtype == protocol.TRACE_DUMP:
+            conn.reply(msg, dump=_tp.dump())
         elif mtype == protocol.SHUTDOWN:
             self.stop_event.set()
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)
+
+    @staticmethod
+    def _stamp_recv(spec, msg: dict) -> None:
+        """Note message-arrival time and re-parent the spec under the
+        scheduler's envelope-carried lease span, so the exec spans
+        chain driver → scheduler → worker (the spec's own pickled
+        parent is the submit span — the right fallback when the lease
+        hop was emitted by an old peer or with tracing off there)."""
+        tid = getattr(spec, "trace_id", 0)   # pre-r9-pickled specs
+        if tid and _tp.enabled():            # have no trace fields
+            tr = msg.get("_trace")
+            if tr and tr[0] == tid:
+                spec.parent_span = tr[1]
+            spec._recv_ns = _tp.now()
 
     # ---- worker-side task events ----
     def _record_event(self, task_id: str, name: str, state: str,
@@ -416,6 +451,47 @@ class WorkerExecutor:
             threading.Thread(target=self._loop.run_forever,
                              name="rtpu-actor-loop", daemon=True).start()
 
+    # ---- tracing plane (r9) ----
+    @staticmethod
+    def _open_exec_span(spec, set_tls: bool = True):
+        """Start the worker's span pair for a traced spec: a "recv"
+        span covering message-arrival → execution-start (the worker-
+        local FIFO queue time, which depth>1 pipelining makes real),
+        then the exec span whose id the TASK_DONE will carry. Returns
+        opaque state for _close_exec_span, or None when untraced."""
+        tid = getattr(spec, "trace_id", 0)
+        if not tid or not _tp.enabled():
+            return None
+        t_start = _tp.now()
+        parent = getattr(spec, "parent_span", 0)
+        t_recv = getattr(spec, "_recv_ns", None)
+        if t_recv is not None:
+            sid_r = _tp.new_id()
+            _tp.record("worker", "recv", t_recv, t_start, tid, sid_r,
+                       parent)
+            parent = sid_r
+        exec_sid = _tp.new_id()
+        if set_tls:
+            # nested gets/puts/submissions made by user code parent
+            # under the exec span (async actor methods skip this: the
+            # event loop interleaves coroutines on one thread)
+            _tp.set_current(tid, exec_sid)
+        return (tid, exec_sid, parent, t_start, set_tls)
+
+    @staticmethod
+    def _close_exec_span(tctx, spec, error: bool):
+        """Record the exec span; returns the (trace_id, span_id) pair
+        the TASK_DONE message should carry, or None."""
+        if tctx is None:
+            return None
+        tid, exec_sid, parent, t_start, set_tls = tctx
+        _tp.record("worker", "exec:" + (spec.name or spec.task_id[:12]),
+                   t_start, _tp.now(), tid, exec_sid, parent,
+                   {"error": True} if error else None)
+        if set_tls:
+            _tp.clear_current()
+        return (tid, exec_sid)
+
     # ---- execution ----
     def _load_function(self, func_id: str):
         fn = self._fn_cache.get(func_id)
@@ -433,7 +509,12 @@ class WorkerExecutor:
                     if isinstance(v, RefMarker)]
         values = {}
         if ref_ids:
-            got = self.ctx.get_objects(ref_ids, timeout=None)
+            # traced tasks get an explicit arg-fetch span (the classic
+            # hidden stall: remote args pulled before exec can start);
+            # the GET_OBJECT messages inside carry its context
+            with _tp.span("worker", "get_args",
+                          extra={"n": len(ref_ids)}):
+                got = self.ctx.get_objects(ref_ids, timeout=None)
             values = dict(zip(ref_ids, got))
         conv = lambda v: values[v.object_id] if isinstance(v, RefMarker) else v
         return tuple(conv(a) for a in args), {
@@ -442,6 +523,8 @@ class WorkerExecutor:
     def _send_results(self, task_id: str, return_ids: list[str],
                       result: Any, num_returns: int, error: bool,
                       **extra) -> None:
+        tr = extra.get("_trace")
+        t_put = _tp.now() if (tr and _tp.enabled()) else None
         if not error and num_returns > 1:
             if not isinstance(result, (tuple, list)) or \
                     len(result) != num_returns:
@@ -466,6 +549,11 @@ class WorkerExecutor:
                     TaskError(e, format_exception(e)), object_id=oid)
             stored.is_error = error
             stored_list.append(stored)
+        if t_put is not None:
+            # result serialization/seal span, parented under exec
+            _tp.record("worker", "put", t_put, _tp.now(), tr[0],
+                       _tp.new_id(), tr[1],
+                       {"nbytes": sum(s.nbytes for s in stored_list)})
         # Lazy while other work is in flight: completions emitted in
         # the same tick (pipelined tasks finishing back-to-back, seal
         # notifications, trailing decrefs) coalesce into one frame —
@@ -528,6 +616,7 @@ class WorkerExecutor:
                 return
             self._started_tasks.add(spec.task_id)
         t0 = time.time()
+        tctx = self._open_exec_span(spec)
         self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
         try:
             try:
@@ -561,8 +650,12 @@ class WorkerExecutor:
             result = TaskError(e, format_exception(e),
                                task_name=spec.name)
             error = True
+        tr = self._close_exec_span(tctx, spec, error)
+        extra = {"name": spec.name}
+        if tr is not None:
+            extra["_trace"] = tr
         self._send_results(spec.task_id, spec.return_ids, result,
-                           spec.num_returns, error, name=spec.name)
+                           spec.num_returns, error, **extra)
         self._record_event(spec.task_id, spec.name,
                            "EXEC_FAILED" if error else "EXEC_FINISHED",
                            duration_s=time.time() - t0)
@@ -608,6 +701,7 @@ class WorkerExecutor:
 
     def _run_actor_task(self, spec: ActorTaskSpec) -> None:
         t0 = time.time()
+        tctx = self._open_exec_span(spec)
         self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
         try:
             result = self._invoke_actor_method(spec)
@@ -615,15 +709,20 @@ class WorkerExecutor:
         except BaseException as e:  # noqa: BLE001
             result = TaskError(e, format_exception(e), task_name=spec.name)
             error = True
+        tr = self._close_exec_span(tctx, spec, error)
+        extra = {"name": spec.name}
+        if tr is not None:
+            extra["_trace"] = tr
         self._send_results(spec.task_id, spec.return_ids, result,
                            spec.num_returns, error, is_actor_task=True,
-                           actor_id=spec.actor_id, name=spec.name)
+                           actor_id=spec.actor_id, **extra)
         self._record_event(spec.task_id, spec.name,
                            "EXEC_FAILED" if error else "EXEC_FINISHED",
                            duration_s=time.time() - t0)
 
     async def _run_actor_task_async(self, spec: ActorTaskSpec) -> None:
         t0 = time.time()
+        tctx = self._open_exec_span(spec, set_tls=False)
         self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
         try:
             method = getattr(self._actor, spec.method_name)
@@ -633,9 +732,13 @@ class WorkerExecutor:
         except BaseException as e:  # noqa: BLE001
             result = TaskError(e, format_exception(e), task_name=spec.name)
             error = True
+        tr = self._close_exec_span(tctx, spec, error)
+        extra = {"name": spec.name}
+        if tr is not None:
+            extra["_trace"] = tr
         self._send_results(spec.task_id, spec.return_ids, result,
                            spec.num_returns, error, is_actor_task=True,
-                           actor_id=spec.actor_id, name=spec.name)
+                           actor_id=spec.actor_id, **extra)
         self._record_event(spec.task_id, spec.name,
                            "EXEC_FAILED" if error else "EXEC_FINISHED",
                            duration_s=time.time() - t0)
@@ -657,6 +760,7 @@ def main() -> None:
         # Driver went away: nothing useful left to do.
         os._exit(0)
 
+    _tp.set_role("worker", args.worker_id)
     conn = protocol.connect((host, int(port)), handler, on_close,
                             name=f"worker-{args.worker_id}")
     # the worker is a hot emitter (TASK_DONE bursts, decref floods):

@@ -85,7 +85,9 @@ class _EnvView(ctypes.Structure):
                 ("fields_off", ctypes.c_int64),
                 ("fields_len", ctypes.c_int64),
                 ("batch_off", ctypes.c_int64),
-                ("batch_len", ctypes.c_int64)]
+                ("batch_len", ctypes.c_int64),
+                ("trace_id", ctypes.c_uint64),
+                ("parent_span", ctypes.c_uint64)]
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -325,9 +327,10 @@ def env_encode_header(version: int, mtype: bytes, rid: int,
 def env_decode(data: bytes):
     """Parse the top-level Envelope fields of `data`. Returns
     ``(version, rid, type_bytes, body_bytes|None, fields_len,
-    batch_off, batch_len)`` with fields_len = -1 / batch_off = -1 when
-    absent, or None when the fast parser can't handle the input (the
-    caller falls back to the real protobuf codec)."""
+    batch_off, batch_len, trace_id, parent_span)`` with fields_len =
+    -1 / batch_off = -1 when absent and trace ids 0 when unset, or
+    None when the fast parser can't handle the input (the caller
+    falls back to the real protobuf codec)."""
     lib = _load()
     view = _EnvView()
     if lib.rtpu_env_decode(data, len(data), ctypes.byref(view)) != 0:
@@ -341,7 +344,8 @@ def env_decode(data: bytes):
             if view.body_off >= 0 else None)
     return (view.version, view.rid, mtype, body,
             view.fields_len if view.fields_off >= 0 else -1,
-            view.batch_off, view.batch_len)
+            view.batch_off, view.batch_len,
+            view.trace_id, view.parent_span)
 
 
 def batch_split(data: bytes, off: int, length: int):

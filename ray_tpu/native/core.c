@@ -332,6 +332,8 @@ long rtpu_writev_full(int fd, struct iovec *iov, long cnt) {
  *   field 4  fields   message  (len-del, tag 0x22)  [structural plane]
  *   field 5  py_body  bytes    (len-del, tag 0x2a)
  *   field 6  batch    message  (len-del, tag 0x32)  [BatchFrame]
+ *   field 7  trace_id    fixed64 (tag 0x39)  [tracing plane, MINOR 2]
+ *   field 8  parent_span fixed64 (tag 0x41)
  * BatchFrame: field 1 repeated Envelope (len-del, tag 0x0a).
  *
  * The decoder returns OFFSET/LENGTH views into the caller's buffer —
@@ -348,6 +350,7 @@ typedef struct {
     int64_t body_off, body_len;         /* py_body */
     int64_t fields_off, fields_len;
     int64_t batch_off, batch_len;
+    uint64_t trace_id, parent_span;     /* tracing plane; 0 = unset */
 } rtpu_env_view;
 
 static int pb_varint(const uint8_t *b, uint64_t len, uint64_t *pos,
@@ -411,6 +414,17 @@ int rtpu_env_decode(const uint8_t *buf, uint64_t len, rtpu_env_view *v) {
             if (pb_varint(buf, len, &pos, &n))
                 return -1;
             v->rid = n;
+        } else if ((fno == 7 || fno == 8) && wt == 1) {
+            if (len - pos < 8)
+                return -1;
+            uint64_t x = 0;
+            for (int i = 7; i >= 0; i--)
+                x = (x << 8) | buf[pos + i];
+            pos += 8;
+            if (fno == 7)
+                v->trace_id = x;
+            else
+                v->parent_span = x;
         } else if ((fno == 2 || fno == 4 || fno == 5 || fno == 6)
                    && wt == 2) {
             if (pb_varint(buf, len, &pos, &n) || len - pos < n)

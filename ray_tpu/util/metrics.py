@@ -153,11 +153,20 @@ class Histogram(Metric):
 
 
 def timeline(filename: Optional[str] = None) -> list:
-    """Chrome-trace dump of task events (reference `ray timeline`).
+    """LEGACY head-events Chrome-trace view (reference `ray timeline`).
 
-    Pairs RUNNING→FINISHED/FAILED transitions per task into complete
-    ("X") events; open-ended states become instant ("i") events. Load
-    the file in chrome://tracing or Perfetto.
+    Pairs the controller's head-side RUNNING→FINISHED/FAILED task
+    transitions into complete ("X") events; open-ended states become
+    instant ("i") events. Load the file in chrome://tracing or
+    Perfetto.
+
+    This view needs nothing but the head's task-event table — it
+    works even with tracing disabled — but it only sees what the head
+    saw: scheduler queueing, wire latency, arg pulls, and worker-local
+    time are invisible. For the cross-process timeline backed by the
+    r9 tracing plane (per-process flight recorders, spans parented
+    across driver → scheduler → worker → object plane), use
+    `ray_tpu.util.tracing.task_timeline` instead.
     """
     import json
 

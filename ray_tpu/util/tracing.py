@@ -1,10 +1,31 @@
-"""Tracing/profiling hooks (SURVEY §5.1).
+"""Tracing/profiling hooks (SURVEY §5.1 — a full subsystem since r9).
 
 Parity: reference util/tracing (opt-in opentelemetry wrapping) + the
-nsight runtime-env plugin + `ray timeline`. The TPU-native profiler IS
-jax.profiler (XLA/TPU traces viewable in TensorBoard/Perfetto); this
-module gives it the framework spelling and keeps the task-level Chrome
-trace next to it:
+nsight runtime-env plugin + `ray timeline`. Three layers, coarsest to
+finest:
+
+* :func:`task_timeline` — the cross-process runtime timeline, backed
+  by the r9 tracing plane (`_private/tracing_plane.py`): every
+  process's flight recorder is drained over the wire (``trace_dump``),
+  clocks are aligned, and the result is a Chrome/Perfetto JSON with
+  one track per process (driver, each agent, each worker) and flow
+  arrows stitching a task's submit → queue/lease → recv/exec/put →
+  done spans across processes. Open the output at https://ui.perfetto.dev
+  or chrome://tracing. (``ray_tpu.util.metrics.timeline`` remains the
+  LEGACY head-events view: head-side RUNNING→FINISHED pairs only, no
+  cross-process spans — see its docstring.)
+
+* :func:`annotate` / :func:`annotate_fn` — named user spans. These
+  land BOTH in the jax profiler capture (TraceAnnotation, when a
+  profile() trace is active) and in the flight recorder, so user code
+  shows up on the same task_timeline() as the runtime's own spans.
+
+* :func:`profile` — the device-level jax.profiler capture (XLA ops,
+  TPU activity) for TensorBoard/XProf; orthogonal to the task plane.
+
+Knobs: ``RAY_TPU_TRACE`` (master switch, default on) and
+``RAY_TPU_TRACE_RING`` (per-process recorder capacity, default 4096;
+0 disables). See README "Distributed tracing".
 
     with ray_tpu.util.tracing.profile("/tmp/tb"):   # device+host trace
         train_step(...)
@@ -12,7 +33,7 @@ trace next to it:
     with ray_tpu.util.tracing.annotate("sample"):    # named span
         ...
 
-    ray_tpu.util.tracing.task_timeline("out.json")   # task events
+    ray_tpu.util.tracing.task_timeline("out.json")   # Perfetto JSON
 """
 from __future__ import annotations
 
@@ -34,11 +55,21 @@ def profile(log_dir: str) -> Iterator[None]:
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
-    """Named span inside a profile() capture (TraceAnnotation); no-op
-    cost when no trace is active."""
-    import jax
-    with jax.profiler.TraceAnnotation(name):
-        yield
+    """Named span: lands in the flight recorder (so it shows on
+    task_timeline() next to the runtime's spans, joining the ambient
+    trace when called inside a traced task, else starting its own)
+    AND as a jax TraceAnnotation inside a profile() capture; near-zero
+    cost when tracing is disabled and no jax trace is active."""
+    from ray_tpu._private import tracing_plane as _tp
+    with _tp.span("user", name, root=True):
+        try:
+            import jax
+            ta = jax.profiler.TraceAnnotation(name)
+        except Exception:        # jax unavailable/broken: recorder only
+            yield
+            return
+        with ta:
+            yield
 
 
 def annotate_fn(name: Optional[str] = None):
@@ -55,8 +86,28 @@ def annotate_fn(name: Optional[str] = None):
     return deco
 
 
-def task_timeline(filename: Optional[str] = None) -> list:
-    """Chrome-trace of runtime task events (`ray timeline` parity);
-    see util/metrics.timeline."""
-    from ray_tpu.util.metrics import timeline
-    return timeline(filename)
+def task_timeline(filename: Optional[str] = None,
+                  trace_id: Optional[int] = None) -> list:
+    """Cross-process Perfetto timeline from the tracing plane's flight
+    recorders (r9). Drains every process's recorder via the
+    ``trace_dump`` state op (head + local workers + each agent + its
+    workers), aligns clocks on the head's monotonic clock (RTT-
+    midpoint offsets), and returns Chrome trace-event JSON: one
+    Perfetto process per runtime process, spans as complete events,
+    parent→child flow arrows across processes. `trace_id` filters to
+    one trace. Load the file in https://ui.perfetto.dev.
+
+    For the legacy head-events-only view (task RUNNING→FINISHED pairs,
+    no per-process recorders needed) see `ray_tpu.util.metrics
+    .timeline`."""
+    import json
+
+    from ray_tpu._private import context as _ctx
+    from ray_tpu._private import tracing_plane as _tp
+    dump = _ctx.get_ctx().state_op("trace_dump")
+    trace = _tp.chrome_trace(dump.get("processes", []),
+                             trace_id=trace_id)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -234,6 +234,9 @@ def test_learner_connector_gae_matches_in_jit(ray_cluster):
         assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.slow        # ~17s; PPO learning and Tune sweeps each
+                         # keep their own tier-1 gates (870s budget,
+                         # ROADMAP.md)
 def test_ppo_as_tune_trainable_lr_sweep(ray_cluster):
     """Algorithms register as Tune trainables (reference Algorithm IS a
     Trainable, algorithm.py:227): a PPO lr grid sweep runs through

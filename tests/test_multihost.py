@@ -409,6 +409,9 @@ def test_head_restart_named_actor_survives(tmp_path):
             agent.terminate()
 
 
+@pytest.mark.slow        # ~21s; head-restart semantics stay gated by
+                         # test_head_restart_named_actor_survives in
+                         # tier-1 (870s budget, ROADMAP.md)
 def test_head_restart_trainer_resumes(tmp_path):
     """An in-flight JaxTrainer dies with the head; the restarted head
     resumes it from the latest checkpoint and finishes the remaining

@@ -253,9 +253,23 @@ def test_env_decode_views():
     env = pb.Envelope(version=101, type="task", rid=9,
                       py_body=b"PAYLOAD")
     view = native.env_decode(env.SerializeToString())
-    version, rid, mtype, body, fields_len, batch_off, batch_len = view
+    (version, rid, mtype, body, fields_len, batch_off, batch_len,
+     trace_id, parent_span) = view
     assert (version, rid, mtype, body) == (101, 9, b"task", b"PAYLOAD")
     assert fields_len == -1 and batch_off == -1
+    assert trace_id == 0 and parent_span == 0
+
+
+@pytestmark_native
+def test_env_decode_trace_fields():
+    """r9 tracing plane: the C parser captures the Envelope's fixed64
+    trace fields, byte-compatibly with protobuf's encoding."""
+    env = pb.Envelope(version=102, type="task", rid=4,
+                      py_body=b"B", trace_id=(1 << 62) + 5,
+                      parent_span=77)
+    view = native.env_decode(env.SerializeToString())
+    assert view is not None
+    assert view[7] == (1 << 62) + 5 and view[8] == 77
 
 
 @pytestmark_native

@@ -90,6 +90,9 @@ def _build_wheel(tmp_path) -> str:
     return str(tmp_path)
 
 
+@pytest.mark.slow        # ~22s (builds a wheel + venv); the other
+                         # runtime_env plugins (py_modules/uv/env
+                         # switch/container) stay in tier-1
 def test_pip_runtime_env_offline_wheel(ray_cluster, tmp_path):
     """pip env: a venv is materialized per spec hash (offline via
     --no-index + local wheel) and the package imports inside workers."""

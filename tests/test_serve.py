@@ -48,6 +48,10 @@ def test_serve_deploy_and_route(serve_shutdown):
     assert st["echo"]["live_replicas"] == 2
 
 
+@pytest.mark.slow        # ~32s (replica worker respawn is wall-clock
+                         # bound); serve liveness/autoscale/multi-app
+                         # stay in tier-1, and the full default suite
+                         # runs this (870s tier-1 budget, ROADMAP.md)
 def test_serve_replica_recovery(serve_shutdown):
     Echo = _echo_deployment()
     handle = serve.run(Echo.bind("r"), name="rec")

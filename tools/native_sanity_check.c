@@ -30,6 +30,9 @@ typedef struct {
     int64_t body_off, body_len;
     int64_t fields_off, fields_len;
     int64_t batch_off, batch_len;
+    uint64_t trace_id, parent_span;   /* r9: MUST match core.c's
+                                         definition — decode memsets
+                                         and writes sizeof(view) */
 } rtpu_env_view;
 int rtpu_env_decode(const uint8_t *buf, uint64_t len, rtpu_env_view *v);
 long rtpu_batch_split(const uint8_t *buf, uint64_t len,
@@ -175,6 +178,20 @@ static void check_codec(void) {
     assert(v.body_len == 9
            && memcmp(out + v.body_off, "BODYBYTES", 9) == 0);
     assert(v.fields_off == -1 && v.batch_off == -1);
+    assert(v.trace_id == 0 && v.parent_span == 0);
+
+    /* r9 trace fields (fixed64, little-endian) parse and a short
+     * fixed64 fails instead of overreading */
+    uint8_t tr[4120];
+    memcpy(tr, out, (size_t)n);
+    const uint8_t trace_tail[] = {
+        0x39, 0x2a, 0, 0, 0, 0, 0, 0, 0,        /* trace_id = 42   */
+        0x41, 0x07, 0, 0, 0, 0, 0, 0, 0};       /* parent_span = 7 */
+    memcpy(tr + n, trace_tail, sizeof trace_tail);
+    assert(rtpu_env_decode(tr, (uint64_t)n + sizeof trace_tail,
+                           &v) == 0);
+    assert(v.trace_id == 42 && v.parent_span == 7);
+    assert(rtpu_env_decode(tr, (uint64_t)n + 5, &v) == -1);
 
     /* unknown trailing fields (future MINORs) are skipped */
     uint8_t ext[4120];

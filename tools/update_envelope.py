@@ -31,6 +31,8 @@ LABELS = [
      "wire codec, protobuf backend (encode+decode µs)"),
     ("drain_5k_nonative", "5k drain, RAY_TPU_DISABLE_NATIVE=1"),
     ("drain_5k_native", "5k drain, native frame engine"),
+    ("drain_3k_notrace", "3k drain, RAY_TPU_TRACE=0"),
+    ("drain_3k_trace", "3k drain, tracing on (default)"),
     ("tasks_sync_per_s", "tasks, sync round-trip"),
     ("tasks_batch_per_s", "tasks, batched"),
     ("actor_calls_sync_per_s", "actor calls, sync"),
@@ -74,7 +76,8 @@ def _fmt_result(rec: dict) -> str:
         return out
     extras = {k: v for k, v in rec.items()
               if k not in ("n", "unit", "frames_per_task",
-                           "head_cpu_us_per_task")}
+                           "head_cpu_us_per_task",
+                           "trace_overhead_pct")}
     return ", ".join(f"{k}={v}" for k, v in extras.items())
 
 
@@ -89,6 +92,15 @@ def _fmt_frames(rec: dict) -> str:
     return " · ".join(parts) if parts else "—"
 
 
+def _fmt_trace(rec: dict) -> str:
+    """The r9 tracing-plane overhead column: throughput delta of the
+    traced run vs its RAY_TPU_TRACE=0 twin (negative = the traced run
+    measured faster, i.e. the cost is below box noise)."""
+    if "trace_overhead_pct" in rec:
+        return f"{rec['trace_overhead_pct']:+}%"
+    return "—"
+
+
 def render_block(results: dict) -> str:
     known = [k for k, _ in LABELS]
     rows = [(label, results[key]) for key, label in LABELS
@@ -98,11 +110,12 @@ def render_block(results: dict) -> str:
     lines = [BEGIN,
              "### Latest `bench_core.py` run (machine-generated)",
              "",
-             "| Scenario | Result | frames/task · head-CPU/task |",
-             "|---|---|---|"]
+             "| Scenario | Result | frames/task · head-CPU/task "
+             "| trace overhead |",
+             "|---|---|---|---|"]
     for label, rec in rows:
         lines.append(f"| {label} | {_fmt_result(rec)} | "
-                     f"{_fmt_frames(rec)} |")
+                     f"{_fmt_frames(rec)} | {_fmt_trace(rec)} |")
     lines.append(END)
     return "\n".join(lines)
 

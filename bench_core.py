@@ -71,6 +71,65 @@ def _drain_with_frames(n_tasks: int) -> dict:
             **stats}
 
 
+def _delegated_drain(n_tasks: int, delegate: bool) -> dict:
+    """Remote-drain A/B (r10): a 0-CPU head routes EVERY task to one
+    4-CPU agent subprocess, so the measurement isolates the head<->
+    agent control protocol — central per-task dispatch
+    (RAY_TPU_DELEGATE=0: NODE_ENQUEUE + dispatch event +
+    NODE_TASK_DONE per task) vs delegated bulk leases (lease batches
+    out, coalesced done batches back, dispatch events suppressed).
+    frames/task counts the HEAD process's socket frames; head CPU is
+    the head process's total thread time."""
+    import ray_tpu
+    from ray_tpu._private import protocol
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.cluster_utils import NodeAgentProcess
+    os.environ["RAY_TPU_DELEGATE"] = "1" if delegate else "0"
+    CONFIG.reload()
+    agent = None
+    try:
+        rt = ray_tpu.init(num_cpus=0)
+        agent = NodeAgentProcess(num_cpus=4)   # inherits DELEGATE env
+        deadline = time.time() + 60
+        while (time.time() < deadline
+               and len(rt.cluster.alive_nodes()) < 2):
+            time.sleep(0.1)
+
+        @ray_tpu.remote
+        def nop():
+            return None
+
+        for _ in range(3):
+            ray_tpu.get([nop.remote() for _ in range(30)],
+                        timeout=120)                     # warm pool
+        s0 = dict(protocol.WIRE_STATS)
+        c0 = time.process_time()
+        t0 = time.perf_counter()
+        refs = [nop.remote() for _ in range(n_tasks)]
+        ray_tpu.get(refs, timeout=600)
+        dt = time.perf_counter() - t0
+        cpu = time.process_time() - c0
+        stats = _frame_stats(s0, n_tasks)
+        handle = next(n.scheduler for n in rt.cluster.alive_nodes()
+                      if not n.is_head)
+        extra = {}
+        if delegate:
+            extra = {"lease_batches": handle._leases_sent,
+                     "tasks_leased": handle._tasks_leased}
+        return {"n": n_tasks, "seconds": round(dt, 4),
+                "per_second": round(n_tasks / dt, 1), "unit": "tasks",
+                "head_cpu_us_per_task": round(cpu / n_tasks * 1e6, 1),
+                **stats, **extra}
+    finally:
+        if agent is not None:
+            agent.terminate()
+            agent.wait(10)
+        import ray_tpu as _rt
+        _rt.shutdown()
+        os.environ.pop("RAY_TPU_DELEGATE", None)
+        CONFIG.reload()
+
+
 def _codec_bench() -> dict:
     """Codec-only cost: encode+decode µs for the hot frame shapes,
     native engine vs pure-Python protobuf (RAY_TPU_WIRE_NATIVE=0 —
@@ -211,6 +270,23 @@ def main(as_json: bool = False) -> dict:
     results["drain_5k_native"]["native_speedup"] = round(
         results["drain_5k_native"]["per_second"]
         / results["drain_5k_nonative"]["per_second"], 2)
+
+    # ---------- delegated vs central dispatch: 5k remote drain (r10)
+    # Same box, back-to-back fresh head+agent pairs; the central run
+    # first (its env must be set before the agent spawns).
+    results["drain_5k_central"] = _delegated_drain(5000, delegate=False)
+    results["drain_5k_delegated"] = _delegated_drain(5000, delegate=True)
+    _c, _d = results["drain_5k_central"], results["drain_5k_delegated"]
+    if _c["per_second"]:
+        _d["delegate_speedup"] = round(
+            _d["per_second"] / _c["per_second"], 2)
+
+    # --------------------- 100k-task drain: sustained head envelope
+    # (r10 acceptance scenario — the scale at which per-task head
+    # participation used to be the wall; local workers, so the number
+    # tracks the full submit->dispatch->done pipeline, not one box's
+    # agent protocol)
+    results["drain_100k"] = _drain_with_frames(100_000)
 
     # ------------- tracing plane: trace-off vs trace-on 3k drain (r9)
     # Machine-checks the "near-zero at default settings" claim: with

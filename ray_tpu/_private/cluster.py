@@ -91,6 +91,13 @@ class ClusterTaskManager:
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="ray-tpu-health", daemon=True)
         self._monitor.start()
+        # r10 delegated steal: its own thread — a wedged agent can
+        # stall the revoke SEND (socket buffer full, 30s SO_SNDTIMEO),
+        # which must never delay the health monitor's death detection
+        self._rebalancer = threading.Thread(
+            target=self._rebalance_loop, name="ray-tpu-rebalance",
+            daemon=True)
+        self._rebalancer.start()
 
     # ------------------------------------------------------------ nodes
     def add_node(self, resources: Dict[str, float],
@@ -690,6 +697,46 @@ class ClusterTaskManager:
             if not self._try_reserve(pg):
                 with self._lock:
                     self._pending_pgs.append(pg.pg_id)
+
+    # ------------------------------------------- delegated steal (r10)
+    def _rebalance_loop(self) -> None:
+        """Stage-1 spillback for DELEGATED agents: local queues spill
+        themselves (`Scheduler._spill_aged_locked`), but an agent runs
+        with cluster=None and its bulk-leased backlog is invisible to
+        any local spill scan — so the head, which still owns every
+        leased spec, periodically revokes queued-not-started work from
+        an agent reporting unmet demand and re-places it on a node
+        with room (reference ClusterTaskManager::ScheduleOnNode
+        redirect, applied to leases)."""
+        while self._running:
+            time.sleep(1.0)
+            try:
+                self._rebalance_once()
+            except Exception:
+                log.exception("delegated rebalance sweep failed")
+
+    def _rebalance_once(self) -> None:
+        nodes = self.alive_nodes()
+        if len(nodes) < 2:
+            return
+        for n in nodes:
+            h = n.scheduler
+            if (getattr(h, "revoke_lease", None) is None
+                    or not h.delegates()):
+                continue            # local node / pre-delegation agent
+            shapes = h.pending_shapes()
+            if not shapes:
+                continue            # no unmet demand: nothing stuck
+            if not any(fits(m.scheduler.effective_avail(), shapes[0])
+                       for m in nodes if m is not n and m.alive):
+                continue            # nowhere better: leave the lease
+            ids = h.steal_candidates()
+            if ids:
+                # fire-and-forget: the agent's lease_reclaimed event
+                # hands the specs back and the runtime re-places them
+                # (spill-count-capped there) — no blocking reply to
+                # stall this sweep against a wedged agent
+                h.revoke_lease(ids)
 
     # ----------------------------------------------------- node failure
     def _monitor_loop(self) -> None:

@@ -229,6 +229,48 @@ _define("trace_ring", 4096,
         "newest events win, the watermark keeps counting so drops are "
         "visible. 0 disables recording (same effect as "
         "RAY_TPU_TRACE=0).")
+_define("epoll", True,
+        "Drive the read side of every head/agent connection from ONE "
+        "shared event loop (r10): the native epoll API in core.c "
+        "(epoll_wait with the GIL released, level-triggered, each "
+        "ready fd drained through its C reassembly buffer) when the "
+        "frame engine is on, a select()-based Python loop otherwise. "
+        "0 restores a dedicated reader thread per connection. Worker "
+        "processes always use per-connection readers (they hold one "
+        "or two connections).")
+_define("delegate", True,
+        "Delegated bulk-lease scheduling (r10): the head grants "
+        "agents batches of queued tasks in single NODE_LEASE_BATCH "
+        "frames instead of per-spec sends, suppresses per-task "
+        "dispatch events, and agents report completions in coalesced "
+        "TASK_DONE_BATCH frames. Negotiated per connection (peer "
+        "wire MINOR >= 3); 0 restores per-task round-trips. The head "
+        "keeps ownership: lease revoke, steal, and lineage resubmit "
+        "all still work.")
+_define("delegate_lease_batch", 64,
+        "Max specs per NODE_LEASE_BATCH: the head-side lease buffer "
+        "flushes when this many specs are parked for one agent (or "
+        "when the delegate_lease_delay_ms window closes).")
+_define("delegate_lease_delay_ms", 1.0,
+        "Collect-then-flush window for the head-side lease buffer: "
+        "the first parked spec opens a window of this width; every "
+        "spec routed to the same agent inside it rides one "
+        "NODE_LEASE_BATCH frame.")
+_define("delegate_done_batch", 64,
+        "Max completions per TASK_DONE_BATCH: the agent-side "
+        "completion buffer flushes at this count (or when the "
+        "delegate_done_delay_ms window closes, or before any other "
+        "state-bearing send — ordering with worker_lost/refcount "
+        "traffic is preserved).")
+_define("delegate_done_delay_ms", 2.0,
+        "Collect-then-flush window for the agent-side completion "
+        "buffer.")
+_define("delegate_max_inflight", 0,
+        "Resource-budget cap on tasks leased to one agent but not "
+        "yet reported done; specs beyond it stay parked in the "
+        "head-side lease buffer until completions free budget. "
+        "0 = unbounded (the agent's own scheduler remains the "
+        "authoritative resource ledger either way).")
 _define("scheduler_locality", True,
         "Locality-aware node selection: prefer placing a task on a "
         "feasible node already holding the most argument bytes "

@@ -63,6 +63,14 @@ class _AgentFacade:
         self._agent = agent
 
     def on_task_dispatched(self, spec, worker_id: str) -> None:
+        if spec.task_id in self._agent._lease_of:
+            # delegated task (r10): the head is no longer a per-task
+            # participant — it learns the terminal state from the
+            # coalesced done batch; per-dispatch events are the frames
+            # delegation exists to eliminate
+            self._agent._delegate_stats["dispatch_events_suppressed"] \
+                += 1
+            return
         self._agent.send_event("task_dispatched", key=spec.task_id,
                                name=spec.name, worker_id=worker_id)
 
@@ -72,6 +80,11 @@ class _AgentFacade:
                                actor_id=spec.actor_id, worker_id=worker_id)
 
     def on_unplaceable(self, spec, reason: str) -> None:
+        # a leased task that can never run here is off this agent's
+        # book (the head fails/re-places it from the event) — consume
+        # its lease or the ledger entry leaks for the agent's lifetime
+        if getattr(spec, "task_id", None):
+            self._agent._lease_done(spec.task_id)
         self._agent.send_event("unplaceable", spec=spec, reason=reason)
 
 
@@ -86,6 +99,10 @@ class NodeAgent:
         self.head_addr = head_addr
         self.store = LocalStore()
         self._stop = threading.Event()
+        # r10: shared epoll/select read loop for every connection this
+        # agent owns (head control conn, local workers, peer pullers);
+        # None (RAY_TPU_EPOLL=0) restores thread-per-connection.
+        self._poller = protocol.make_poller()
         self._fetch_pool = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="rtpu-agent-fetch")
         self._pull_server = PullServer(self.store,
@@ -135,6 +152,39 @@ class NodeAgent:
         self._pending_sends: _collections.deque = _collections.deque(
             maxlen=10_000)
         self._dropped_sends = 0
+        # ---- delegated bulk leases (r10) ----
+        # task_id -> lease_id for every task granted via
+        # NODE_LEASE_BATCH and not yet completed/reclaimed/lost; the
+        # membership test is what suppresses per-task dispatch events.
+        self._lease_of: dict[str, str] = {}
+        # lease_id -> {"granted", "consumed", "budget"} — grant/consume
+        # accounting; a lease is pruned once fully consumed.
+        self._leases: dict[str, dict] = {}
+        self._lease_lock = threading.Lock()
+        self._delegate_stats = {
+            "lease_batches": 0, "tasks_leased": 0, "tasks_done": 0,
+            "done_batches": 0, "dispatch_events_suppressed": 0,
+            "revoked": 0,
+        }
+        # completion coalescing: plain-task TASK_DONEs park here and
+        # flush as ONE NODE_TASK_DONE_BATCH (count/window thresholds;
+        # any other state-bearing send flushes the buffer first so
+        # worker_lost / refcount ordering is preserved)
+        self._done_buf: list = []
+        self._done_lock = threading.Lock()
+        self._done_flusher = protocol.FlushLoop(
+            self._flush_done_buf,
+            lambda: _CFG.delegate_done_delay_ms,
+            "rtpu-agent-done-flush")
+        # ---- N10 heartbeat delta-sync ----
+        self._hb_seq = 0
+        self._hb_last_norm: Optional[dict] = None
+        self._hb_conn = None
+        # set by the NODE_HB_RESYNC handler (head-conn reader thread),
+        # consumed ONLY by the heartbeat thread — a plain _hb_last_norm
+        # reset could be overwritten mid-_heartbeat_payload and the
+        # requested full snapshot silently lost
+        self._hb_force_full = False
         self._labels = dict(labels or {})
         self._max_workers = max_workers
         self._resources = dict(resources)
@@ -148,7 +198,8 @@ class NodeAgent:
             try:
                 self.head = protocol.connect(
                     head_addr, self._handle_head_msg,
-                    self._on_head_closed, name="head")
+                    self._on_head_closed, name="head",
+                    poller=self._poller)
                 break
             except OSError:
                 if time.monotonic() > dial_deadline:
@@ -217,7 +268,8 @@ class NodeAgent:
             try:
                 conn = protocol.connect(self.head_addr,
                                         self._handle_head_msg,
-                                        self._on_head_closed, name="head")
+                                        self._on_head_closed, name="head",
+                                        poller=self._poller)
             except OSError:
                 continue
             # Swap BEFORE registering: the head may route work here the
@@ -321,11 +373,21 @@ class NodeAgent:
         if self._stop.is_set():
             return
         self._stop.set()
+        self._done_flusher.stop()
+        try:
+            # graceful drain: completions still parked in the batch
+            # window must reach the head, or it re-executes finished
+            # tasks after declaring this node dead
+            self._flush_done_buf()
+        except Exception:
+            pass
         try:
             self._listener.close()
         except OSError:
             pass
         self.scheduler.shutdown()
+        if self._poller is not None:
+            self._poller.close()
         self.store.shutdown()
         from ray_tpu._private.specs import SESSION_TAG_INHERITED
         if not SESSION_TAG_INHERITED:
@@ -342,6 +404,91 @@ class NodeAgent:
             time.sleep(0.2)
 
     # ------------------------------------------------------- heartbeat
+    def _hb_normalize(self, key: str, value):
+        """Comparison view of a heartbeat key for the N10 delta: strip
+        fields that tick every beat without carrying information
+        (worker ages, sample timestamps, and the wire counters' own
+        per-heartbeat send cost), so a steady-state node's beats
+        degenerate to seq + heartbeat presence instead of re-shipping
+        the full worker table and ledgers each time."""
+        if key == "workers":
+            return [{k: v for k, v in row.items() if k != "age_s"}
+                    for row in value]
+        if key == "host_stats":
+            return {k: v for k, v in value.items() if k != "ts"}
+        if key == "wire":
+            # every heartbeat send bumps tx_frames/tx_msgs by one, so
+            # the raw counters ALWAYS differ beat-to-beat and the dict
+            # would ride every delta forever. Subtract the beat count:
+            # on an idle node both tick in lockstep and the normalized
+            # view is constant (any fixed offset cancels); real task/
+            # object traffic still changes it and ships the key.
+            out = dict(value)
+            for k in ("tx_frames", "tx_msgs"):
+                if k in out:
+                    out[k] -= self._hb_seq
+            return out
+        return value
+
+    def _heartbeat_payload(self, last_spo: dict) -> tuple[dict, dict]:
+        """(payload, serves_per_object sent) for one beat: the full
+        snapshot, or — toward a MINOR >= 3 head — a seq-numbered delta
+        carrying only the keys whose normalized value changed since
+        the last beat (N10: heartbeats carry resource DELTAS; full
+        snapshot on reconnect, or when the head reports a seq gap via
+        NODE_HB_RESYNC)."""
+        spo = self._pull_server.serves_per_object()
+        plane = {
+            **OBJECT_PLANE_STATS,
+            "sessions": self._pull_server.session_count(),
+            **{"pull_" + k: v
+               for k, v in self._pull_mgr.stats().items()},
+        }
+        if spo != last_spo:
+            plane["serves_per_object"] = spo
+        with self._lease_lock:
+            delegate = dict(self._delegate_stats,
+                            outstanding=len(self._lease_of),
+                            open_leases=len(self._leases))
+        snap = {
+            # agent-process frame counters (r7 frame engine
+            # telemetry): plain int dict, rides the structural
+            # node plane like the rest of the heartbeat
+            "wire": dict(protocol.WIRE_STATS),
+            # object-plane counters (r8): transfers, bytes,
+            # dedup hits, per-object serve counts — the head
+            # aggregates these in object_plane_stats
+            "object_plane": plane,
+            # tracing plane (r9): watermark ONLY — events move
+            # via the trace_dump pull, never on heartbeats
+            "trace_watermark": _tp.recorder().watermark(),
+            # delegated-lease accounting (r10)
+            "delegate": delegate,
+            **self.scheduler.heartbeat_snapshot(),
+        }
+        head = self.head
+        if head is not self._hb_conn:
+            # fresh connection (initial or post-reconnect): the head's
+            # handle has no prior state — full snapshot, reset the base
+            self._hb_last_norm = None
+            self._hb_conn = head
+        if not head.peer_speaks_delegate():
+            return snap, spo             # pre-delta head: full beats
+        norm = {k: self._hb_normalize(k, v) for k, v in snap.items()}
+        self._hb_seq += 1
+        if self._hb_force_full:
+            self._hb_force_full = False
+            last = None                 # head asked for a resync
+        else:
+            last = self._hb_last_norm
+        self._hb_last_norm = norm
+        if last is None:
+            return dict(snap, hb_seq=self._hb_seq), spo
+        delta = {k: snap[k] for k in snap if norm[k] != last.get(k)}
+        delta["hb_seq"] = self._hb_seq
+        delta["hb_delta"] = True
+        return delta, spo
+
     def _heartbeat_loop(self) -> None:
         last_spo: dict = {}
         while not self._stop.is_set():
@@ -350,30 +497,11 @@ class NodeAgent:
                 # they CHANGED (the head merges, keeping its last copy):
                 # a steady-state cluster must not pay for a 128-entry
                 # debug table twice a second per node
-                spo = self._pull_server.serves_per_object()
-                plane = {
-                    **OBJECT_PLANE_STATS,
-                    "sessions": self._pull_server.session_count(),
-                    **{"pull_" + k: v
-                       for k, v in self._pull_mgr.stats().items()},
-                }
-                if spo != last_spo:
-                    plane["serves_per_object"] = spo
+                payload, spo = self._heartbeat_payload(last_spo)
                 self.head.send({
                     "type": protocol.NODE_HEARTBEAT,
                     "node_id": self.node_id,
-                    # agent-process frame counters (r7 frame engine
-                    # telemetry): plain int dict, rides the structural
-                    # node plane like the rest of the heartbeat
-                    "wire": dict(protocol.WIRE_STATS),
-                    # object-plane counters (r8): transfers, bytes,
-                    # dedup hits, per-object serve counts — the head
-                    # aggregates these in object_plane_stats
-                    "object_plane": plane,
-                    # tracing plane (r9): watermark ONLY — events move
-                    # via the trace_dump pull, never on heartbeats
-                    "trace_watermark": _tp.recorder().watermark(),
-                    **self.scheduler.heartbeat_snapshot(),
+                    **payload,
                 })
                 last_spo = spo          # only after a successful send
             except protocol.ConnectionClosed:
@@ -387,14 +515,20 @@ class NodeAgent:
                 log.exception("heartbeat send failed; retrying")
             self._stop.wait(HEARTBEAT_PERIOD_S)
 
-    def _send_to_head(self, msg: dict) -> None:
+    def _send_to_head(self, msg: dict, _flush_done: bool = True) -> None:
         """Fire-and-forget send that buffers during a head outage (the
         reconnect flush replays it) instead of dropping state. The
         reconnecting check comes BEFORE the direct send: once the new
         connection is live but the buffer has not drained, a direct send
         would overtake buffered messages (a fresh DECREF beating a
         buffered ADDREF lets a refcount dip to zero under a live
-        borrow)."""
+        borrow). Any state-bearing send drains the parked completion
+        batch FIRST (same rule as the wire coalescer's eager-send
+        drain): a worker_lost event must never overtake the done
+        entries of tasks that worker already finished — the head would
+        resubmit finished work."""
+        if _flush_done and self._done_buf:
+            self._flush_done_buf()
         for _attempt in range(2):
             if _CFG.agent_reconnect_window_s > 0:
                 with self._reconnect_lock:
@@ -438,8 +572,23 @@ class NodeAgent:
         mtype = msg["type"]
         if mtype == protocol.NODE_ENQUEUE:
             self.scheduler.enqueue(msg["spec"])
+        elif mtype == protocol.NODE_LEASE_BATCH:
+            self._on_lease_batch(msg)
+        elif mtype == protocol.NODE_LEASE_REVOKE:
+            self._on_lease_revoke(conn, msg)
+        elif mtype == protocol.NODE_FIND_TASK:
+            hit = self.scheduler.find_task(msg["task_id"])
+            conn.reply(msg, state=hit[0] if hit else None,
+                       worker_id=hit[1] if hit else None)
+        elif mtype == protocol.NODE_HB_RESYNC:
+            # head saw a heartbeat seq gap: next beat ships the full
+            # snapshot (flag, not a base reset: the heartbeat thread
+            # may be mid-payload and would overwrite a cleared base)
+            self._hb_force_full = True
         elif mtype == protocol.NODE_CANCEL_PENDING:
             spec = self.scheduler.cancel_pending(msg["task_id"])
+            if spec is not None:
+                self._lease_done(spec.task_id)
             conn.reply(msg, found=spec is not None)
         elif mtype == protocol.NODE_CANCEL_RUNNING:
             self.scheduler.cancel_running(msg["worker_id"], msg["task_id"])
@@ -481,6 +630,102 @@ class NodeAgent:
             self.shutdown()
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)
+
+    # ------------------------------------------ delegated leases (r10)
+    def _on_lease_batch(self, msg: dict) -> None:
+        """A bulk task lease from the head: record the grant, then
+        queue every spec under ONE scheduler lock round-trip. From
+        here on this agent schedules the batch against its own worker
+        pool; the head hears back only through the coalesced done
+        batches (and worker_lost/unplaceable events)."""
+        specs = msg["specs"]
+        lease_id = msg.get("lease_id", "")
+        with self._lease_lock:
+            self._leases[lease_id] = {
+                "granted": len(specs), "consumed": 0,
+                "budget": dict(msg.get("budget") or {})}
+            for s in specs:
+                self._lease_of[s.task_id] = lease_id
+            self._delegate_stats["lease_batches"] += 1
+            self._delegate_stats["tasks_leased"] += len(specs)
+        self.scheduler.enqueue_many(specs)
+
+    def _lease_done(self, task_id: str) -> Optional[str]:
+        """Consume a task from its lease (completion, revoke, loss);
+        prunes the lease once fully consumed. Returns the lease id if
+        the task was delegated."""
+        with self._lease_lock:
+            lease_id = self._lease_of.pop(task_id, None)
+            if lease_id is None:
+                return None
+            led = self._leases.get(lease_id)
+            if led is not None:
+                led["consumed"] += 1
+                if led["consumed"] >= led["granted"]:
+                    self._leases.pop(lease_id, None)
+            return lease_id
+
+    def _on_lease_revoke(self, conn: protocol.Connection,
+                         msg: dict) -> None:
+        """Reclaim queued-not-started tasks for the head (revoke /
+        steal). The scheduler pulls pending-queue entries out
+        synchronously and probes worker FIFOs through the r6
+        UNQUEUE_TASK tombstone machinery; anything already started
+        stays here and completes through the normal done path.
+
+        The hand-back is a fire-and-forget ``lease_reclaimed`` NODE
+        EVENT through _send_to_head — NOT a request reply — so it is
+        buffered across head outages and replayed on rejoin: once the
+        specs leave this agent's queue, a slow or dropped reply can
+        never strand them (the head re-places from the event)."""
+        def _handback(specs: list) -> None:
+            if not specs:
+                return
+
+            def _send() -> None:
+                for s in specs:
+                    self._lease_done(s.task_id)
+                with self._lease_lock:
+                    self._delegate_stats["revoked"] += len(specs)
+                self.send_event("lease_reclaimed", specs=specs)
+
+            # off the caller's thread: _handback fires on the head/
+            # worker connection reader (with the r10 poller, THE loop
+            # thread), and send_event is a blocking head send — a
+            # backpressured head must stall this hand-back, never the
+            # agent's entire read loop
+            threading.Thread(target=_send, name="rtpu-agent-reclaim",
+                             daemon=True).start()
+
+        self.scheduler.reclaim_tasks(list(msg.get("task_ids", ())),
+                                     _handback)
+
+    # --------------------------------- coalesced completions (r10)
+    def _delegates_to_head(self) -> bool:
+        return bool(_CFG.delegate) and self.head.peer_speaks_delegate()
+
+    def _park_done(self, entry: dict) -> None:
+        """Queue one plain-task completion for the next
+        NODE_TASK_DONE_BATCH (collect-then-flush via the shared
+        FlushLoop pacer: first entry opens a delegate_done_delay_ms
+        window, delegate_done_batch entries flush inline)."""
+        with self._done_lock:
+            self._done_buf.append(entry)
+            n = len(self._done_buf)
+        if n >= max(1, _CFG.delegate_done_batch):
+            self._flush_done_buf()
+        else:
+            self._done_flusher.wake()
+
+    def _flush_done_buf(self) -> None:
+        with self._done_lock:
+            if not self._done_buf:
+                return
+            batch, self._done_buf = self._done_buf, []
+            self._delegate_stats["done_batches"] += 1
+        self._send_to_head({"type": protocol.NODE_TASK_DONE_BATCH,
+                            "node_id": self.node_id, "done": batch},
+                           _flush_done=False)
 
     def _trace_dump_reply(self, conn: protocol.Connection,
                           msg: dict) -> None:
@@ -538,7 +783,8 @@ class NodeAgent:
                 return
             conn = protocol.Connection(sock, self._handle_local_msg,
                                        self._on_local_closed,
-                                       name="agent-local", server=True)
+                                       name="agent-local", server=True,
+                                       poller=self._poller)
             conn.start()
 
     def _on_local_closed(self, conn: protocol.Connection) -> None:
@@ -557,6 +803,12 @@ class NodeAgent:
             for task in tasks:
                 for oid in task.return_ids:
                     reap_object_segments(oid)
+                # lease bookkeeping: the head will recover these via
+                # the worker_lost event; they are off this agent's book
+                self._lease_done(task.task_id)
+        # send_event drains the parked done batch first (ordering:
+        # completions the dead worker DID deliver must reach the head
+        # before the loss event, or they'd be resubmitted)
         self.send_event("worker_lost", worker_id=wid, tasks=tasks,
                         actor_id=actor_id)
 
@@ -661,6 +913,8 @@ class NodeAgent:
                                 list(stored.contained_ids)))
         # release the ledger before telling the head (the head may
         # immediately route the next task here)
+        is_plain = not (msg.get("is_actor_create")
+                        or msg.get("is_actor_task"))
         if msg.get("is_actor_create"):
             self.scheduler.actor_ready(worker_id)
         elif msg.get("is_actor_task"):
@@ -669,10 +923,21 @@ class NodeAgent:
             self.scheduler.task_finished(worker_id, msg.get("task_id"))
         ctrl = {k: v for k, v in msg.items()
                 if k not in ("results", "rid", "type")}
+        entry = {"worker_id": worker_id, "inline": inline,
+                 "located": located, **ctrl}
+        # consume the lease UNCONDITIONALLY for plain tasks — even
+        # when the batch path below is momentarily off (e.g. a fresh
+        # head reconnect whose wire version is still unobserved), the
+        # ledger entry must not outlive the task
+        delegated = (self._lease_done(msg.get("task_id", ""))
+                     if is_plain else None)
+        if delegated is not None and self._delegates_to_head():
+            with self._lease_lock:
+                self._delegate_stats["tasks_done"] += 1
+            self._park_done(entry)     # rides the next done batch
+            return
         self._send_to_head({"type": protocol.NODE_TASK_DONE,
-                            "node_id": self.node_id,
-                            "worker_id": worker_id, "inline": inline,
-                            "located": located, **ctrl})
+                            "node_id": self.node_id, **entry})
 
     # ------------------------------------------------------ object gets
     def _on_get_object(self, conn: protocol.Connection, msg: dict) -> None:
@@ -815,7 +1080,8 @@ class NodeAgent:
                 return conn
         try:
             conn = protocol.connect(tuple(addr), lambda c, m: None,
-                                    name=f"peer-{addr[0]}:{addr[1]}")
+                                    name=f"peer-{addr[0]}:{addr[1]}",
+                                    poller=self._poller)
         except OSError:
             return None
         with self._peer_lock:

@@ -22,6 +22,8 @@ at the first frame, before any pickled leaf is decoded.
 from __future__ import annotations
 
 import itertools
+import os
+import select as _select
 import socket
 import struct
 import threading
@@ -29,7 +31,8 @@ import time
 from typing import Any, Callable, Optional
 
 from ray_tpu import native
-from ray_tpu._private.wire import (BATCH_MIN_MINOR, BATCH_TYPE, TRACE_KEY,
+from ray_tpu._private.wire import (BATCH_MIN_MINOR, BATCH_TYPE,
+                                   DELEGATE_MIN_MINOR, TRACE_KEY,
                                    TRACE_MIN_MINOR, WIRE_MAJOR,
                                    WireVersionError, dumps, dumps_batch,
                                    encode_batch_parts, encode_frame_parts,
@@ -106,6 +109,35 @@ BCAST_PLAN = "bcast_plan"              # head -> agent: pull object_id from
                                        #   the given parent, then serve
                                        #   your subtree
 
+# ---- delegated bulk-lease scheduling (r10; wire MINOR >= 3,
+# negotiated by observation like BatchFrame). The head stops being a
+# per-task participant: it grants agents BATCHES of queued tasks under
+# one lease and learns completions in coalesced batches; per-task
+# task_dispatched events are suppressed for leased tasks. ----
+NODE_LEASE_BATCH = "node_lease_batch"  # head -> agent: specs + lease_id
+                                       #   + resource budget snapshot
+NODE_TASK_DONE_BATCH = "node_task_done_batch"  # agent -> head: N task
+                                       #   completions (ctrl + inline/
+                                       #   located results each)
+NODE_LEASE_REVOKE = "node_lease_revoke"  # head -> agent, fire-and-
+                                       #   forget: reclaim queued-not-
+                                       #   started tasks (UNQUEUE
+                                       #   tombstone machinery for
+                                       #   worker FIFOs); the hand-back
+                                       #   is the agent's buffered
+                                       #   "lease_reclaimed" NODE_EVENT,
+                                       #   never a reply — a dropped
+                                       #   reply must not strand work
+NODE_FIND_TASK = "node_find_task"      # head -> agent (reply: state
+                                       #   pending|running|None +
+                                       #   worker_id) — cancel path's
+                                       #   substitute for the
+                                       #   suppressed dispatch events
+NODE_HB_RESYNC = "node_hb_resync"      # head -> agent: heartbeat seq
+                                       #   gap observed; send a full
+                                       #   snapshot next beat (N10
+                                       #   delta-sync)
+
 
 class ConnectionClosed(Exception):
     pass
@@ -136,7 +168,8 @@ class Connection:
     def __init__(self, sock: socket.socket,
                  handler: Callable[["Connection", dict], None],
                  on_close: Optional[Callable[["Connection"], None]] = None,
-                 name: str = "", server: bool = False):
+                 name: str = "", server: bool = False,
+                 poller: Optional["Poller"] = None):
         self._sock = sock
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # Bound sends only (recv stays blocking: connections idle for
@@ -171,11 +204,48 @@ class Connection:
         self._lazy_lock = threading.Lock()
         self._lazy_wake = threading.Event()
         self._lazy_thread: Optional[threading.Thread] = None
+        # r10 epoll loop: when a process-level Poller is attached, the
+        # read side is driven by its shared event loop instead of a
+        # dedicated reader thread. Pump state (native nb-reader or the
+        # Python reassembly buffer over a dup'd socket) is created at
+        # registration time by the poller.
+        self._poller = poller
+        self._nb_reader = None          # native.FrameReader (poller)
+        self._pump_sock: Optional[socket.socket] = None   # py fallback
+        self._pump_buf: Optional[bytearray] = None
+        self._pump_eof = False
+        self._finished = False
+        self._finish_lock = threading.Lock()
         self._reader = threading.Thread(
             target=self._read_loop, name=f"ray-tpu-conn-{name}", daemon=True)
 
     def start(self) -> None:
+        if self._poller is not None and self._poller.alive:
+            if self._server and _auth_token() is not None:
+                # auth handshake keeps its blocking semantics (size
+                # guard + 10s slowloris deadline, verified before ANY
+                # unpickling) on a short-lived thread; the connection
+                # joins the shared loop once authenticated
+                threading.Thread(
+                    target=self._auth_then_register,
+                    name=f"ray-tpu-auth-{self.name}",
+                    daemon=True).start()
+            else:
+                self._poller.register(self)
+            return
+        self._poller = None             # poller gone: thread fallback
         self._reader.start()
+
+    def _auth_then_register(self) -> None:
+        if not self._check_auth():
+            self._finish_read()         # closed: error futures etc.
+            return
+        poller = self._poller
+        if poller is not None and poller.alive:
+            poller.register(self)
+        else:
+            self._poller = None
+            self._reader.start()
 
     def send_auth(self) -> None:
         """Client side: present the shared secret as the raw first
@@ -306,6 +376,16 @@ class Connection:
     def _peer_speaks_batch(self) -> bool:
         v = self.peer_wire_version
         return v // 100 == WIRE_MAJOR and v % 100 >= BATCH_MIN_MINOR
+
+    def peer_speaks_delegate(self) -> bool:
+        """Whether the peer demonstrated the delegated-scheduling wire
+        (MINOR >= 3). Unknown (0) counts as NO: lease/done-batch ops
+        would be silently dropped by an old peer's handler, so the
+        sender stays on the per-task protocol until the peer proves
+        itself (registration traffic always arrives first in
+        practice)."""
+        v = self.peer_wire_version
+        return v // 100 == WIRE_MAJOR and v % 100 >= DELEGATE_MIN_MINOR
 
     def _peer_speaks_trace(self) -> bool:
         """Whether trace context may ride this connection's envelopes.
@@ -503,6 +583,23 @@ class Connection:
             del buf[:total]
             self._handle_frame(frame)
 
+    @staticmethod
+    def _log_read_error(name: str, exc: BaseException) -> bool:
+        """Shared reader-exit reporting (thread loop + poller): True
+        when the exception was recognized and reported."""
+        import sys as _sys
+        if isinstance(exc, FrameTooLarge):
+            _sys.stderr.write(
+                f"ray_tpu: killing connection ({name}): {exc}\n")
+            return True
+        if isinstance(exc, (ConnectionClosed, OSError)):
+            return True
+        if isinstance(exc, WireVersionError):
+            _sys.stderr.write(
+                f"ray_tpu: refusing connection ({name}): {exc}\n")
+            return True
+        return False
+
     def _read_loop(self) -> None:
         try:
             if self._server and not self._check_auth():
@@ -511,31 +608,114 @@ class Connection:
                 self._native_read_loop()
             else:
                 self._py_read_loop()
-        except FrameTooLarge as e:
-            import sys as _sys
-            _sys.stderr.write(
-                f"ray_tpu: killing connection ({self.name}): {e}\n")
-        except (ConnectionClosed, OSError):
-            pass
-        except WireVersionError as e:
-            import sys as _sys
-            _sys.stderr.write(
-                f"ray_tpu: refusing connection ({self.name}): {e}\n")
-        except Exception:  # handler bug; don't kill silently
-            import traceback
-            traceback.print_exc()
+        except Exception as e:
+            if not self._log_read_error(self.name, e):
+                import traceback
+                traceback.print_exc()   # handler bug; don't kill silently
         finally:
-            self.close()     # reader exit = stream dead; release the fd
-            self._closed.set()
-            with self._pending_lock:
-                pending, self._pending = self._pending, {}
-            for fut in pending.values():
-                fut.set_error(ConnectionClosed("connection lost"))
-            if self._on_close is not None:
-                try:
-                    self._on_close(self)
-                except Exception:
-                    pass
+            self._finish_read()
+
+    def _finish_read(self) -> None:
+        """Reader-exit finalization (thread loop finally / poller
+        drop): the stream is dead — release fds, fail outstanding
+        request futures, fire on_close. Idempotent: the poller and a
+        racing close() may both arrive here."""
+        with self._finish_lock:
+            if self._finished:
+                return
+            self._finished = True
+        self.close()     # reader exit = stream dead; release the fd
+        if self._nb_reader is not None:
+            self._nb_reader.close()
+        if self._pump_sock is not None:
+            try:
+                self._pump_sock.close()
+            except OSError:
+                pass
+        self._closed.set()
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            fut.set_error(ConnectionClosed("connection lost"))
+        if self._on_close is not None:
+            try:
+                self._on_close(self)
+            except Exception:
+                pass
+
+    # ---- poller-driven receiving (r10) ----
+    def _attach_pump(self, use_native: bool) -> int:
+        """Create this connection's non-blocking pump state and return
+        the fd the poller should watch. Both engines read a DUP of the
+        socket fd: the dup pins the open file description, so a
+        concurrent Connection.close() (shutdown + close of the
+        original) surfaces as EOF on the watched fd instead of racing
+        fd reuse; the dup is closed in _finish_read."""
+        from ray_tpu._private.config import CONFIG
+        if use_native:
+            self._nb_reader = native.FrameReader(
+                self._sock.fileno(), CONFIG.wire_max_frame_bytes)
+            return self._nb_reader.fd
+        self._pump_sock = socket.socket(
+            fileno=os.dup(self._sock.fileno()))
+        self._pump_buf = bytearray()
+        return self._pump_sock.fileno()
+
+    def _poll_pump(self) -> list[bytes]:
+        """Drain readable bytes (never blocking) and return the
+        complete frame bodies buffered so far; [] when no complete
+        frame is ready yet. Raises ConnectionClosed / FrameTooLarge
+        exactly like the blocking read loops."""
+        if self._nb_reader is not None:
+            try:
+                return self._nb_reader.pump_nb()
+            except native.PumpClosed:
+                raise ConnectionClosed("peer closed") from None
+            except native.PumpOversized as e:
+                raise FrameTooLarge(str(e)) from None
+        from ray_tpu._private.config import CONFIG
+        max_frame = CONFIG.wire_max_frame_bytes
+        buf = self._pump_buf
+        while not self._pump_eof:
+            # mirror the C pump: stop reading the moment a complete
+            # frame is buffered (the level-triggered poller re-reports
+            # the fd while kernel bytes remain)
+            if len(buf) >= _LEN.size:
+                (length,) = _LEN.unpack_from(buf)
+                if length > max_frame:
+                    raise FrameTooLarge(
+                        f"frame length prefix {length} exceeds "
+                        f"wire_max_frame_bytes ({max_frame})")
+                if len(buf) >= _LEN.size + length:
+                    break
+            try:
+                chunk = self._pump_sock.recv(1 << 20,
+                                             socket.MSG_DONTWAIT)
+            except BlockingIOError:
+                break
+            except OSError as e:
+                raise ConnectionClosed(str(e)) from e
+            if not chunk:
+                self._pump_eof = True
+                break
+            buf += chunk
+        frames = []
+        while len(buf) >= _LEN.size:
+            (length,) = _LEN.unpack_from(buf)
+            if length > max_frame:
+                if frames:
+                    break      # dispatch what's whole; next pass dies
+                raise FrameTooLarge(
+                    f"frame length prefix {length} exceeds "
+                    f"wire_max_frame_bytes ({max_frame})")
+            total = _LEN.size + length
+            if len(buf) < total:
+                break
+            frames.append(bytes(memoryview(buf)[_LEN.size:total]))
+            del buf[:total]
+        if not frames and self._pump_eof:
+            raise ConnectionClosed("peer closed")
+        return frames
 
     @property
     def closed(self) -> bool:
@@ -552,6 +732,268 @@ class Connection:
             self._sock.close()
         except OSError:
             pass
+
+
+class FlushLoop:
+    """Shared collect-then-flush pacer for message-level batching
+    buffers (r10: the head-side lease buffer and the agent-side
+    completion buffer) — the same window shape as the wire coalescer's
+    lazy-queue flusher, factored out so the two sites cannot drift.
+
+    wake() lazily starts a daemon thread, opens a delay_ms-wide
+    window, then calls flush_fn(); callers flush inline themselves
+    when a count threshold hits. stop() is race-free by construction:
+    the dead flag is set BEFORE the event, and the loop re-checks it
+    after every wait/sleep, so a stopped owner can never strand the
+    thread in wait() forever."""
+
+    def __init__(self, flush_fn: Callable[[], None],
+                 delay_ms_fn: Callable[[], float], name: str):
+        self._flush = flush_fn
+        self._delay_ms = delay_ms_fn
+        self._name = name
+        self._wake_ev = threading.Event()
+        self._dead = False
+        self._thread: Optional[threading.Thread] = None
+        self._spawn_lock = threading.Lock()
+
+    def wake(self) -> None:
+        if self._dead:
+            return
+        if self._thread is None:
+            with self._spawn_lock:
+                if self._thread is None and not self._dead:
+                    self._thread = threading.Thread(
+                        target=self._loop, name=self._name, daemon=True)
+                    self._thread.start()
+        self._wake_ev.set()
+
+    def stop(self) -> None:
+        self._dead = True           # BEFORE the wake: loop must see it
+        self._wake_ev.set()
+
+    def _loop(self) -> None:
+        while True:
+            self._wake_ev.wait()
+            if self._dead:
+                return
+            delay = max(0.0, self._delay_ms() / 1000.0)
+            if delay:
+                time.sleep(delay)
+            self._wake_ev.clear()
+            if self._dead:
+                return
+            try:
+                self._flush()
+            except Exception:
+                pass        # a failed flush must not kill the pacer
+                            # (send paths already contain their errors)
+
+
+class Poller:
+    """Process-level read event loop (r10): ONE thread drives the read
+    side of every registered connection, replacing thread-per-
+    connection reads on the head and agents (reference raylet/GCS run
+    their RPC stacks on shared asio event loops the same way).
+
+    Engine: the native epoll API (``rtpu_poller_*`` in core.c —
+    epoll_wait blocks with the GIL released, level-triggered, each
+    ready fd drained through its connection's C reassembly buffer via
+    the MSG_DONTWAIT pump) when the frame engine is on; a
+    ``select.select`` Python fallback otherwise (RAY_TPU_DISABLE_NATIVE
+    / RAY_TPU_WIRE_NATIVE=0). RAY_TPU_EPOLL=0 disables the loop
+    entirely and every connection keeps its own reader thread.
+
+    Liveness rules baked in here:
+    - handlers run on the loop thread, so anything that might block on
+      another poller-served connection's REPLY must not run here —
+      connection teardown (whose on_close callbacks issue blocking
+      bundle/cancel RPCs during node death), the cancel_task state op,
+      and the lease-revoke hand-back are all dispatched to throwaway
+      threads;
+    - a connection that dies only kills itself: handler bugs and
+      corrupt streams are contained exactly like the per-thread loop.
+
+    Known tradeoff: handlers' plain SENDS (replies, forwarded events)
+    still run on the loop thread, so a peer that stops draining its
+    socket can stall the whole process's read plane for up to the
+    send budget (SO_SNDTIMEO, 30s) instead of one connection's reader
+    as under thread-per-connection. The budget bounds the stall and
+    then kills the wedged connection; deployments that cannot accept
+    it set RAY_TPU_EPOLL=0. Moving the send plane behind per-
+    connection outbound queues is the designed escape hatch if this
+    ever bites in practice.
+    """
+
+    def __init__(self):
+        self._use_native = native.frame_engine_enabled()
+        self._conns: dict[int, Connection] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wake_r, self._wake_w = os.pipe()
+        self._ep = None
+        if self._use_native:
+            self._ep = native.EpollPoller()
+            self._ep.add(self._wake_r)
+        self._thread = threading.Thread(
+            target=self._loop, name="ray-tpu-poller", daemon=True)
+        self._thread.start()
+
+    @property
+    def alive(self) -> bool:
+        return not self._stop.is_set()
+
+    @property
+    def engine(self) -> str:
+        return "epoll" if self._use_native else "select"
+
+    def register(self, conn: Connection) -> None:
+        """Attach a connection's read side to the loop. Falls back to
+        the connection's own reader thread on any setup failure (e.g.
+        the select() fd limit)."""
+        try:
+            fd = conn._attach_pump(self._use_native)
+            if self._ep is None and fd >= 1024:
+                # select() caps at FD_SETSIZE; a bigger fd would make
+                # every select call raise. This connection reads on
+                # its own thread instead (pump state is closed by
+                # _finish_read there).
+                raise ValueError("fd exceeds select() FD_SETSIZE")
+            # epoll add BEFORE the _conns insert: if the kernel
+            # refuses the watch, the thread fallback below must not
+            # leave a stale fd->conn mapping behind (a later reuse of
+            # that fd number would alias an unrelated connection)
+            if self._ep is not None:
+                self._ep.add(fd)
+            with self._lock:
+                self._conns[fd] = conn
+            if self._ep is None:
+                self._wake()
+        except (OSError, ValueError):
+            conn._poller = None
+            conn._reader.start()
+
+    def _wake(self) -> None:
+        try:
+            os.write(self._wake_w, b"x")
+        except OSError:
+            pass
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self._ep is not None:
+                    ready = self._ep.wait(500)
+                else:
+                    with self._lock:
+                        fds = list(self._conns)
+                    fds.append(self._wake_r)
+                    try:
+                        ready, _, _ = _select.select(fds, [], [], 0.5)
+                    except (OSError, ValueError):
+                        # a fd closed between snapshot and select:
+                        # prune dead entries and retry
+                        self._prune()
+                        continue
+            except OSError:
+                if self._stop.is_set():
+                    return
+                time.sleep(0.05)
+                continue
+            for fd in ready:
+                if fd == self._wake_r:
+                    try:
+                        os.read(self._wake_r, 4096)
+                    except OSError:
+                        pass
+                    continue
+                with self._lock:
+                    conn = self._conns.get(fd)
+                if conn is not None:
+                    self._service(fd, conn)
+
+    def _prune(self) -> None:
+        """Drop select-fallback entries whose fd died under us."""
+        with self._lock:
+            items = list(self._conns.items())
+        for fd, conn in items:
+            try:
+                os.fstat(fd)
+            except OSError:
+                self._drop(fd, conn)
+
+    def _service(self, fd: int, conn: Connection) -> None:
+        try:
+            frames = conn._poll_pump()
+            for frame in frames:
+                conn._handle_frame(frame)
+        except Exception as e:
+            if not Connection._log_read_error(conn.name, e):
+                import traceback
+                traceback.print_exc()   # handler bug: that conn dies
+            self._drop(fd, conn)
+
+    def _drop(self, fd: int, conn: Connection) -> None:
+        with self._lock:
+            self._conns.pop(fd, None)
+        if self._ep is not None:
+            try:
+                self._ep.remove(fd)
+            except OSError:
+                pass
+        # Teardown OFF the loop thread: on_close callbacks may issue
+        # blocking RPCs whose replies arrive over OTHER poller-served
+        # connections (node-death -> bundle re-reserve), which would
+        # deadlock the loop against itself.
+        threading.Thread(target=conn._finish_read,
+                         name=f"ray-tpu-conn-close-{conn.name}",
+                         daemon=True).start()
+
+    def close(self) -> None:
+        """Stop the loop and tear down every still-registered
+        connection (their futures must error, not hang)."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._wake()
+        self._thread.join(timeout=5.0)
+        with self._lock:
+            conns, self._conns = dict(self._conns), {}
+        for fd, conn in conns.items():
+            if self._ep is not None:
+                try:
+                    self._ep.remove(fd)
+                except OSError:
+                    pass
+            threading.Thread(target=conn._finish_read,
+                             name=f"ray-tpu-conn-close-{conn.name}",
+                             daemon=True).start()
+        if self._ep is not None:
+            self._ep.close()
+        for fd in (self._wake_r, self._wake_w):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+    @property
+    def num_connections(self) -> int:
+        with self._lock:
+            return len(self._conns)
+
+
+def make_poller() -> Optional[Poller]:
+    """A process Poller when the epoll loop is enabled (RAY_TPU_EPOLL,
+    default on), else None — callers pass the result straight to
+    Connection/connect, so EPOLL=0 restores thread-per-connection
+    reads everywhere."""
+    from ray_tpu._private.config import CONFIG
+    if not CONFIG.epoll:
+        return None
+    try:
+        return Poller()
+    except OSError:
+        return None
 
 
 class _Future:
@@ -607,9 +1049,10 @@ class _Future:
 def connect(addr: tuple[str, int],
             handler: Callable[[Connection, dict], None],
             on_close: Optional[Callable[[Connection], None]] = None,
-            name: str = "") -> Connection:
+            name: str = "",
+            poller: Optional[Poller] = None) -> Connection:
     sock = socket.create_connection(addr)
-    conn = Connection(sock, handler, on_close, name=name)
+    conn = Connection(sock, handler, on_close, name=name, poller=poller)
     conn.send_auth()             # no-op unless RAY_TPU_AUTH_TOKEN is set
     conn.start()
     return conn

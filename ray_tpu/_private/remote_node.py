@@ -18,9 +18,12 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from typing import Any, Optional
 
 from ray_tpu._private import protocol
+from ray_tpu._private import tracing_plane as _tp
+from ray_tpu._private.config import CONFIG as _CFG
 from ray_tpu._private.specs import ActorSpec, ActorTaskSpec, TaskSpec
 
 _RPC_TIMEOUT = 30.0
@@ -54,20 +57,68 @@ class RemoteNodeHandle:
         # move via the trace_dump pull) — surfaced by trace_stats
         self.trace_watermark = 0
         self._dead = False
+        # ---- delegated bulk-lease dispatch (r10) ----
+        # Specs parked for the next NODE_LEASE_BATCH flush. They are
+        # ALREADY mirrored in _work (death recovery / cancel see them
+        # immediately); the buffer only batches the wire send.
+        self._lease_buf: list = []
+        self._lease_lock = threading.Lock()
+        # Serializes the pop-build-send of a lease batch: the "flush
+        # before cancel/revoke" guards must not return while another
+        # thread holds a popped-but-unsent batch, or the cancel frame
+        # would overtake its own task's lease on the wire.
+        self._lease_send_lock = threading.Lock()
+        self._lease_flusher = protocol.FlushLoop(
+            self.flush_leases,
+            lambda: _CFG.delegate_lease_delay_ms,
+            f"rtpu-lease-{node_id}")
+        # task_ids granted to the agent and not yet reported done —
+        # the outstanding count the delegate_max_inflight budget caps
+        self._leased: set[str] = set()
+        self._leases_sent = 0
+        self._tasks_leased = 0
+        # agent-reported delegate counters (ride heartbeats)
+        self.delegate_stats: dict = {}
+        # ---- N10 heartbeat delta-sync ----
+        self._hb_seq = -1
+        self._hb_last_resync = 0.0
 
     # ------------------------------------------------------- heartbeat
     def on_heartbeat(self, msg: dict) -> None:
+        """Apply a heartbeat — full snapshot or an N10 delta. Deltas
+        (hb_delta=True, MINOR >= 3 agents) carry ONLY the keys that
+        changed since the previous beat; absent keys mean "unchanged",
+        so application is update-if-present. A seq gap (dropped/
+        reordered beat) applies best-effort and asks the agent for a
+        full snapshot via NODE_HB_RESYNC; pre-delta agents send every
+        key every beat and take the same path as a full snapshot."""
+        seq = msg.get("hb_seq")
+        gap = False
         with self._lock:
-            self.avail = dict(msg.get("avail", self.avail))
-            self.total = dict(msg.get("total", self.total))
-            self._pending_demand = dict(msg.get("pending_demand", {}))
-            self._pending_shapes = list(msg.get("pending_shapes", []))
-            self._idle = bool(msg.get("is_idle", False))
-            self._last_workers = list(msg.get("workers", []))
+            if seq is not None:
+                if msg.get("hb_delta") and seq != self._hb_seq + 1:
+                    gap = True
+                self._hb_seq = int(seq)
+            if "avail" in msg:
+                self.avail = dict(msg["avail"])
+            if "total" in msg:
+                self.total = dict(msg["total"])
+            if "pending_demand" in msg:
+                self._pending_demand = dict(msg["pending_demand"])
+            if "pending_shapes" in msg:
+                self._pending_shapes = list(msg["pending_shapes"])
+            if "is_idle" in msg:
+                self._idle = bool(msg["is_idle"])
+            if "workers" in msg:
+                self._last_workers = list(msg["workers"])
             # agent-process frame counters (r7 telemetry; {} from
             # pre-r7 agents) — debug surface for per-node wire load
-            self.wire_stats = dict(msg.get("wire", {}))
-            self.trace_watermark = int(msg.get("trace_watermark", 0))
+            if "wire" in msg:
+                self.wire_stats = dict(msg["wire"])
+            if "trace_watermark" in msg:
+                self.trace_watermark = int(msg["trace_watermark"])
+            if "delegate" in msg:
+                self.delegate_stats = dict(msg["delegate"])
             op = dict(msg.get("object_plane", {}))
             if op:
                 # serves_per_object rides heartbeats only when it
@@ -77,6 +128,11 @@ class RemoteNodeHandle:
                     op["serves_per_object"] = (
                         self.object_plane["serves_per_object"])
                 self.object_plane = op
+        if gap:
+            now = time.monotonic()
+            if now - self._hb_last_resync > 1.0:   # one ask per gap
+                self._hb_last_resync = now
+                self._send({"type": protocol.NODE_HB_RESYNC})
 
     def workers_snapshot(self) -> list:
         """Worker table rows as of the last heartbeat."""
@@ -129,18 +185,155 @@ class RemoteNodeHandle:
             return "actor:" + spec.actor_id
         return spec.task_id
 
+    def delegates(self) -> bool:
+        """Delegated bulk-lease dispatch is on for this agent: enabled
+        by config (RAY_TPU_DELEGATE) AND the agent demonstrated wire
+        MINOR >= 3 (negotiated by observation, like BatchFrame)."""
+        return bool(_CFG.delegate) and self.conn.peer_speaks_delegate()
+
     def enqueue(self, spec) -> None:
         with self._lock:
             self._work[self._key(spec)] = (spec, False)
+        if isinstance(spec, TaskSpec) and self.delegates():
+            self._park_lease(spec)
+            return
         self._send({"type": protocol.NODE_ENQUEUE, "spec": spec})
 
     enqueue_front = enqueue
+
+    # ---- bulk leases (r10) ----
+    def _park_lease(self, spec: TaskSpec) -> None:
+        """Park a spec for the next NODE_LEASE_BATCH: the first parked
+        spec opens a delegate_lease_delay_ms collect window (shared
+        FlushLoop pacer); hitting delegate_lease_batch flushes inline.
+        Mirrors the wire-level coalescing queue's collect-then-flush
+        shape one level up — whole specs instead of frames."""
+        with self._lease_lock:
+            self._lease_buf.append(spec)
+            n = len(self._lease_buf)
+        if n >= max(1, _CFG.delegate_lease_batch):
+            self.flush_leases()
+        else:
+            self._lease_flusher.wake()
+
+    def _budget_room(self) -> int:
+        cap = _CFG.delegate_max_inflight
+        if cap <= 0:
+            return 1 << 30
+        return max(0, cap - len(self._leased))
+
+    def flush_leases(self) -> None:
+        """Ship parked specs as ONE NODE_LEASE_BATCH (bounded by the
+        outstanding-task budget; the remainder stays parked and
+        re-flushes as done batches free room). Carries the head's
+        resource-budget snapshot for the agent's lease ledger.
+
+        The whole pop→build→send runs under _lease_send_lock: callers
+        using this as an ordering barrier (cancel_pending /
+        revoke_lease flush-first guards) must not observe an "empty"
+        buffer while another thread still holds an unsent batch."""
+        if self._dead:
+            return                       # mirror already drained
+        with self._lease_send_lock:
+            self._flush_leases_locked()
+
+    def _flush_leases_locked(self) -> None:
+        with self._lease_lock:
+            if not self._lease_buf:
+                return
+            room = self._budget_room()
+            if room <= 0:
+                return
+            batch, self._lease_buf = (self._lease_buf[:room],
+                                      self._lease_buf[room:])
+            # drop specs cancel/death already removed from the mirror
+            with self._lock:
+                batch = [s for s in batch if s.task_id in self._work]
+                self._leased.update(s.task_id for s in batch)
+            if not batch:
+                return
+            lease_id = "ls_" + uuid.uuid4().hex[:12]
+            self._leases_sent += 1
+            self._tasks_leased += len(batch)
+        if _tp.enabled():
+            # one tiny "lease_batch" span per traced spec, spliced
+            # between the driver's submit span and the agent-side
+            # queue/lease spans (specs re-parent under it), so the
+            # delegated hop reads off the timeline: submit ->
+            # lease_batch -> queue -> lease -> exec -> done
+            t_now = _tp.now()
+            for s in batch:
+                if getattr(s, "trace_id", 0):
+                    sid = _tp.new_id()
+                    _tp.record("head", "lease_batch", t_now, t_now,
+                               s.trace_id, sid,
+                               getattr(s, "parent_span", 0),
+                               {"n": len(batch), "node": self.node_id})
+                    s.parent_span = sid
+        self._send({"type": protocol.NODE_LEASE_BATCH,
+                    "lease_id": lease_id, "specs": batch,
+                    "budget": self.effective_avail()})
+
+    def kick_lease_flush(self) -> None:
+        """Completions freed outstanding-budget room: retry the flush
+        (no-op when nothing is parked)."""
+        if self._lease_buf:
+            self.flush_leases()
+
+    def steal_candidates(self, limit: int = 64) -> list[str]:
+        """Leased task_ids eligible for a rebalance revoke: plain
+        tasks without node-affinity/PG constraints that haven't
+        exhausted their spill budget (the same rules cluster.try_spill
+        applies to local queues). The agent-side reclaim then filters
+        to queued-NOT-started — running tasks always stay put."""
+        out: list[str] = []
+        with self._lock:
+            for tid in self._leased:
+                entry = self._work.get(tid)
+                if entry is None:
+                    continue
+                spec = entry[0]
+                if (getattr(spec, "node_id", None)
+                        or getattr(spec, "placement_group_id", None)
+                        or getattr(spec, "_spill_count", 0) >= 3):
+                    continue
+                out.append(tid)
+                if len(out) >= limit:
+                    break
+        return out
+
+    def revoke_lease(self, task_ids: list[str]) -> None:
+        """Ask the agent to reclaim queued-not-started tasks (lease
+        revoke / steal). Fire-and-forget BY DESIGN: the hand-back is
+        the agent's ``lease_reclaimed`` NODE EVENT — buffered across
+        head outages agent-side and deduped head-side by the mirror
+        pop — so a slow or dropped reply can never strand work that
+        already left the agent's queue (a request/reply here did
+        exactly that on timeout). Tasks the agent already started stay
+        leased there and complete normally."""
+        self.flush_leases()      # revoke must not overtake its lease
+        self._send({"type": protocol.NODE_LEASE_REVOKE,
+                    "task_ids": list(task_ids)})
 
     def cancel_pending(self, task_id: str) -> Optional[TaskSpec]:
         with self._lock:
             entry = self._work.get(task_id)
         if entry is None or entry[1]:
             return None                    # unknown or already running
+        # A spec still parked in the lease buffer (budget-saturated
+        # flush left it behind) cancels locally — the agent has never
+        # seen it, so the RPC below would miss and the task would
+        # lease out and run later despite the cancel. Under the send
+        # lock: no concurrent popped-but-unsent batch can hold it.
+        with self._lease_send_lock:
+            with self._lease_lock:
+                for i, s in enumerate(self._lease_buf):
+                    if s.task_id == task_id:
+                        del self._lease_buf[i]
+                        with self._lock:
+                            entry = self._work.pop(task_id, None)
+                        return entry[0] if entry else None
+        self.flush_leases()  # the cancel must not overtake its lease
         try:
             rep = self.conn.request({"type": protocol.NODE_CANCEL_PENDING,
                                      "task_id": task_id},
@@ -150,17 +343,34 @@ class RemoteNodeHandle:
         if rep.get("found"):
             with self._lock:
                 entry = self._work.pop(task_id, None)
+                self._leased.discard(task_id)
             return entry[0] if entry else None
         return None
 
     def worker_running_task(self, task_id: str):
         with self._lock:
             entry = self._work.get(task_id)
-            if entry is None or not entry[1]:
+            if entry is None:
                 return None
             spec = entry[0]
-            wid = getattr(spec, "_worker_id", None)
-        return (wid, spec) if wid is not None else None
+            wid = getattr(spec, "_worker_id", None) if entry[1] else None
+            delegated = task_id in self._leased
+        if wid is not None:
+            return (wid, spec)
+        if not delegated:
+            return None
+        # Delegated mode suppresses per-task dispatch events, so the
+        # mirror can't know the worker: ask the agent (cancel path
+        # only — runs on a driver thread, never a reader).
+        try:
+            rep = self.conn.request({"type": protocol.NODE_FIND_TASK,
+                                     "task_id": task_id},
+                                    timeout=_RPC_TIMEOUT)
+        except (protocol.ConnectionClosed, TimeoutError):
+            return None
+        if rep.get("state") == "running" and rep.get("worker_id"):
+            return (rep["worker_id"], spec)
+        return None
 
     def cancel_running(self, worker_id: str, task_id: str) -> bool:
         return self._send({"type": protocol.NODE_CANCEL_RUNNING,
@@ -220,6 +430,9 @@ class RemoteNodeHandle:
         """Remove + return the mirrored spec (None if unknown)."""
         with self._lock:
             entry = self._work.pop(key, None)
+            self._leased.discard(key)
+        if self._lease_buf:
+            self.kick_lease_flush()    # completion freed budget room
         return entry[0] if entry else None
 
     def track_live_actor(self, actor_id: str, spec) -> None:
@@ -237,9 +450,19 @@ class RemoteNodeHandle:
         pass
 
     def drain_for_death(self):
-        """(queued specs, running TaskSpecs, actor ids) from the mirror."""
+        """(queued specs, running TaskSpecs, actor ids) from the mirror.
+
+        Delegated tasks (leased or still parked in the lease buffer)
+        sit in the mirror with dispatched=False, so they all come back
+        as "queued" and re-place through cluster.submit exactly once —
+        the agent's workers died with it, so no completion can race a
+        resubmission into a double execution."""
+        self._lease_flusher.stop()       # dead-before-wake, race-free
+        with self._lease_lock:
+            self._lease_buf.clear()
         with self._lock:
             self._dead = True
+            self._leased.clear()
             work = list(self._work.values())
             self._work.clear()
             self._workers.clear()
@@ -263,6 +486,8 @@ class RemoteNodeHandle:
             pass
 
     def shutdown(self) -> None:
+        self._dead = True
+        self._lease_flusher.stop()
         self._send({"type": protocol.NODE_SHUTDOWN})
         try:
             self.conn.close()
@@ -277,6 +502,11 @@ class RemoteNodeHandle:
                 "available_resources": dict(self.avail),
                 "num_pending_tasks": len(self._pending_shapes),
                 "mirrored_work": len(self._work),
+                "delegated": self.delegates(),
+                "leased_outstanding": len(self._leased),
+                "lease_batches_sent": self._leases_sent,
+                "tasks_leased": self._tasks_leased,
+                "delegate_stats": dict(self.delegate_stats),
             }
 
     # --------------------------------------------------------- helpers

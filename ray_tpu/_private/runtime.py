@@ -124,6 +124,10 @@ class Runtime(_context.BaseContext):
 
         from ray_tpu._private.config import CONFIG as _CFG2
         bind = bind_host or _CFG2.bind_host
+        # r10: one epoll/select event loop reads every accepted
+        # connection (workers, agents, clients) instead of a reader
+        # thread each; None (RAY_TPU_EPOLL=0) restores threads.
+        self._poller = protocol.make_poller()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((bind, int(port or _CFG2.port)))
@@ -293,7 +297,7 @@ class Runtime(_context.BaseContext):
                 return
             conn = protocol.Connection(sock, self._handle_msg,
                                        self._on_conn_closed, name="driver",
-                                       server=True)
+                                       server=True, poller=self._poller)
             conn.start()
 
     def _on_conn_closed(self, conn: protocol.Connection) -> None:
@@ -528,6 +532,21 @@ class Runtime(_context.BaseContext):
                             pass
                     threading.Thread(target=_td, name="rtpu-trace-dump",
                                      daemon=True).start()
+                elif msg["op"] == "cancel_task":
+                    # issues blocking NODE_CANCEL_PENDING /
+                    # NODE_FIND_TASK RPCs to agents whose replies
+                    # arrive on THIS reader (with the r10 shared
+                    # poller: on the one loop thread serving every
+                    # connection) — same rule as trace_dump/broadcast:
+                    # never collect on a connection reader
+                    def _ct(conn=conn, msg=msg, kwargs=kwargs):
+                        try:
+                            conn.reply(msg, value=self.state_op(
+                                "cancel_task", **kwargs))
+                        except protocol.ConnectionClosed:
+                            pass
+                    threading.Thread(target=_ct, name="rtpu-cancel",
+                                     daemon=True).start()
                 elif msg["op"] == "broadcast_object":
                     # blocks until the whole tree completes — never on
                     # a connection reader thread
@@ -579,6 +598,8 @@ class Runtime(_context.BaseContext):
             self._on_node_event(conn, msg)
         elif mtype == protocol.NODE_TASK_DONE:
             self._on_node_task_done(conn, msg)
+        elif mtype == protocol.NODE_TASK_DONE_BATCH:
+            self._on_node_task_done_batch(conn, msg)
         elif mtype == protocol.OBJECT_LOOKUP:
             self._on_object_lookup(conn, msg)
         elif mtype == protocol.LOCATE_OBJECT:
@@ -691,6 +712,26 @@ class Runtime(_context.BaseContext):
                 if proxy is not None:
                     proxy.on_finished("actor:" + actor_id)
                 self._recover_actor(actor_id)
+        elif kind == "lease_reclaimed":
+            # r10 lease revoke hand-back: the agent pulled these
+            # queued-not-started tasks out of its queue — re-place the
+            # MIRROR specs (authoritative retry/trace state). The pop
+            # is the dedup guard: a replayed event or a racing death
+            # drain finds the mirror empty and does nothing, so a task
+            # is re-placed at most once.
+            for spec in msg.get("specs", ()):
+                mirror = (proxy.on_finished(spec.task_id)
+                          if proxy is not None else None)
+                if mirror is None:
+                    continue
+                try:
+                    # same churn cap as spillback: a task bounced
+                    # between saturated nodes stops moving after 3 hops
+                    mirror._spill_count = \
+                        getattr(mirror, "_spill_count", 0) + 1
+                except AttributeError:
+                    pass
+                self.cluster.submit(mirror)
         elif kind == "unplaceable":
             if proxy is not None:
                 proxy.on_finished(proxy._key(msg["spec"]))
@@ -726,6 +767,27 @@ class Runtime(_context.BaseContext):
                                  msg: dict) -> None:
         node_id = msg["node_id"]
         proxy = self._proxy_for(node_id)
+        self._apply_node_done(node_id, proxy, msg)
+
+    def _on_node_task_done_batch(self, conn: protocol.Connection,
+                                 msg: dict) -> None:
+        """NODE_TASK_DONE_BATCH (r10 delegated dispatch): N plain-task
+        completions in ONE frame — each entry is the control half of a
+        classic NODE_TASK_DONE (worker_id, inline/located results,
+        error, per-entry trace context). One decode + one handler
+        invocation amortizes the head's per-completion cost; the
+        per-entry bookkeeping (seal, directory, mirror, task events)
+        is unchanged."""
+        node_id = msg["node_id"]
+        proxy = self._proxy_for(node_id)
+        for entry in msg.get("done", ()):
+            t_tr = _tp.recv_t0(entry)
+            try:
+                self._apply_node_done(node_id, proxy, entry)
+            finally:
+                self._record_done(entry, t_tr)
+
+    def _apply_node_done(self, node_id: str, proxy, msg: dict) -> None:
         for stored in msg.get("inline", []):
             self._seal_contained(stored.object_id, stored.contained_ids)
             self.store.put_stored(stored)
@@ -1621,7 +1683,10 @@ class Runtime(_context.BaseContext):
         for step in (self.cluster.shutdown, self.waiters.shutdown,
                      self.controller.pubsub.close,
                      lambda: self._restore_pool.shutdown(wait=False),
-                     self._listener.close, self.store.shutdown,
+                     self._listener.close,
+                     lambda: (self._poller.close()
+                              if self._poller is not None else None),
+                     self.store.shutdown,
                      self._sweep_orphan_segments):
             try:
                 step()

@@ -273,6 +273,23 @@ class Scheduler:
                 self._try_dispatch_locked(self._INLINE_SCAN_LIMIT)
             self._cv.notify_all()
 
+    def enqueue_many(self, specs) -> None:
+        """Queue a bulk-lease batch under ONE lock acquisition with
+        ONE trailing dispatch sweep (r10 delegated dispatch: a 64-spec
+        lease would otherwise pay 64 lock round-trips and up to 64
+        inline sweeps on the agent's head-connection reader)."""
+        if not specs:
+            return
+        with self._cv:
+            now = time.monotonic()
+            for spec in specs:
+                self._pending.append(spec)
+                self._queued_at[id(spec)] = now
+                self._demand_add(spec)
+            if self._running:
+                self._try_dispatch_locked(self._INLINE_SCAN_LIMIT)
+            self._cv.notify_all()
+
     def enqueue_front(self, spec) -> None:
         with self._cv:
             self._pending.appendleft(spec)
@@ -503,6 +520,35 @@ class Scheduler:
         for tid in steal:
             self._steal_queued_task(rec, tid)
 
+    def _pop_worker_task_locked(self, rec: WorkerRec,
+                                task_id: str) -> Optional[TaskSpec]:
+        """UNQUEUE accounting shared by the steal-back and lease-
+        reclaim paths: remove a worker-confirmed-unstarted task from
+        rec's FIFO mirror and settle its resource charge. Caller holds
+        the lock and has an ``ok`` UNQUEUE reply in hand; returns the
+        spec, or None when the record went stale (worker replaced /
+        task already gone)."""
+        cur = self._workers.get(rec.worker_id)
+        if cur is not rec:
+            return None
+        spec = rec.tasks.pop(task_id, None)
+        need_pg = rec.task_res.pop(task_id, None)
+        if spec is None:
+            return None
+        if need_pg is not None and need_pg[2]:
+            if rec.blocked_depth == 0:
+                # the worker unblocked between steal and reply, so its
+                # charges were re-acquired — release this one
+                # (uncharged pipelined tasks never held a share)
+                release(self._ledger_for_key(need_pg[1]), need_pg[0])
+            # a charged entry left the chain: hand its share to the
+            # next queued task, or the rest of the pipeline would run
+            # permanently uncharged
+            self._promote_next_charge_locked(rec)
+        if rec.state == BUSY and not rec.tasks:
+            rec.state = IDLE
+        return spec
+
     def _steal_queued_task(self, rec: WorkerRec, task_id: str) -> None:
         """Ask the worker to drop a not-yet-started pipelined task from
         its local FIFO and requeue it here. Runs async: this path is
@@ -522,27 +568,9 @@ class Scheduler:
             if not rep.get("ok"):
                 return                # already started: FIFO handles it
             with self._cv:
-                cur = self._workers.get(rec.worker_id)
-                if cur is not rec:
-                    return
-                spec = rec.tasks.pop(task_id, None)
-                need_pg = rec.task_res.pop(task_id, None)
+                spec = self._pop_worker_task_locked(rec, task_id)
                 if spec is None:
                     return
-                if need_pg is not None and need_pg[2]:
-                    if rec.blocked_depth == 0:
-                        # the worker unblocked between steal and reply,
-                        # so its charges were re-acquired — release
-                        # this one (uncharged pipelined tasks never
-                        # held a share)
-                        release(self._ledger_for_key(need_pg[1]),
-                                need_pg[0])
-                    # a charged entry left the chain: hand its share to
-                    # the next queued task, or the rest of the pipeline
-                    # would run permanently uncharged
-                    self._promote_next_charge_locked(rec)
-                if rec.state == BUSY and not rec.tasks:
-                    rec.state = IDLE
                 self._pending.appendleft(spec)
                 self._queued_at[id(spec)] = time.monotonic()
                 self._demand_add(spec)
@@ -551,6 +579,105 @@ class Scheduler:
                 self._cv.notify_all()
 
         fut.add_done_callback(_done)
+
+    def find_task(self, task_id: str):
+        """Where a task currently lives on this node: ("pending", None)
+        while queued here, ("running", worker_id) while in a worker's
+        FIFO (dispatched; possibly not yet started), else None. The
+        head's cancel path uses this in delegated mode, where per-task
+        dispatch events are suppressed."""
+        with self._lock:
+            for spec in self._pending:
+                if getattr(spec, "task_id", None) == task_id:
+                    return ("pending", None)
+            for rec in self._workers.values():
+                if rec.state != DEAD and task_id in rec.tasks:
+                    return ("running", rec.worker_id)
+        return None
+
+    def reclaim_tasks(self, task_ids: list,
+                      callback: Callable[[list], None]) -> None:
+        """Lease revoke (r10): pull queued-NOT-started tasks back out
+        of this node and hand their specs to `callback` in one shot.
+        Pending-queue entries come out synchronously; tasks already
+        pipelined into a worker's FIFO go through the r6 UNQUEUE_TASK
+        tombstone machinery (async — the worker refuses if the task
+        started, in which case it stays leased here and runs to
+        completion). `callback(reclaimed_specs)` fires exactly once,
+        after every worker probe resolves."""
+        reclaimed: list = []
+        probes: list = []               # (rec, task_id, future)
+        want = set(task_ids)
+        with self._cv:
+            # ONE pass over the queue and worker FIFOs builds the id
+            # indexes — per-id rescans of a 10k-deep backlog (exactly
+            # the state that triggers a rebalance revoke) would stall
+            # dispatch under this lock for the whole sweep
+            pending_hits = {}
+            for spec in self._pending:
+                tid = getattr(spec, "task_id", None)
+                if tid in want:
+                    pending_hits[tid] = spec
+            if pending_hits:
+                # one rebuild, not a deque.remove per id (each remove
+                # rescans from the front — the same O(ids x backlog)
+                # this index exists to avoid)
+                drop = set(map(id, pending_hits.values()))
+                self._pending = deque(
+                    s for s in self._pending if id(s) not in drop)
+                for spec in pending_hits.values():
+                    self._queued_at.pop(id(spec), None)
+                    self._demand_sub(spec)
+                    reclaimed.append(spec)
+            worker_hits = {}
+            for rec in self._workers.values():
+                if rec.state == DEAD or rec.conn is None:
+                    continue
+                # FIFO head = (likely) already executing; only the
+                # queued tail is reclaimable
+                it = iter(rec.tasks)
+                next(it, None)
+                for tid in it:
+                    if tid in want:
+                        worker_hits[tid] = rec
+            for tid in task_ids:
+                if tid in pending_hits:
+                    continue
+                rec = worker_hits.get(tid)
+                if rec is not None:
+                    try:
+                        fut = rec.conn.request_async(
+                            {"type": protocol.UNQUEUE_TASK,
+                             "task_id": tid})
+                        probes.append((rec, tid, fut))
+                    except protocol.ConnectionClosed:
+                        pass
+        if not probes:
+            callback(reclaimed)
+            return
+        state = {"left": len(probes)}
+        state_lock = threading.Lock()
+
+        def _probe_done(rec, tid, fut) -> None:
+            try:
+                ok = bool(fut.result(0).get("ok"))
+            except BaseException:
+                ok = False              # worker died: death path covers
+            if ok:
+                with self._cv:
+                    spec = self._pop_worker_task_locked(rec, tid)
+                    if spec is not None:
+                        reclaimed.append(spec)
+                    self._cv.notify_all()
+            with state_lock:
+                state["left"] -= 1
+                last = state["left"] == 0
+            if last:
+                callback(reclaimed)
+
+        for rec, tid, fut in probes:
+            fut.add_done_callback(
+                lambda f, rec=rec, tid=tid: _probe_done(rec, tid, f))
 
     def worker_unblocked(self, worker_id: str) -> None:
         with self._cv:

@@ -50,9 +50,12 @@ from ray_tpu import native as _native
 from ray_tpu._private import wire_pb2 as pb
 
 WIRE_MAJOR = 1
-WIRE_MINOR = 2          # 1: BatchFrame coalescing (negotiated by peers)
+WIRE_MINOR = 3          # 1: BatchFrame coalescing (negotiated by peers)
                         # 2: Envelope trace_id/parent_span (tracing
                         #    plane; old peers skip unknown fields)
+                        # 3: delegated scheduling ops (NODE_LEASE_BATCH
+                        #    / TASK_DONE_BATCH / lease revoke) + seq-
+                        #    numbered heartbeat deltas
 WIRE_VERSION = WIRE_MAJOR * 100 + WIRE_MINOR
 
 # First MINOR that understands a type=="batch" Envelope carrying a
@@ -67,6 +70,13 @@ BATCH_TYPE = "batch"
 # peer that demonstrated an older MINOR (protocol.Connection strips
 # the key before encode in that case).
 TRACE_MIN_MINOR = 2
+
+# First MINOR that understands the delegated-scheduling ops
+# (NODE_LEASE_BATCH, NODE_TASK_DONE_BATCH, NODE_LEASE_REVOKE,
+# NODE_FIND_TASK) and seq-numbered heartbeat deltas. Negotiated by
+# observation like BatchFrame: senders fall back to the per-task
+# protocol until the peer demonstrates MINOR >= 3.
+DELEGATE_MIN_MINOR = 3
 
 # Message-dict carrier for the Envelope trace fields: senders attach
 # msg["_trace"] = (trace_id, parent_span); codecs move it between the
@@ -92,6 +102,7 @@ STRUCTURAL_TYPES = frozenset({
     "register", "ping", "decref", "addref", "decref_batch",
     "node_register", "node_heartbeat", "node_event",
     "node_kill_worker", "node_delete_object", "node_shutdown",
+    "node_hb_resync",
     "object_lookup", "pull_object", "pull_chunk",
     "locate_object", "object_added", "object_removed", "bcast_plan",
 })

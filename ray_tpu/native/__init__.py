@@ -123,6 +123,20 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.rtpu_reader_free.restype = None
         lib.rtpu_reader_pump.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.rtpu_reader_pump.restype = ctypes.c_long
+        lib.rtpu_reader_pump_nb.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_int]
+        lib.rtpu_reader_pump_nb.restype = ctypes.c_long
+        # ---- epoll poller (r10) ----
+        lib.rtpu_poller_new.argtypes = []
+        lib.rtpu_poller_new.restype = ctypes.c_int
+        lib.rtpu_poller_add.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.rtpu_poller_add.restype = ctypes.c_int
+        lib.rtpu_poller_del.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.rtpu_poller_del.restype = ctypes.c_int
+        lib.rtpu_poller_wait.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.c_long,
+            ctypes.c_int]
+        lib.rtpu_poller_wait.restype = ctypes.c_long
         lib.rtpu_reader_next.argtypes = [ctypes.c_void_p,
                                          ctypes.POINTER(ctypes.c_uint64)]
         lib.rtpu_reader_next.restype = ctypes.c_void_p
@@ -241,6 +255,24 @@ class FrameReader:
             os.close(self._fd)
             raise MemoryError("rtpu_reader_new failed")
 
+    @property
+    def fd(self) -> int:
+        """The dup'd fd the pump reads (register THIS in a poller: it
+        stays valid until close(), unlike the original, which another
+        thread may close at any time)."""
+        return self._fd
+
+    def _collect(self) -> list[bytes]:
+        frames = []
+        length = ctypes.c_uint64()
+        while True:
+            ptr = self._lib.rtpu_reader_next(
+                self._handle, ctypes.byref(length))
+            if not ptr:
+                break
+            frames.append(ctypes.string_at(ptr, length.value))
+        return frames
+
     def pump(self) -> list[bytes]:
         """Block (GIL released) until at least one complete frame is
         buffered; returns all complete frame bodies. Raises PumpClosed
@@ -248,15 +280,25 @@ class FrameReader:
         read error."""
         n = self._lib.rtpu_reader_pump(self._handle, self._fd)
         if n > 0:
-            frames = []
-            length = ctypes.c_uint64()
-            while True:
-                ptr = self._lib.rtpu_reader_next(
-                    self._handle, ctypes.byref(length))
-                if not ptr:
-                    break
-                frames.append(ctypes.string_at(ptr, length.value))
-            return frames
+            return self._collect()
+        if n == 0:
+            raise PumpClosed("peer closed")
+        if n == -2:
+            raise PumpOversized(
+                "frame length prefix exceeds wire_max_frame_bytes")
+        raise OSError("native frame read failed")
+
+    def pump_nb(self) -> list[bytes]:
+        """Non-blocking pump (epoll loop): drain whatever the kernel
+        has via recv(MSG_DONTWAIT) and return the complete frames
+        buffered so far — [] when no complete frame is ready yet (the
+        level-triggered poller re-reports the fd when more arrives).
+        Raises like pump()."""
+        n = self._lib.rtpu_reader_pump_nb(self._handle, self._fd)
+        if n > 0:
+            return self._collect()
+        if n == -4:
+            return []
         if n == 0:
             raise PumpClosed("peer closed")
         if n == -2:
@@ -364,6 +406,48 @@ def batch_split(data: bytes, off: int, length: int):
         if n <= cap:
             return [(off + offs[i], lens[i]) for i in range(n)]
         cap = n
+
+
+class EpollPoller:
+    """Thin wrapper over the rtpu_poller_* epoll API (r10): one
+    instance drives the read side of many connections. wait() blocks
+    with the GIL released (ctypes call); add/del are callable from any
+    thread while a wait is in flight (kernel epoll semantics)."""
+
+    def __init__(self):
+        lib = _load()
+        assert lib is not None, "check frame_engine_enabled() first"
+        self._lib = lib
+        self._epfd = lib.rtpu_poller_new()
+        if self._epfd < 0:
+            raise OSError(-self._epfd, os.strerror(-self._epfd))
+
+    def add(self, fd: int) -> None:
+        rc = self._lib.rtpu_poller_add(self._epfd, fd)
+        if rc != 0:
+            raise OSError(-rc, os.strerror(-rc))
+
+    def remove(self, fd: int) -> None:
+        rc = self._lib.rtpu_poller_del(self._epfd, fd)
+        if rc != 0 and rc != -9:        # EBADF: fd already closed
+            raise OSError(-rc, os.strerror(-rc))
+
+    def wait(self, timeout_ms: int, max_events: int = 64) -> list[int]:
+        """Ready fd numbers ([] on timeout/EINTR)."""
+        out = (ctypes.c_int * max_events)()
+        n = self._lib.rtpu_poller_wait(self._epfd, out, max_events,
+                                       int(timeout_ms))
+        if n < 0:
+            raise OSError(int(-n), os.strerror(int(-n)))
+        return [out[i] for i in range(n)]
+
+    def close(self) -> None:
+        if self._epfd >= 0:
+            try:
+                os.close(self._epfd)
+            except OSError:
+                pass
+            self._epfd = -1
 
 
 def batch_encode(version: int, mtype: bytes,

@@ -43,6 +43,8 @@
 #include <string.h>
 #include <time.h>
 #include <sched.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
 #include <sys/uio.h>
 #include <unistd.h>
 
@@ -176,6 +178,7 @@ typedef struct {
 #define RTPU_PUMP_ERR     (-1)  /* read(2) failed (see errno caveat)  */
 #define RTPU_PUMP_TOOBIG  (-2)  /* length prefix exceeds max_frame    */
 #define RTPU_PUMP_NOMEM   (-3)  /* reassembly buffer grow failed      */
+#define RTPU_PUMP_AGAIN   (-4)  /* pump_nb: kernel dry, no frame yet  */
 
 rtpu_reader *rtpu_reader_new(uint64_t max_frame) {
     rtpu_reader *r = calloc(1, sizeof *r);
@@ -223,49 +226,57 @@ static long rd_count(const rtpu_reader *r) {
     return n;
 }
 
+/* Compact + size the reassembly buffer for the next read: shrink
+ * after a large-frame spike, grow toward the pending frame's length.
+ * Returns 0, or RTPU_PUMP_NOMEM when a required grow failed. */
+static long rd_make_room(rtpu_reader *r) {
+    if (r->start > 0) {
+        memmove(r->buf, r->buf + r->start, r->end - r->start);
+        r->end -= r->start;
+        r->start = 0;
+    }
+    /* shrink after a large-frame spike: steady-state control
+     * frames are a few hundred bytes, so a buffer grown for one
+     * multi-MB state reply must not stay pinned for the
+     * connection's lifetime. Shrink when the buffered remainder
+     * uses under a quarter of a >1 MiB buffer; shrink-realloc
+     * failure just keeps the old buffer. */
+    if (r->cap > (1 << 20) && r->end < r->cap / 4) {
+        size_t ncap = 1 << 16;
+        while (ncap < r->end * 2)
+            ncap *= 2;
+        uint8_t *nbuf = realloc(r->buf, ncap);
+        if (nbuf) {
+            r->buf = nbuf;
+            r->cap = ncap;
+        }
+    }
+    size_t target = r->end + (1 << 16);
+    if (r->end >= 8) {
+        uint64_t len = rd_u64le(r->buf);        /* <= max_frame here */
+        if (8 + len > (uint64_t)target)
+            target = (size_t)(8 + len);
+    }
+    if (r->cap < target) {
+        size_t ncap = r->cap;
+        while (ncap < target)
+            ncap *= 2;
+        uint8_t *nbuf = realloc(r->buf, ncap);
+        if (!nbuf)
+            return RTPU_PUMP_NOMEM;
+        r->buf = nbuf;
+        r->cap = ncap;
+    }
+    return 0;
+}
+
 long rtpu_reader_pump(rtpu_reader *r, int fd) {
     for (;;) {
         long n = rd_count(r);
         if (n != 0)
             return n;                   /* frames ready, or TOOBIG */
-        /* compact, then make room for (at least) the pending frame */
-        if (r->start > 0) {
-            memmove(r->buf, r->buf + r->start, r->end - r->start);
-            r->end -= r->start;
-            r->start = 0;
-        }
-        /* shrink after a large-frame spike: steady-state control
-         * frames are a few hundred bytes, so a buffer grown for one
-         * multi-MB state reply must not stay pinned for the
-         * connection's lifetime. Shrink when the buffered remainder
-         * uses under a quarter of a >1 MiB buffer; shrink-realloc
-         * failure just keeps the old buffer. */
-        if (r->cap > (1 << 20) && r->end < r->cap / 4) {
-            size_t ncap = 1 << 16;
-            while (ncap < r->end * 2)
-                ncap *= 2;
-            uint8_t *nbuf = realloc(r->buf, ncap);
-            if (nbuf) {
-                r->buf = nbuf;
-                r->cap = ncap;
-            }
-        }
-        size_t target = r->end + (1 << 16);
-        if (r->end >= 8) {
-            uint64_t len = rd_u64le(r->buf);    /* <= max_frame here */
-            if (8 + len > (uint64_t)target)
-                target = (size_t)(8 + len);
-        }
-        if (r->cap < target) {
-            size_t ncap = r->cap;
-            while (ncap < target)
-                ncap *= 2;
-            uint8_t *nbuf = realloc(r->buf, ncap);
-            if (!nbuf)
-                return RTPU_PUMP_NOMEM;
-            r->buf = nbuf;
-            r->cap = ncap;
-        }
+        if ((n = rd_make_room(r)) != 0)
+            return n;
         ssize_t got = read(fd, r->buf + r->end, r->cap - r->end);
         if (got < 0) {
             if (errno == EINTR)
@@ -276,6 +287,79 @@ long rtpu_reader_pump(rtpu_reader *r, int fd) {
             return RTPU_PUMP_EOF;
         r->end += (size_t)got;
     }
+}
+
+/* Non-blocking pump for the epoll loop (r10): recv(MSG_DONTWAIT), so
+ * the fd's own flags stay untouched — the blocking send paths share
+ * the open file description and must not turn non-blocking. Drains
+ * the socket until at least one complete frame is buffered or the
+ * kernel runs dry; RTPU_PUMP_AGAIN means "no complete frame yet,
+ * wait for the next readiness event" (level-triggered epoll re-arms
+ * by itself). Sockets only — every wire connection is one. */
+long rtpu_reader_pump_nb(rtpu_reader *r, int fd) {
+    for (;;) {
+        long n = rd_count(r);
+        if (n != 0)
+            return n;                   /* frames ready, or TOOBIG */
+        if ((n = rd_make_room(r)) != 0)
+            return n;
+        ssize_t got = recv(fd, r->buf + r->end, r->cap - r->end,
+                           MSG_DONTWAIT);
+        if (got < 0) {
+            if (errno == EINTR)
+                continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK)
+                return RTPU_PUMP_AGAIN;
+            return RTPU_PUMP_ERR;
+        }
+        if (got == 0)
+            return RTPU_PUMP_EOF;
+        r->end += (size_t)got;
+    }
+}
+
+/* ------------------- epoll poller (r10 event loop) -------------------
+ *
+ * One epoll instance drives every registered connection's read side
+ * (replacing thread-per-connection reads on the head and agents). All
+ * calls arrive through ctypes, so the wait blocks with the GIL
+ * released. Level-triggered: a fd whose pump left buffered kernel
+ * bytes is simply reported again. Registration/removal from other
+ * threads while a wait is in flight is kernel-supported. */
+
+int rtpu_poller_new(void) {
+    int fd = epoll_create1(EPOLL_CLOEXEC);
+    return fd >= 0 ? fd : -errno;
+}
+
+int rtpu_poller_add(int epfd, int fd) {
+    struct epoll_event ev;
+    memset(&ev, 0, sizeof ev);
+    ev.events = EPOLLIN | EPOLLRDHUP;   /* level-triggered */
+    ev.data.fd = fd;
+    return epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev) == 0 ? 0 : -errno;
+}
+
+int rtpu_poller_del(int epfd, int fd) {
+    struct epoll_event ev;               /* non-NULL for old kernels */
+    memset(&ev, 0, sizeof ev);
+    return epoll_ctl(epfd, EPOLL_CTL_DEL, fd, &ev) == 0 ? 0 : -errno;
+}
+
+/* Wait up to timeout_ms for readiness; fills fds[0..ret) with the
+ * ready fd numbers (EPOLLIN/HUP/ERR all count — the pump surfaces
+ * EOF/errors itself). 0 on timeout or EINTR; -errno on failure. */
+long rtpu_poller_wait(int epfd, int *fds, long max, int timeout_ms) {
+    struct epoll_event evs[64];
+    int cap = max < 64 ? (int)max : 64;
+    if (cap <= 0)
+        return 0;
+    int n = epoll_wait(epfd, evs, cap, timeout_ms);
+    if (n < 0)
+        return errno == EINTR ? 0 : -(long)errno;
+    for (int i = 0; i < n; i++)
+        fds[i] = evs[i].data.fd;
+    return n;
 }
 
 /* Next complete frame body (and its length), or NULL when the buffered

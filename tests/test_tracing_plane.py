@@ -326,6 +326,14 @@ def test_two_agent_trace_parentage(tmp_path, tracing_on):
             names.append(cur[4])
         assert cur[3] == "submit" and cur[2] == 0    # chain ends at root
         assert "queue" in names and "lease" in names and "recv" in names
+        # r10 delegated dispatch (default-on): the head's lease_batch
+        # span splices between the driver submit span and the agent's
+        # queue span, so the delegated hop (submit -> lease-batch ->
+        # agent-local queue/lease -> exec -> batched done) reads
+        # straight off the parent chain
+        from ray_tpu._private.config import CONFIG as _CFG
+        if _CFG.delegate:
+            assert "lease_batch" in names, names
 
         # heartbeat watermarks (pull-only events; push carries counts)
         stats = rt.state_op("trace_stats")

@@ -21,8 +21,13 @@ typedef struct rtpu_reader rtpu_reader;
 rtpu_reader *rtpu_reader_new(uint64_t max_frame);
 void rtpu_reader_free(rtpu_reader *r);
 long rtpu_reader_pump(rtpu_reader *r, int fd);
+long rtpu_reader_pump_nb(rtpu_reader *r, int fd);
 const uint8_t *rtpu_reader_next(rtpu_reader *r, uint64_t *len_out);
 long rtpu_writev_full(int fd, struct iovec *iov, long cnt);
+int rtpu_poller_new(void);
+int rtpu_poller_add(int epfd, int fd);
+int rtpu_poller_del(int epfd, int fd);
+long rtpu_poller_wait(int epfd, int *fds, long max, int timeout_ms);
 typedef struct {
     uint32_t version;
     uint64_t rid;
@@ -247,10 +252,53 @@ static void check_codec(void) {
     fprintf(stderr, "codec ok\n");
 }
 
+static void check_poller(void) {
+    /* r10 epoll loop: readiness + non-blocking pump over a socketpair
+     * — torn frame completes across two waits, EAGAIN surfaces as
+     * RTPU_PUMP_AGAIN (-4), EOF as 0, removal works. */
+    int ep = rtpu_poller_new();
+    assert(ep >= 0);
+    int sv[2];
+    assert(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+    assert(rtpu_poller_add(ep, sv[0]) == 0);
+    int ready[8];
+    /* nothing readable: timeout -> 0 ready fds */
+    assert(rtpu_poller_wait(ep, ready, 8, 10) == 0);
+
+    uint8_t frame[8 + 5];
+    put_u64le(frame, 5);
+    memcpy(frame + 8, "hello", 5);
+    /* first half: readable, but the nb pump must report AGAIN (no
+     * complete frame, kernel dry) without blocking */
+    assert(write(sv[1], frame, 6) == 6);
+    assert(rtpu_poller_wait(ep, ready, 8, 1000) == 1);
+    assert(ready[0] == sv[0]);
+    rtpu_reader *r = rtpu_reader_new(1 << 20);
+    assert(rtpu_reader_pump_nb(r, sv[0]) == -4);
+    /* second half completes the frame */
+    assert(write(sv[1], frame + 6, sizeof frame - 6)
+           == (ssize_t)(sizeof frame - 6));
+    assert(rtpu_poller_wait(ep, ready, 8, 1000) == 1);
+    assert(rtpu_reader_pump_nb(r, sv[0]) == 1);
+    uint64_t len;
+    const uint8_t *f = rtpu_reader_next(r, &len);
+    assert(f && len == 5 && memcmp(f, "hello", 5) == 0);
+    /* peer close: readiness fires, pump reports EOF */
+    close(sv[1]);
+    assert(rtpu_poller_wait(ep, ready, 8, 1000) == 1);
+    assert(rtpu_reader_pump_nb(r, sv[0]) == 0);
+    assert(rtpu_poller_del(ep, sv[0]) == 0);
+    rtpu_reader_free(r);
+    close(sv[0]);
+    close(ep);
+    fprintf(stderr, "poller ok\n");
+}
+
 int main(void) {
     check_codec();
     check_reader();
     check_writev();
+    check_poller();
     fprintf(stderr, "native_sanity_check: ALL OK\n");
     return 0;
 }

@@ -31,6 +31,10 @@ LABELS = [
      "wire codec, protobuf backend (encode+decode µs)"),
     ("drain_5k_nonative", "5k drain, RAY_TPU_DISABLE_NATIVE=1"),
     ("drain_5k_native", "5k drain, native frame engine"),
+    ("drain_5k_central",
+     "5k remote drain, central dispatch (RAY_TPU_DELEGATE=0)"),
+    ("drain_5k_delegated", "5k remote drain, delegated bulk leases"),
+    ("drain_100k", "100k drain, local workers"),
     ("drain_3k_notrace", "3k drain, RAY_TPU_TRACE=0"),
     ("drain_3k_trace", "3k drain, tracing on (default)"),
     ("tasks_sync_per_s", "tasks, sync round-trip"),
@@ -66,6 +70,12 @@ def _fmt_result(rec: dict) -> str:
             out += f" (channel speedup {rec['channel_speedup']}x)"
         if "native_speedup" in rec:
             out += f" (native speedup {rec['native_speedup']}x)"
+        if "delegate_speedup" in rec:
+            out += f" (delegate speedup {rec['delegate_speedup']}x)"
+        if "lease_batches" in rec:
+            # r10 delegated-dispatch columns: grants went out in bulk
+            out += (f" ({rec['lease_batches']} lease batches / "
+                    f"{rec['tasks_leased']} tasks)")
         if "source_serves" in rec:
             # r8 broadcast columns: aggregate GB/s is per_second; the
             # serve count is the tree property (source <= fanout)

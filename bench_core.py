@@ -306,6 +306,24 @@ def main(as_json: bool = False) -> dict:
             (_base / results["drain_3k_trace"]["per_second"] - 1) * 100,
             1)
 
+    # --------- metrics plane: metrics-off vs metrics-on 3k drain (r11)
+    # Machine-checks the r11 zero-cost claim: with metrics ON (the
+    # default) every dispatch observes a queue-wait bucket, every task
+    # a worker exec + head e2e bucket (one bisect + list increment
+    # each), and every spec carries a submit stamp — throughput must
+    # stay within noise of the RAY_TPU_METRICS=0 run.
+    os.environ["RAY_TPU_METRICS"] = "0"
+    try:
+        results["drain_3k_nometrics"] = _drain_with_frames(3000)
+    finally:
+        os.environ.pop("RAY_TPU_METRICS", None)
+    results["drain_3k_metrics"] = _drain_with_frames(3000)
+    _base = results["drain_3k_nometrics"]["per_second"]
+    if _base:
+        results["drain_3k_metrics"]["metrics_overhead_pct"] = round(
+            (_base / results["drain_3k_metrics"]["per_second"] - 1)
+            * 100, 1)
+
     # ------------------- control-frame coalescing: off vs on (r6)
     # The OFF run goes first in its own runtime (workers inherit the
     # env at spawn); the ON run is the normal 5k-drain below, which

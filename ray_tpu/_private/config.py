@@ -271,6 +271,46 @@ _define("delegate_max_inflight", 0,
         "head-side lease buffer until completions free budget. "
         "0 = unbounded (the agent's own scheduler remains the "
         "authoritative resource ledger either way).")
+_define("metrics", True,
+        "Master switch for the cluster metrics plane (r11): runtime-"
+        "instrumented series (task latency histograms by phase, lease/"
+        "poller/object-plane/shm-pool telemetry) registered into the "
+        "per-process util.metrics registry, plus the METRICS_DUMP "
+        "cluster scrape. 0 disables instrumentation entirely — hot "
+        "paths skip every observe behind one memoized gate and no "
+        "runtime series are ever registered (zero metric bytes, the "
+        "RAY_TPU_TRACE=0 discipline).")
+_define("metrics_ttl_s", 15.0,
+        "Stale-series expiry in the head-side cluster collector: a "
+        "process (worker/agent) that stops answering METRICS_DUMP "
+        "keeps its last-seen series in /metrics for this long, then "
+        "they disappear — removed nodes/workers cannot linger "
+        "forever, while one missed scrape doesn't flap the view.")
+_define("metrics_ring", 120,
+        "Head-side metrics retention ring: how many collection "
+        "samples (one summary per cluster scrape) the head keeps for "
+        "the dashboard sparklines and the autoscaler's windowed "
+        "queue-latency signal. 0 disables retention.")
+_define("metrics_min_scrape_s", 1.0,
+        "Rate limit on cluster metrics fan-outs: collections "
+        "requested closer together than this (dashboard auto-refresh "
+        "+ autoscaler both pulling) reuse the cached merge instead of "
+        "re-fanning METRICS_DUMP to every process.")
+_define("autoscale_queue_latency_s", 0.0,
+        "Autoscaler queue-latency signal (r11): when > 0, the "
+        "autoscaler scales UP one node whenever the cluster task "
+        "queue-wait p95 over the recent window exceeds this many "
+        "seconds — even if resource-shape demand alone would not "
+        "trigger a launch (the groundwork for latency-SLO serving "
+        "autoscaling). 0 disables the signal.")
+_define("autoscale_queue_latency_window_s", 30.0,
+        "Window over the metrics retention ring used to compute the "
+        "queue-wait p95 for the autoscaler signal (recent "
+        "distribution, not the process-lifetime cumulative one).")
+_define("autoscale_queue_latency_cooldown_s", 30.0,
+        "Minimum seconds between latency-driven scale-ups: the p95 "
+        "stays high until new capacity drains the queue, so without a "
+        "cooldown the signal would launch a node per update tick.")
 _define("scheduler_locality", True,
         "Locality-aware node selection: prefer placing a task on a "
         "feasible node already holding the most argument bytes "

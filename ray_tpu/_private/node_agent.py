@@ -37,6 +37,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
+from ray_tpu._private import metrics_plane as _mp
 from ray_tpu._private import protocol
 from ray_tpu._private import tracing_plane as _tp
 from ray_tpu._private.config import CONFIG as _CFG
@@ -225,6 +226,9 @@ class NodeAgent:
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="rtpu-agent-hb", daemon=True)
         self._hb_thread.start()
+        # metrics plane (r11): refresh this agent's sampled gauges
+        # (delegate ledger, pull-manager in-flight) at scrape time
+        _mp.set_sampler("agent", self._sample_metrics)
 
     # ------------------------------------------------------ lifecycles
     def _on_head_closed(self, conn) -> None:
@@ -369,10 +373,25 @@ class NodeAgent:
         self._relay_to_head(conn, msg, _retry_depth=depth + 1)
         return True
 
+    def _sample_metrics(self) -> None:
+        """Metrics-plane sampler: mirror the delegate-lease ledger and
+        pull-manager occupancy into gauges (scrape-time only)."""
+        m = _mp._metrics()
+        with self._lease_lock:
+            st = dict(self._delegate_stats)
+            outstanding = len(self._lease_of)
+        m.delegate.set_many(
+            [({"counter": k}, float(v)) for k, v in st.items()]
+            + [({"counter": "outstanding"}, float(outstanding))])
+        pm = self._pull_mgr.stats()
+        m.pull_inflight.set(pm["inflight"])
+        m.pull_inflight_bytes.set(pm["inflight_bytes"])
+
     def shutdown(self) -> None:
         if self._stop.is_set():
             return
         self._stop.set()
+        _mp.set_sampler("agent", None)
         self._done_flusher.stop()
         try:
             # graceful drain: completions still parked in the batch
@@ -626,6 +645,14 @@ class NodeAgent:
                              args=(conn, msg),
                              name="rtpu-agent-trace-dump",
                              daemon=True).start()
+        elif mtype == protocol.METRICS_DUMP:
+            # same off-loop rule as TRACE_DUMP: the fan-out to this
+            # node's workers blocks on replies that arrive on the
+            # shared poller thread
+            threading.Thread(target=self._metrics_dump_reply,
+                             args=(conn, msg),
+                             name="rtpu-agent-metrics-dump",
+                             daemon=True).start()
         elif mtype == protocol.NODE_SHUTDOWN:
             self.shutdown()
         elif mtype == protocol.PING:
@@ -752,6 +779,25 @@ class NodeAgent:
             # derives this node's offset from it, and an entry-time
             # sample would be stale by however long the drain took
             conn.reply(msg, processes=procs, now_ns=_tp.now())
+        except protocol.ConnectionClosed:
+            pass
+
+    def _metrics_dump_reply(self, conn: protocol.Connection,
+                            msg: dict) -> None:
+        """Drain this node's metrics registries: the agent's own plus
+        each local worker's, under a budget inside the head's
+        collection deadline (a wedged worker must not drop the whole
+        node from the scrape)."""
+        procs = [dict(_mp.local_dump(), worker="")]
+        budget = max(0.5, float(msg.get("timeout", 3.0)) - 1.0)
+        for wid, t0, t1, rep in _tp.fanout_dumps(
+                list(self.scheduler.worker_conns()), budget,
+                mtype=protocol.METRICS_DUMP):
+            d = rep.get("dump")
+            if d and d.get("metrics"):
+                procs.append(dict(d, worker=wid))
+        try:
+            conn.reply(msg, processes=procs)
         except protocol.ConnectionClosed:
             pass
 

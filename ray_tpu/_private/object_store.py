@@ -126,6 +126,7 @@ class _SegmentPool:
         self._bytes = 0
         self.reused = 0     # introspection / bench counters
         self.pooled = 0
+        self.misses = 0     # eligible acquires the pool couldn't serve
 
     @staticmethod
     def _cls(nbytes: int) -> int:
@@ -147,6 +148,7 @@ class _SegmentPool:
         with self._lock:
             free = self._classes.get(cls)
             if not free:
+                self.misses += 1
                 return False
             pooled_name = free.popleft()
             self._bytes -= 1 << cls
@@ -154,6 +156,7 @@ class _SegmentPool:
             os.rename("/dev/shm/" + pooled_name, "/dev/shm/" + name)
         except OSError:
             # pooled entry vanished (external sweep): just miss
+            self.misses += 1
             return False
         self.reused += 1
         return True
@@ -215,6 +218,7 @@ class _SegmentPool:
                     "pool_segments": sum(len(f) for f in
                                          self._classes.values()),
                     "pool_reused": self.reused,
+                    "pool_misses": self.misses,
                     "pool_released": self.pooled}
 
 

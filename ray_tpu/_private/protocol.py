@@ -32,8 +32,8 @@ from typing import Any, Callable, Optional
 
 from ray_tpu import native
 from ray_tpu._private.wire import (BATCH_MIN_MINOR, BATCH_TYPE,
-                                   DELEGATE_MIN_MINOR, TRACE_KEY,
-                                   TRACE_MIN_MINOR, WIRE_MAJOR,
+                                   DELEGATE_MIN_MINOR, METRICS_MIN_MINOR,
+                                   TRACE_KEY, TRACE_MIN_MINOR, WIRE_MAJOR,
                                    WireVersionError, dumps, dumps_batch,
                                    encode_batch_parts, encode_frame_parts,
                                    loads_ex)
@@ -45,6 +45,19 @@ _LEN = struct.Struct("<Q")
 # bench_core.py to report control frames per completed task; plain int
 # increments under the GIL are accurate enough for benchmarking.
 WIRE_STATS = {"tx_frames": 0, "tx_msgs": 0, "rx_frames": 0, "rx_msgs": 0}
+
+# r10 shared-read-loop accounting (this process's Poller, if any):
+# plain ints bumped under the GIL on the loop thread — same accuracy
+# contract as WIRE_STATS. The metrics plane samples these into gauges
+# at scrape time, so the loop itself never touches a metrics lock.
+#   passes       service passes that handled >= 1 ready fd
+#   frames/bytes complete frames drained through the poller pumps
+#   busy_ns      cumulative time spent servicing ready fds
+#   max_pass_ns  slowest single servicing pass (the loop-lag ceiling:
+#                while one pass runs, every other connection's reads
+#                wait this long)
+POLLER_STATS = {"passes": 0, "frames": 0, "bytes": 0,
+                "busy_ns": 0, "max_pass_ns": 0}
 
 # Message types (flat namespace; direction noted).
 REGISTER = "register"            # worker -> driver
@@ -73,6 +86,10 @@ BATCH = BATCH_TYPE               # either: coalesced sub-frames (MINOR>=1)
 TRACE_DUMP = "trace_dump"        # collector -> any: drain the peer's
                                  #   flight recorder (reply: dump/processes
                                  #   + monotonic now for clock alignment)
+METRICS_DUMP = "metrics_dump"    # collector -> any: snapshot the peer's
+                                 #   metrics registry (r11; agents drain
+                                 #   their own workers and reply with the
+                                 #   whole node, like TRACE_DUMP)
 
 # ---- multi-host: node agent <-> head (reference raylet <-> GCS,
 # gcs_node_manager.h:62 HandleRegisterNode; ray_syncer.h:88 resource
@@ -386,6 +403,14 @@ class Connection:
         practice)."""
         v = self.peer_wire_version
         return v // 100 == WIRE_MAJOR and v % 100 >= DELEGATE_MIN_MINOR
+
+    def peer_speaks_metrics(self) -> bool:
+        """Whether the peer answers METRICS_DUMP (MINOR >= 4). Unknown
+        (0) counts as NO — an old peer's handler drops the unknown
+        type without replying and would burn the collector's shared
+        fan-out deadline (same rule as peer_speaks_delegate)."""
+        v = self.peer_wire_version
+        return v // 100 == WIRE_MAJOR and v % 100 >= METRICS_MIN_MINOR
 
     def _peer_speaks_trace(self) -> bool:
         """Whether trace context may ride this connection's envelopes.
@@ -900,6 +925,8 @@ class Poller:
                     return
                 time.sleep(0.05)
                 continue
+            t0 = time.monotonic_ns() if ready else 0
+            serviced = False
             for fd in ready:
                 if fd == self._wake_r:
                     try:
@@ -910,7 +937,14 @@ class Poller:
                 with self._lock:
                     conn = self._conns.get(fd)
                 if conn is not None:
+                    serviced = True
                     self._service(fd, conn)
+            if serviced:
+                dt = time.monotonic_ns() - t0
+                POLLER_STATS["passes"] += 1
+                POLLER_STATS["busy_ns"] += dt
+                if dt > POLLER_STATS["max_pass_ns"]:
+                    POLLER_STATS["max_pass_ns"] = dt
 
     def _prune(self) -> None:
         """Drop select-fallback entries whose fd died under us."""
@@ -925,6 +959,9 @@ class Poller:
     def _service(self, fd: int, conn: Connection) -> None:
         try:
             frames = conn._poll_pump()
+            if frames:
+                POLLER_STATS["frames"] += len(frames)
+                POLLER_STATS["bytes"] += sum(map(len, frames))
             for frame in frames:
                 conn._handle_frame(frame)
         except Exception as e:

@@ -24,6 +24,7 @@ from typing import Any, Optional
 log = logging.getLogger(__name__)
 
 from ray_tpu._private import context as _context
+from ray_tpu._private import metrics_plane as _mp
 from ray_tpu._private import protocol
 from ray_tpu._private import tracing_plane as _tp
 from ray_tpu._private.controller import (ALIVE, DEAD, PENDING, RESTARTING,
@@ -155,6 +156,12 @@ class Runtime(_context.BaseContext):
                 self.controller.remove_location(oid, nid))
         self.bcast = BroadcastCoordinator(self)
         self.controller.directory.add_listener(self.bcast.on_location)
+        # Cluster metrics plane (r11): head-side scrape fan-out/merge
+        # + retention ring; the head's own sampled gauges (per-node
+        # lease ledgers, pull-manager occupancy) refresh at scrape
+        # time through the sampler hook.
+        self.metrics = _mp.ClusterCollector(self)
+        _mp.set_sampler("head", self._sample_metrics)
         self._init_head_persistence()
 
     # ================= head fault tolerance =================
@@ -448,6 +455,28 @@ class Runtime(_context.BaseContext):
             node_id=getattr(sched, "node_id", None))
 
     # ================= message handlers =================
+    def _reply_off_reader(self, conn, msg, name, fn) -> None:
+        """Run `fn` on its own thread and reply with its result: a
+        state op that fans out and WAITS for replies (one of which may
+        arrive on the requesting connection's reader — with the r10
+        shared poller, on the one loop thread serving every
+        connection) must never run on a connection reader thread."""
+        def _run():
+            try:
+                conn.reply(msg, value=fn())
+            except protocol.ConnectionClosed:
+                pass
+            except Exception as e:
+                # the caller is BLOCKED on this reply: a swallowed
+                # exception here means it hangs for its full request
+                # timeout instead of seeing the failure
+                try:
+                    conn.reply(msg, value=None,
+                               error=f"{type(e).__name__}: {e}")
+                except protocol.ConnectionClosed:
+                    pass
+        threading.Thread(target=_run, name=name, daemon=True).start()
+
     def _handle_msg(self, conn: protocol.Connection, msg: dict) -> None:
         mtype = msg["type"]
         if mtype == protocol.REGISTER:
@@ -524,14 +553,18 @@ class Runtime(_context.BaseContext):
                     # of which may arrive on THIS reader thread (the
                     # requesting worker's own dump): never collect on
                     # a connection reader (same rule as broadcast)
-                    def _td(conn=conn, msg=msg, kwargs=kwargs):
-                        try:
-                            conn.reply(msg, value=self._trace_dump(
-                                timeout=kwargs.get("timeout", 5.0)))
-                        except protocol.ConnectionClosed:
-                            pass
-                    threading.Thread(target=_td, name="rtpu-trace-dump",
-                                     daemon=True).start()
+                    self._reply_off_reader(
+                        conn, msg, "rtpu-trace-dump",
+                        lambda kwargs=kwargs: self._trace_dump(
+                            timeout=kwargs.get("timeout", 5.0)))
+                elif msg["op"] in ("metrics_dump", "metrics_summary"):
+                    # fans METRICS_DUMP out and WAITS for replies —
+                    # one may arrive on THIS reader thread (same rule
+                    # as trace_dump: never collect on a conn reader)
+                    self._reply_off_reader(
+                        conn, msg, "rtpu-metrics-dump",
+                        lambda op=msg["op"], kwargs=kwargs:
+                            self.state_op(op, **kwargs))
                 elif msg["op"] == "cancel_task":
                     # issues blocking NODE_CANCEL_PENDING /
                     # NODE_FIND_TASK RPCs to agents whose replies
@@ -539,14 +572,10 @@ class Runtime(_context.BaseContext):
                     # poller: on the one loop thread serving every
                     # connection) — same rule as trace_dump/broadcast:
                     # never collect on a connection reader
-                    def _ct(conn=conn, msg=msg, kwargs=kwargs):
-                        try:
-                            conn.reply(msg, value=self.state_op(
-                                "cancel_task", **kwargs))
-                        except protocol.ConnectionClosed:
-                            pass
-                    threading.Thread(target=_ct, name="rtpu-cancel",
-                                     daemon=True).start()
+                    self._reply_off_reader(
+                        conn, msg, "rtpu-cancel",
+                        lambda kwargs=kwargs: self.state_op(
+                            "cancel_task", **kwargs))
                 elif msg["op"] == "broadcast_object":
                     # blocks until the whole tree completes — never on
                     # a connection reader thread
@@ -667,6 +696,9 @@ class Runtime(_context.BaseContext):
                     spec = st.inflight.pop(task_id, None)
                 if spec is not None:
                     self._unpin(spec.pinned_refs)
+                    _mp.observe_task_done(
+                        spec, getattr(wsched, "node_id",
+                                      self.head_node_id))
             state = "FAILED" if msg.get("error") else "FINISHED"
             self.controller.record_task_event(task_id, msg.get("name", ""),
                                               state, worker_id=worker_id)
@@ -675,6 +707,8 @@ class Runtime(_context.BaseContext):
                 if wsched is not None else None)
         if spec is not None:
             self._unpin(spec.pinned_refs)
+            _mp.observe_task_done(
+                spec, getattr(wsched, "node_id", self.head_node_id))
             state = "FAILED" if msg.get("error") else "FINISHED"
             self.controller.record_task_event(spec.task_id, spec.name, state,
                                               worker_id=worker_id)
@@ -834,6 +868,7 @@ class Runtime(_context.BaseContext):
                     spec = st.inflight.pop(task_id, None)
                 if spec is not None:
                     self._unpin(spec.pinned_refs)
+                    _mp.observe_task_done(spec, node_id)
             state = "FAILED" if msg.get("error") else "FINISHED"
             self.controller.record_task_event(task_id, msg.get("name", ""),
                                               state, worker_id=worker_id)
@@ -841,6 +876,7 @@ class Runtime(_context.BaseContext):
         spec = proxy.on_finished(task_id) if proxy is not None else None
         if spec is not None:
             self._unpin(spec.pinned_refs)
+            _mp.observe_task_done(spec, node_id)
             state = "FAILED" if msg.get("error") else "FINISHED"
             self.controller.record_task_event(spec.task_id, spec.name,
                                               state, worker_id=worker_id)
@@ -1201,6 +1237,32 @@ class Runtime(_context.BaseContext):
                                    + agent_off)))
         return {"processes": procs}
 
+    # ================= metrics plane: collection =================
+    def _sample_metrics(self) -> None:
+        """Head sampler: mirror per-agent delegated-lease ledgers and
+        the head's pull-manager/pull-server occupancy into gauges.
+        set_many REPLACES the series set, so a removed node's labeled
+        gauges drop from the head's own registry immediately."""
+        m = _mp._metrics()
+        out, batches, leased, revoked = [], [], [], []
+        for n in self.cluster.alive_nodes():
+            h = n.scheduler
+            if not hasattr(h, "_leased"):
+                continue                     # in-process local node
+            with h._lock:
+                out.append(({"node": n.node_id}, float(len(h._leased))))
+            batches.append(({"node": n.node_id}, float(h._leases_sent)))
+            leased.append(({"node": n.node_id}, float(h._tasks_leased)))
+            revoked.append(({"node": n.node_id}, float(
+                (h.delegate_stats or {}).get("revoked", 0))))
+        m.lease_outstanding.set_many(out)
+        m.lease_batches.set_many(batches)
+        m.lease_tasks.set_many(leased)
+        m.lease_revoked.set_many(revoked)
+        pm = self._pull_mgr.stats()
+        m.pull_inflight.set(pm["inflight"])
+        m.pull_inflight_bytes.set(pm["inflight_bytes"])
+
     def _trace_stats(self) -> dict:
         rec = _tp.recorder()
         nodes = {}
@@ -1413,6 +1475,7 @@ class Runtime(_context.BaseContext):
 
     def submit_spec(self, spec: TaskSpec) -> list[str]:
         tr = self._stamp_trace(spec)
+        _mp.submit_stamp(spec)
         for oid in spec.pinned_refs:
             self.controller.pin(oid)
         self.controller.record_lineage(spec)
@@ -1444,6 +1507,7 @@ class Runtime(_context.BaseContext):
 
     def submit_actor_task_spec(self, actor_id: str,
                                spec: ActorTaskSpec) -> list[str]:
+        _mp.submit_stamp(spec)
         tr = self._stamp_trace(spec)
         try:
             return self._submit_actor_task_inner(actor_id, spec)
@@ -1648,6 +1712,16 @@ class Runtime(_context.BaseContext):
                 timeout=kwargs.get("timeout", 5.0))
         if op == "trace_stats":
             return self._trace_stats()
+        if op == "metrics_dump":
+            # cluster-merged registry snapshot (node/worker-labeled
+            # series; the dashboard renders exposition text from it)
+            return self.metrics.collect(
+                timeout=kwargs.get("timeout", 3.0))
+        if op == "metrics_summary":
+            return self.metrics.summary(
+                timeout=kwargs.get("timeout", 3.0))
+        if op == "metrics_stats":
+            return {"enabled": _mp.enabled(), **self.metrics.stats()}
         if op == "waiter_stats":
             return self.waiters.stats()
         if op == "pubsub_poll":
@@ -1678,6 +1752,7 @@ class Runtime(_context.BaseContext):
         if self._shutdown:
             return
         self._shutdown = True
+        _mp.set_sampler("head", None)
         # each step is independent: a wedged component must not block
         # the ones after it (especially the final shm sweep)
         for step in (self.cluster.shutdown, self.waiters.shutdown,

@@ -28,6 +28,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ray_tpu._private import metrics_plane as _mp
 from ray_tpu._private import protocol
 from ray_tpu._private import tracing_plane as _tp
 from ray_tpu._private.runtime_env import has_container
@@ -1199,6 +1200,12 @@ class Scheduler:
                 break                 # no free worker: stop the sweep
             self._pending.remove(spec)
             t_enq = self._queued_at.pop(id(spec), None)
+            if t_enq is not None:
+                # metrics plane (r11): queue-wait phase from the stamp
+                # the queue already keeps — enqueue pays nothing, and
+                # the gate short-circuits with RAY_TPU_METRICS=0
+                _mp.observe_queue_wait(time.monotonic() - t_enq,
+                                       self.node_id)
             self._demand_sub(spec)
             if charged:
                 acquire(pool, need)

@@ -113,6 +113,16 @@ class TaskSpec:
     trace_id: int = 0
     parent_span: int = 0
 
+    def __getstate__(self):
+        # The metrics plane's head-side submit stamp (_submit_mono) is
+        # read off the head's mirrored spec only — a monotonic reading
+        # is meaningless in another process, so keep it off the wire.
+        state = self.__dict__
+        if "_submit_mono" in state:
+            state = {k: v for k, v in state.items()
+                     if k != "_submit_mono"}
+        return state
+
 
 @dataclass
 class ActorSpec:
@@ -152,6 +162,10 @@ class ActorTaskSpec:
     # tracing plane (r9): see TaskSpec
     trace_id: int = 0
     parent_span: int = 0
+
+    # same contract as TaskSpec: the head-side e2e submit stamp never
+    # ships in pickled copies
+    __getstate__ = TaskSpec.__getstate__
 
 
 def pickle_callable(fn: Any) -> tuple[str, bytes]:

@@ -279,7 +279,8 @@ class span:
 
 # ------------------------------------------------------- collection
 def fanout_dumps(targets: list, timeout_s: float,
-                 extra: Optional[dict] = None) -> list:
+                 extra: Optional[dict] = None,
+                 mtype: Optional[str] = None) -> list:
     """TRACE_DUMP fan-out shared by the head and the agents: request
     each ``(meta, connection)`` concurrently, stamp each reply's
     ARRIVAL time the moment it lands (a slow earlier peer must not
@@ -289,14 +290,17 @@ def fanout_dumps(targets: list, timeout_s: float,
     its collection budget so agents bound their own worker drain).
     Returns ``[(meta, t0_ns, t1_ns, reply), ...]`` for the replies
     that made it; peers that died or missed the deadline are silently
-    absent."""
+    absent. `mtype` selects the dump protocol (default TRACE_DUMP; the
+    metrics plane reuses this machinery with METRICS_DUMP)."""
     from ray_tpu._private import protocol
+    if mtype is None:
+        mtype = protocol.TRACE_DUMP
     pending = []
     for meta, conn in targets:
         t0 = now()
         try:
             fut = conn.request_async(
-                {"type": protocol.TRACE_DUMP, **(extra or {})})
+                {"type": mtype, **(extra or {})})
         except protocol.ConnectionClosed:
             continue
         arrival: dict = {}

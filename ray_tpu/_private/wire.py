@@ -50,12 +50,14 @@ from ray_tpu import native as _native
 from ray_tpu._private import wire_pb2 as pb
 
 WIRE_MAJOR = 1
-WIRE_MINOR = 3          # 1: BatchFrame coalescing (negotiated by peers)
+WIRE_MINOR = 4          # 1: BatchFrame coalescing (negotiated by peers)
                         # 2: Envelope trace_id/parent_span (tracing
                         #    plane; old peers skip unknown fields)
                         # 3: delegated scheduling ops (NODE_LEASE_BATCH
                         #    / TASK_DONE_BATCH / lease revoke) + seq-
                         #    numbered heartbeat deltas
+                        # 4: METRICS_DUMP cluster scrape (metrics
+                        #    plane; no envelope change)
 WIRE_VERSION = WIRE_MAJOR * 100 + WIRE_MINOR
 
 # First MINOR that understands a type=="batch" Envelope carrying a
@@ -77,6 +79,12 @@ TRACE_MIN_MINOR = 2
 # observation like BatchFrame: senders fall back to the per-task
 # protocol until the peer demonstrates MINOR >= 3.
 DELEGATE_MIN_MINOR = 3
+
+# First MINOR whose handlers answer a METRICS_DUMP request (r11
+# metrics plane). An older peer would silently drop the unknown type
+# and the collector's shared deadline would burn waiting on a reply
+# that can never come, so the head only fans to proven peers.
+METRICS_MIN_MINOR = 4
 
 # Message-dict carrier for the Envelope trace fields: senders attach
 # msg["_trace"] = (trace_id, parent_span); codecs move it between the

@@ -25,6 +25,7 @@ from typing import Any, Optional
 import cloudpickle
 
 from ray_tpu._private import context as _context
+from ray_tpu._private import metrics_plane as _mp
 from ray_tpu._private import protocol
 from ray_tpu._private import tracing_plane as _tp
 from ray_tpu._private.object_store import StoredObject, deserialize, serialize
@@ -182,6 +183,9 @@ class WorkerContext(_context.BaseContext):
             from ray_tpu._private.pubsub import StaleCursorError
             raise StaleCursorError(reply.get("detail", "stale cursor"),
                                    resync=reply.get("resync", 0))
+        if reply.get("error"):
+            raise RuntimeError(
+                f"state op {op!r} failed on the head: {reply['error']}")
         return reply.get("value")
 
     def get_actor_handle(self, name: str, namespace: str = "default"):
@@ -361,6 +365,8 @@ class WorkerExecutor:
             conn.reply(msg, ok=ok)
         elif mtype == protocol.TRACE_DUMP:
             conn.reply(msg, dump=_tp.dump())
+        elif mtype == protocol.METRICS_DUMP:
+            conn.reply(msg, dump=_mp.local_dump())
         elif mtype == protocol.SHUTDOWN:
             self.stop_event.set()
         elif mtype == protocol.PING:
@@ -616,6 +622,7 @@ class WorkerExecutor:
                 return
             self._started_tasks.add(spec.task_id)
         t0 = time.time()
+        t0m = time.monotonic()      # exec histogram: step-immune clock
         tctx = self._open_exec_span(spec)
         self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
         try:
@@ -651,6 +658,7 @@ class WorkerExecutor:
                                task_name=spec.name)
             error = True
         tr = self._close_exec_span(tctx, spec, error)
+        _mp.observe_exec(time.monotonic() - t0m)
         extra = {"name": spec.name}
         if tr is not None:
             extra["_trace"] = tr
@@ -701,6 +709,7 @@ class WorkerExecutor:
 
     def _run_actor_task(self, spec: ActorTaskSpec) -> None:
         t0 = time.time()
+        t0m = time.monotonic()      # exec histogram: step-immune clock
         tctx = self._open_exec_span(spec)
         self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
         try:
@@ -710,6 +719,7 @@ class WorkerExecutor:
             result = TaskError(e, format_exception(e), task_name=spec.name)
             error = True
         tr = self._close_exec_span(tctx, spec, error)
+        _mp.observe_exec(time.monotonic() - t0m)
         extra = {"name": spec.name}
         if tr is not None:
             extra["_trace"] = tr
@@ -722,6 +732,7 @@ class WorkerExecutor:
 
     async def _run_actor_task_async(self, spec: ActorTaskSpec) -> None:
         t0 = time.time()
+        t0m = time.monotonic()      # exec histogram: step-immune clock
         tctx = self._open_exec_span(spec, set_tls=False)
         self._record_event(spec.task_id, spec.name, "EXEC_STARTED")
         try:
@@ -733,6 +744,7 @@ class WorkerExecutor:
             result = TaskError(e, format_exception(e), task_name=spec.name)
             error = True
         tr = self._close_exec_span(tctx, spec, error)
+        _mp.observe_exec(time.monotonic() - t0m)
         extra = {"name": spec.name}
         if tr is not None:
             extra["_trace"] = tr

@@ -170,7 +170,9 @@ class Autoscaler:
     def __init__(self, cluster, node_types: List[NodeTypeConfig],
                  provider: Optional[NodeProvider] = None,
                  idle_timeout_s: float = 60.0,
-                 update_interval_s: float = 1.0):
+                 update_interval_s: float = 1.0,
+                 queue_latency_source=None):
+        from ray_tpu._private.config import CONFIG
         self._cluster = cluster
         self._types = {t.name: t for t in node_types}
         self._provider = provider or NodeProvider(cluster)
@@ -182,6 +184,27 @@ class Autoscaler:
         self._thread: Optional[threading.Thread] = None
         self.num_scale_ups = 0
         self.num_scale_downs = 0
+        # Queue-latency signal (r11, RAY_TPU_AUTOSCALE_QUEUE_LATENCY_S
+        # > 0 enables): scale up when the cluster task queue-wait p95
+        # over the recent window exceeds the threshold — latency-SLO
+        # scaling that fires even when every queued shape technically
+        # fits (resource-demand scaling can't see slow drains, only
+        # unplaceable shapes). `queue_latency_source` overrides where
+        # the p95 comes from (tests / external SLO pipelines); the
+        # default reads the runtime's cluster metrics collector.
+        self.latency_threshold_s = float(
+            CONFIG.autoscale_queue_latency_s)
+        self.latency_window_s = float(
+            CONFIG.autoscale_queue_latency_window_s)
+        self.latency_cooldown_s = float(
+            CONFIG.autoscale_queue_latency_cooldown_s)
+        self._latency_source = (queue_latency_source
+                                or self._default_latency_source)
+        # None, not 0.0: a fresh host's CLOCK_MONOTONIC can be smaller
+        # than the cooldown, which would suppress the first trigger
+        self._last_latency_scale_up: Optional[float] = None
+        self.num_latency_scale_ups = 0
+        self.last_queue_wait_p95: Optional[float] = None
         # launches whose node hasn't registered yet (async providers):
         # counted as planned capacity so repeated updates don't
         # re-launch for the same demand. (node_id, resources, at)
@@ -283,6 +306,51 @@ class Autoscaler:
                     demand.append(dict(bundle))
         return demand
 
+    def _default_latency_source(self) -> Optional[float]:
+        """Cluster queue-wait p95 from the runtime's metrics collector
+        (r11 metrics plane); None when metrics are off or no tasks
+        waited in the window."""
+        collector = getattr(getattr(self._cluster, "_rt", None),
+                            "metrics", None)
+        if collector is None:
+            return None
+        # non-blocking: the fan-out runs on the collector's own
+        # thread, so a wedged agent can never stall this reconcile
+        # loop (it also drives demand scale-up and launch bookkeeping)
+        return collector.queue_wait_p95(window_s=self.latency_window_s,
+                                        block=False)
+
+    def _maybe_latency_scale_up(self, now: float) -> None:
+        if self.latency_threshold_s <= 0:
+            return
+        try:
+            p95 = self._latency_source()
+        except Exception:
+            return                  # a broken signal must never kill
+        self.last_queue_wait_p95 = p95      # the reconcile loop
+        if p95 is None or p95 <= self.latency_threshold_s:
+            return
+        if (self._last_latency_scale_up is not None
+                and now - self._last_latency_scale_up
+                < self.latency_cooldown_s):
+            return                  # capacity from the last trigger is
+                                    # still draining the backlog
+        if self._in_flight_launches:
+            # a launched node can't drain anything before it
+            # REGISTERS: with slow providers the p95 stays breached
+            # through every cooldown window, and re-firing here would
+            # march to max_workers for a backlog the pending capacity
+            # already covers (the demand path packs into planned
+            # capacity for the same reason)
+            return
+        for t in self._types.values():
+            if self._count_type(t.name) + t.hosts > t.max_workers:
+                continue
+            self._scale_up(t)
+            self.num_latency_scale_ups += 1
+            self._last_latency_scale_up = now
+            return
+
     def _fits(self, shape: Dict[str, float],
               resources: Dict[str, float]) -> bool:
         # one feasibility definition for the whole runtime (epsilon'd):
@@ -359,6 +427,9 @@ class Autoscaler:
                     caps[0][k] = caps[0].get(k, 0.0) - v
                 planned.extend(caps)
                 break
+        # queue-latency signal: scale up when the windowed queue-wait
+        # p95 breaches the SLO threshold, even though every shape fits
+        self._maybe_latency_scale_up(now)
         # idle scale down (an atomic multi-host group only retires once
         # EVERY member is idle past the timeout)
         idle_map = {}
@@ -418,6 +489,12 @@ class Autoscaler:
         self.num_scale_downs += 1
 
     def stats(self) -> Dict[str, int]:
+        p95 = self.last_queue_wait_p95
+        if p95 == float("inf"):
+            p95 = None          # keep stats() strict-JSON-valid (the
+                                # raw inf still trips the trigger)
         return {"managed_nodes": len(self._managed),
                 "num_scale_ups": self.num_scale_ups,
-                "num_scale_downs": self.num_scale_downs}
+                "num_scale_downs": self.num_scale_downs,
+                "num_latency_scale_ups": self.num_latency_scale_ups,
+                "last_queue_wait_p95": p95}

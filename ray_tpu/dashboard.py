@@ -13,7 +13,12 @@ the state API + metrics registry. Endpoints:
   GET /api/serve_applications  (serve apps -> deployments/replicas)
   GET /api/timeline     (Chrome-trace JSON of recorded task events —
                          load in Perfetto / chrome://tracing)
-  GET /metrics          (Prometheus exposition of util.metrics)
+  GET /api/metrics_summary  (cluster metrics JSON: windowed task-
+                         latency percentiles + sparkline ring, r11)
+  GET /metrics          (Prometheus exposition — CLUSTER-aggregated
+                         when a runtime is attached: every process's
+                         registry merged with node/worker labels;
+                         head-local util.metrics otherwise)
   GET /                 (single-page frontend app: tabbed views over
                          the JSON API with utilization + host-stats
                          bars, auto-refreshing; no external assets)
@@ -63,7 +68,7 @@ i.none{color:#5a6474}
 <nav id="nav"></nav><main id="out">loading…</main>
 <script>
 const TABS={Overview:ovw,Nodes:nodes,Workers:workers,Actors:actors,
-            Tasks:tasks,Serve:serveApps,Jobs:jobs,
+            Tasks:tasks,Metrics:metricsTab,Serve:serveApps,Jobs:jobs,
             "Placement Groups":pgs};
 let cur="Overview", cache={};
 async function J(p){const r=await fetch("/api/"+p);return r.json()}
@@ -136,6 +141,42 @@ async function tasks(){
 }
 async function pgs(){
   return "<h2>placement groups</h2>"+table(await J("placement_groups"))}
+function fmtMs(s){return s==null?"—":(s*1000).toFixed(s<0.01?2:0)+" ms"}
+function spark(label,vals){
+  const nums=vals.map(v=>v==null?0:v), w=240, hh=36;
+  const max=Math.max(...nums,1e-9);
+  const pts=nums.map((v,i)=>
+    `${(i/Math.max(nums.length-1,1))*w},${hh-1-(v/max)*(hh-3)}`
+  ).join(" ");
+  return `<div class=kpi><svg width="${w}" height="${hh}">`+
+    `<polyline fill="none" stroke="#4f8ef7" stroke-width="1.5" `+
+    `points="${pts}"/></svg><span>${esc(label)} · max `+
+    `${Math.round(max*100)/100}</span></div>`;
+}
+async function metricsTab(){
+  const m=await J("metrics_summary");
+  if(m.error) return "<i class=none>"+esc(m.error)+"</i>";
+  if(!m.enabled)
+    return "<i class=none>metrics disabled (RAY_TPU_METRICS=0)</i>";
+  let h="<div class=kpis>";
+  h+=kpi(m.sources,"processes scraped");
+  h+=kpi(m.tasks_done_total,"tasks done");
+  h+=kpi(fmtMs(m.queue_wait.p95),"queue wait p95 ≤");
+  h+=kpi(fmtMs(m.e2e.p95),"e2e p95 ≤");
+  h+=kpi(m.shm_pool_hit_rate==null?"—":
+         Math.round(m.shm_pool_hit_rate*100)+"%","shm pool hit rate");
+  h+=kpi(m.lease_outstanding,"leased outstanding");
+  h+="</div><h2>phase latency (last "+m.window_s+"s, bucket upper "+
+     "bounds)</h2>";
+  h+=table(["queue_wait","exec","e2e"].map(p=>
+    Object.assign({phase:p},m[p])),["phase","count","p50","p95","p99"]);
+  h+="<h2>trends (per scrape)</h2><div class=kpis>";
+  h+=spark("tasks/s",m.ring.map(r=>r.tasks_per_s));
+  h+=spark("queue p95 ms",m.ring.map(r=>r.queue_p95_ms));
+  h+=spark("wire frames/s",m.ring.map(r=>r.wire_frames_per_s));
+  h+=spark("pull in-flight MB",m.ring.map(r=>r.pull_inflight_mb));
+  return h+"</div>";
+}
 async function serveApps(){
   const apps=await J("serve_applications");
   const names=Object.keys(apps);
@@ -238,7 +279,36 @@ def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> int:
         if path == "timeline":
             from ray_tpu.util.metrics import timeline
             return timeline()
+        if path == "metrics_summary":
+            from ray_tpu._private import context as _context
+            return _context.get_ctx().state_op("metrics_summary")
         raise KeyError(path)
+
+    last_cluster_text: list = [None]
+
+    def metrics_text() -> str:
+        """Cluster-aggregated exposition when a runtime is attached
+        (r11: every process's registry merged with node/worker
+        labels); the head-local registry otherwise — a dashboard
+        started without init() keeps scraping something. A transient
+        collect failure re-serves the LAST cluster exposition rather
+        than flipping to the unlabeled head-local schema (a phantom
+        label change would fork every series Prometheus-side)."""
+        from ray_tpu._private import context as _context
+        from ray_tpu._private import metrics_plane as _mp
+        ctx = _context.maybe_ctx()
+        if ctx is not None and _mp.enabled():
+            try:
+                merged = ctx.state_op("metrics_dump")
+                if merged:
+                    text = _mp.prometheus_text(merged)
+                    last_cluster_text[0] = text
+                    return text
+            except Exception:
+                pass           # head unreachable: degrade below
+            if last_cluster_text[0] is not None:
+                return last_cluster_text[0]   # stale beats schema flip
+        return DEFAULT_REGISTRY.prometheus_text()
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
@@ -247,7 +317,7 @@ def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> int:
                     body = _INDEX_HTML.encode()
                     ctype = "text/html"
                 elif self.path == "/metrics":
-                    body = DEFAULT_REGISTRY.prometheus_text().encode()
+                    body = metrics_text().encode()
                     ctype = "text/plain; version=0.0.4"
                 elif self.path.startswith("/api/"):
                     body = json.dumps(api(self.path[5:]),

@@ -9,11 +9,56 @@ scraping or file export. Tags follow the reference's tag_keys model.
 """
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _TagTuple = Tuple[str, ...]
+
+
+def escape_label_value(value) -> str:
+    """Prometheus exposition-format label-value escaping: backslash,
+    double quote, and newline must be escaped or a hostile/unlucky tag
+    value corrupts the whole scrape output."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text) -> str:
+    # HELP lines escape only backslash and newline (the format keeps
+    # quotes literal there)
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def render_prometheus(snapshot: Dict[str, dict]) -> str:
+    """Render a registry snapshot (`MetricsRegistry.collect()` shape,
+    or the cluster-merged snapshot from `_private/metrics_plane`) as
+    Prometheus exposition text. One renderer for both so the head-local
+    and cluster-aggregated views cannot drift."""
+    lines: List[str] = []
+    for name, snap in snapshot.items():
+        lines.append(f"# HELP {name} "
+                     f"{_escape_help(snap.get('description', ''))}")
+        lines.append(f"# TYPE {name} {snap.get('type', 'untyped')}")
+        for tags, value in snap["series"].items():
+            label = ",".join(
+                f'{k}="{escape_label_value(v)}"' for k, v in tags)
+            label = "{" + label + "}" if label else ""
+            if snap["type"] == "histogram":
+                total, count, buckets = value
+                blabel = label[:-1] + "," if label else "{"
+                for bound, c in buckets:
+                    lines.append(
+                        f'{name}_bucket{blabel}le="{bound}"}} {c}')
+                # exposition format mandates the +Inf bucket == count
+                lines.append(
+                    f'{name}_bucket{blabel}le="+Inf"}} {count}')
+                lines.append(f"{name}_sum{label} {total}")
+                lines.append(f"{name}_count{label} {count}")
+            else:
+                lines.append(f"{name}{label} {value}")
+    return "\n".join(lines) + "\n"
 
 
 class MetricsRegistry:
@@ -40,27 +85,7 @@ class MetricsRegistry:
         return {m.name: m.snapshot() for m in metrics}
 
     def prometheus_text(self) -> str:
-        lines: List[str] = []
-        for name, snap in self.collect().items():
-            lines.append(f"# HELP {name} {snap['description']}")
-            lines.append(f"# TYPE {name} {snap['type']}")
-            for tags, value in snap["series"].items():
-                label = ",".join(f'{k}="{v}"' for k, v in tags)
-                label = "{" + label + "}" if label else ""
-                if snap["type"] == "histogram":
-                    total, count, buckets = value
-                    blabel = label[:-1] + "," if label else "{"
-                    for bound, c in buckets:
-                        lines.append(
-                            f'{name}_bucket{blabel}le="{bound}"}} {c}')
-                    # exposition format mandates the +Inf bucket == count
-                    lines.append(
-                        f'{name}_bucket{blabel}le="+Inf"}} {count}')
-                    lines.append(f"{name}_sum{label} {total}")
-                    lines.append(f"{name}_count{label} {count}")
-                else:
-                    lines.append(f"{name}{label} {value}")
-        return "\n".join(lines) + "\n"
+        return render_prometheus(self.collect())
 
     def clear(self) -> None:
         with self._lock:
@@ -105,6 +130,16 @@ class Metric:
             return {"type": self._type, "description": self.description,
                     "series": dict(self._series)}
 
+    def prune_series(self, predicate) -> int:
+        """Drop every series whose tag-tuple key matches `predicate`
+        (stale-label hygiene: long-lived registries must not grow
+        forever under label churn). Returns the number dropped."""
+        with self._lock:
+            dead = [k for k in self._series if predicate(k)]
+            for k in dead:
+                del self._series[k]
+            return len(dead)
+
 
 class Counter(Metric):
     _type = "counter"
@@ -126,9 +161,42 @@ class Gauge(Metric):
         with self._lock:
             self._series[self._key(tags)] = float(value)
 
+    def set_many(self, rows: Sequence[Tuple[Optional[Dict[str, str]],
+                                            float]]) -> None:
+        """Atomically REPLACE every series with `rows` ((tags, value)
+        pairs). Samplers that mirror a per-entity table (one series per
+        node/worker) use this so entities that disappeared drop out of
+        the snapshot instead of freezing at their last value."""
+        series = {self._key(tags): float(v) for tags, v in rows}
+        with self._lock:
+            self._series = series
+
 
 DEFAULT_HISTOGRAM_BOUNDARIES = (
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0)
+
+
+class _HistSeries:
+    """Mutable per-series histogram state: one counter per bucket
+    (non-cumulative), so a hot-path observe is a bisect + one list
+    increment — not a rebuild of the whole bucket tuple. The snapshot
+    converts back to the cumulative ``(total, count, ((bound, c≤), …))``
+    shape every consumer already reads."""
+
+    __slots__ = ("total", "count", "counts")
+
+    def __init__(self, n_buckets: int):
+        self.total = 0.0
+        self.count = 0
+        self.counts = [0] * n_buckets
+
+    def render(self, boundaries: Tuple[float, ...]) -> tuple:
+        cum = 0
+        buckets = []
+        for b, c in zip(boundaries, self.counts):
+            cum += c
+            buckets.append((b, cum))
+        return (self.total, self.count, tuple(buckets))
 
 
 class Histogram(Metric):
@@ -144,12 +212,26 @@ class Histogram(Metric):
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
         k = self._key(tags)
+        # NaN compares False against every bound: bisect_left would
+        # file it under the FIRST bucket, where `value <= b` filed it
+        # past the last (implicit +Inf overflow) — keep that.
+        i = (len(self.boundaries) if value != value
+             else bisect.bisect_left(self.boundaries, value))
         with self._lock:
-            total, count, buckets = self._series.get(
-                k, (0.0, 0, tuple((b, 0) for b in self.boundaries)))
-            buckets = tuple(
-                (b, c + (1 if value <= b else 0)) for b, c in buckets)
-            self._series[k] = (total + value, count + 1, buckets)
+            st = self._series.get(k)
+            if st is None:
+                st = self._series[k] = _HistSeries(len(self.boundaries))
+            st.total += value
+            st.count += 1
+            if i < len(st.counts):
+                st.counts[i] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            series = {k: st.render(self.boundaries)
+                      for k, st in self._series.items()}
+        return {"type": self._type, "description": self.description,
+                "series": series}
 
 
 def timeline(filename: Optional[str] = None) -> list:

@@ -208,7 +208,16 @@ def test_dashboard_endpoints(ray_cluster):
         html = get("/").decode()
         assert "ray_tpu" in html
         metrics = get("/metrics").decode()
-        assert "dashboard_test_total 3" in metrics
+        # r11: /metrics is cluster-aggregated — every series carries
+        # node/worker labels, user metrics included
+        import re
+        assert re.search(
+            r'dashboard_test_total\{node="[^"]+",worker=""\} 3',
+            metrics), metrics[:800]
+        # runtime-instrumented series ride the same exposition
+        assert "ray_tpu_task_e2e_s_count{" in metrics
+        msum = json.loads(get("/api/metrics_summary"))
+        assert msum["enabled"] and msum["sources"] >= 1
         # worker-manager table + usage rollup (frontend Workers tab)
         workers = json.loads(get("/api/workers"))
         assert workers and all("node_id" in w and "pid" in w

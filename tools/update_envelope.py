@@ -37,6 +37,8 @@ LABELS = [
     ("drain_100k", "100k drain, local workers"),
     ("drain_3k_notrace", "3k drain, RAY_TPU_TRACE=0"),
     ("drain_3k_trace", "3k drain, tracing on (default)"),
+    ("drain_3k_nometrics", "3k drain, RAY_TPU_METRICS=0"),
+    ("drain_3k_metrics", "3k drain, metrics on (default)"),
     ("tasks_sync_per_s", "tasks, sync round-trip"),
     ("tasks_batch_per_s", "tasks, batched"),
     ("actor_calls_sync_per_s", "actor calls, sync"),
@@ -87,7 +89,8 @@ def _fmt_result(rec: dict) -> str:
     extras = {k: v for k, v in rec.items()
               if k not in ("n", "unit", "frames_per_task",
                            "head_cpu_us_per_task",
-                           "trace_overhead_pct")}
+                           "trace_overhead_pct",
+                           "metrics_overhead_pct")}
     return ", ".join(f"{k}={v}" for k, v in extras.items())
 
 
@@ -111,6 +114,15 @@ def _fmt_trace(rec: dict) -> str:
     return "—"
 
 
+def _fmt_metrics(rec: dict) -> str:
+    """The r11 metrics-plane overhead column, next to the trace one:
+    throughput delta of the metrics-on run vs its RAY_TPU_METRICS=0
+    twin (same negative-means-noise reading)."""
+    if "metrics_overhead_pct" in rec:
+        return f"{rec['metrics_overhead_pct']:+}%"
+    return "—"
+
+
 def render_block(results: dict) -> str:
     known = [k for k, _ in LABELS]
     rows = [(label, results[key]) for key, label in LABELS
@@ -121,11 +133,12 @@ def render_block(results: dict) -> str:
              "### Latest `bench_core.py` run (machine-generated)",
              "",
              "| Scenario | Result | frames/task · head-CPU/task "
-             "| trace overhead |",
-             "|---|---|---|---|"]
+             "| trace overhead | metrics overhead |",
+             "|---|---|---|---|---|"]
     for label, rec in rows:
         lines.append(f"| {label} | {_fmt_result(rec)} | "
-                     f"{_fmt_frames(rec)} | {_fmt_trace(rec)} |")
+                     f"{_fmt_frames(rec)} | {_fmt_trace(rec)} | "
+                     f"{_fmt_metrics(rec)} |")
     lines.append(END)
     return "\n".join(lines)
 

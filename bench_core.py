@@ -13,10 +13,16 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import sys
 import time
 
 import numpy as np
+
+# Repetitions per A/B pair (each rep runs BOTH arms, order
+# alternating). 2 is the minimum that gives every arm one first-run
+# and one second-run sample.
+AB_REPS = max(1, int(os.environ.get("RAY_TPU_BENCH_AB_REPS", "2")))
 
 
 def timed(fn, n: int, *, unit: str = "ops") -> dict:
@@ -25,6 +31,50 @@ def timed(fn, n: int, *, unit: str = "ops") -> dict:
     dt = time.perf_counter() - t0
     return {"n": n, "seconds": round(dt, 4),
             "per_second": round(n / dt, 1), "unit": unit}
+
+
+def _ab_pair(results: dict, key_a: str, run_a, key_b: str, run_b,
+             reps: int = None) -> tuple[dict, dict]:
+    """Order-bias-corrected A/B scenario pair.
+
+    Back-to-back pairs systematically favor the SECOND run (warmed
+    page cache, faulted pool pages, a settled box): r11 measured the
+    metrics-plane overhead at "-8.0%" purely from running second, and
+    a reversed-order control confirmed. So every A/B pair runs
+    ``reps`` times with the arm order ALTERNATING (rep 0: A then B,
+    rep 1: B then A, ...). Each arm's recorded result is its
+    median-throughput run; the ``ab`` block carries every rep's
+    per_second tagged by running order plus the per-order medians, so
+    a reader can see the order spread instead of trusting one
+    ordering. Speedup/overhead figures derive from the arm medians."""
+    reps = AB_REPS if reps is None else reps
+    runs: dict[str, list] = {key_a: [], key_b: []}
+    for rep in range(reps):
+        order = ((key_a, run_a), (key_b, run_b))
+        if rep % 2:
+            order = order[::-1]
+        for pos, (key, run) in enumerate(order):
+            rec = run()
+            rec["_order"] = "first" if pos == 0 else "second"
+            runs[key].append(rec)
+    for key, recs in runs.items():
+        med = statistics.median_low([r["per_second"] for r in recs])
+        rec = dict(next(r for r in recs if r["per_second"] == med))
+        rec.pop("_order")
+        rec["per_second"] = round(statistics.median(
+            [r["per_second"] for r in recs]), 3)
+        rec["ab"] = {
+            "reps": reps,
+            "runs": [{"order": r["_order"],
+                      "per_second": r["per_second"]} for r in recs],
+            "order_medians": {
+                o: round(statistics.median(
+                    [r["per_second"] for r in recs
+                     if r["_order"] == o]), 3)
+                for o in ("first", "second")
+                if any(r["_order"] == o for r in recs)}}
+        results[key] = rec
+    return results[key_a], results[key_b]
 
 
 def _frame_stats(s0: dict, n_tasks: int) -> dict:
@@ -195,12 +245,15 @@ def _codec_bench() -> dict:
 
 
 def _broadcast_bench(n_nodes: int = 8, mb: int = 64) -> dict:
-    """Tree vs all-pull-from-source A/B (r8 object plane): one `mb`-MB
-    object distributed to `n_nodes` real agent subprocesses. `flat`
-    fans every node directly off the source (the pre-tree topology);
-    `tree` runs the fanout cascade — the source serves <= fanout
-    transfers and completed pullers serve their subtrees. Aggregate
-    GB/s counts every delivered copy."""
+    """Tree vs all-pull-from-source A/B (r8 object plane, r12
+    cut-through): one `mb`-MB object distributed to `n_nodes` real
+    agent subprocesses. `flat` fans every node directly off the source
+    (the pre-tree topology); `tree` runs the fanout cascade — the
+    source serves <= fanout transfers and relay nodes serve their
+    subtrees from the in-flight landing (cut-through) the moment their
+    first chunk lands. Aggregate GB/s counts every delivered copy.
+    Arm order alternates across AB_REPS (see _ab_pair); one cluster
+    hosts all reps, each rep broadcasting a FRESH object."""
     import ray_tpu
     from ray_tpu.cluster_utils import NodeAgentProcess
     from ray_tpu._private.config import CONFIG
@@ -215,8 +268,11 @@ def _broadcast_bench(n_nodes: int = 8, mb: int = 64) -> dict:
             time.sleep(0.2)
         joined = len(rt.cluster.alive_nodes()) - 1
         payload = np.arange(mb * 1024 * 1024 // 8, dtype=np.float64)
-        for name, fanout in (("flat", max(64, joined)), ("tree", 2)):
-            ref = ray_tpu.put(payload * (1.0 if name == "flat" else 2.0))
+        seq = {"n": 0}
+
+        def run(fanout: int) -> dict:
+            seq["n"] += 1
+            ref = ray_tpu.put(payload * float(seq["n"]))  # fresh object
             t0 = time.perf_counter()
             st = rt.broadcast_object(ref.object_id, fanout=fanout,
                                      timeout=600)
@@ -224,17 +280,19 @@ def _broadcast_bench(n_nodes: int = 8, mb: int = 64) -> dict:
             src_serves = rt._pull_server.serves_per_object().get(
                 ref.object_id, 0)
             gb = st["nbytes"] * st["completed"] / 2 ** 30
-            out[f"bcast_{mb}mb_{name}"] = {
-                "n": st["completed"], "unit": "GB",
-                "seconds": round(dt, 4),
-                "per_second": round(gb / dt, 3),
-                "fanout": fanout, "depth": st["depth"],
-                "source_serves": src_serves,
-                "failed": len(st["failed"])}
-            del ref                      # free agent copies before B run
+            rec = {"n": st["completed"], "unit": "GB",
+                   "seconds": round(dt, 4),
+                   "per_second": round(gb / dt, 3),
+                   "fanout": fanout, "depth": st["depth"],
+                   "source_serves": src_serves,
+                   "failed": len(st["failed"])}
+            del ref                  # free agent copies before the next
             time.sleep(1.0)
-        flat = out[f"bcast_{mb}mb_flat"]
-        tree = out[f"bcast_{mb}mb_tree"]
+            return rec
+
+        flat, tree = _ab_pair(
+            out, f"bcast_{mb}mb_flat", lambda: run(max(64, joined)),
+            f"bcast_{mb}mb_tree", lambda: run(2))
         if flat["per_second"]:
             tree["tree_speedup"] = round(
                 tree["per_second"] / flat["per_second"], 2)
@@ -247,36 +305,129 @@ def _broadcast_bench(n_nodes: int = 8, mb: int = 64) -> dict:
     return out
 
 
+def _pull_bench(mb: int = 64) -> dict:
+    """Manifest-vs-blob pull A/B (r12 zero-copy serve/land): one
+    holder store serving a `mb`-MB object over a real same-box TCP
+    pair. The blob arm is exactly what a MINOR<5 peer runs
+    (materialize + pickle blob + chunk slices, reassembly + re-decode
+    on the puller); the manifest arm scatter-gathers chunk frames
+    straight from the holder's shm mapping and lands bodies into the
+    puller's pooled segments with ONE memcpy. Copy counters come from
+    OBJECT_PLANE_STATS deltas, so the copies-per-byte columns are the
+    code's own accounting, not an estimate. One untimed manifest
+    warm-up faults the segment pool first: timed manifest runs
+    measure steady-state (pooled-page) serving, the weight-delivery
+    case — same-box numbers are wire-floor-bound, see ENVELOPE."""
+    from ray_tpu._private import object_store as osm
+    from ray_tpu._private import object_transfer as ot
+    from ray_tpu._private import protocol
+    from ray_tpu._private.config import CONFIG
+    CONFIG.reload()
+    src = osm.LocalStore()
+    obj = osm.serialize(np.arange(mb * 1024 * 1024 // 8,
+                                  dtype=np.float64))
+    src.put_stored(obj)
+    oid, nbytes = obj.object_id, obj.nbytes
+    server = ot.PullServer(src)
+
+    def handle(conn, msg):
+        if msg["type"] == protocol.PULL_OBJECT:
+            server.handle_pull(conn, msg)
+        elif msg["type"] == protocol.PULL_CHUNK:
+            server.handle_chunk(conn, msg)
+
+    import socket as _socket
+    lst = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    lst.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    cli = protocol.connect(lst.getsockname(), lambda c, m: None,
+                           name="bench-puller")
+    srv_sock, _ = lst.accept()
+    srv = protocol.Connection(srv_sock, handle,
+                              on_close=server.on_conn_closed,
+                              name="bench-holder", server=True)
+    srv.start()
+    dst = osm.LocalStore()
+    out: dict = {}
+    try:
+        def run(manifest: bool) -> dict:
+            s0 = dict(ot.OBJECT_PLANE_STATS)
+            t0 = time.perf_counter()
+            stored = ot.pull_object(cli, oid, timeout=300,
+                                    store=dst if manifest else None)
+            dt = time.perf_counter() - t0
+            assert stored is not None and stored.nbytes == nbytes
+            d = {k: ot.OBJECT_PLANE_STATS[k] - s0[k] for k in s0}
+            rec = {"n": 1, "unit": "GB", "seconds": round(dt, 4),
+                   "per_second": round(nbytes / dt / 2 ** 30, 3),
+                   "serve_copies_per_byte": round(
+                       d["serve_bytes_copied"] / nbytes, 2),
+                   "land_copies_per_byte": round(
+                       d["land_bytes_copied"] / nbytes, 2)}
+            if manifest:
+                dst.delete(oid)      # segments back to the pool
+            return rec
+
+        run(True)                    # untimed pool warm-up
+        blob, man = _ab_pair(out, f"pull_{mb}mb_blob",
+                             lambda: run(False),
+                             f"pull_{mb}mb_manifest",
+                             lambda: run(True))
+        if blob["per_second"]:
+            man["manifest_speedup"] = round(
+                man["per_second"] / blob["per_second"], 2)
+    finally:
+        cli.close()
+        srv.close()
+        lst.close()
+        dst.shutdown()
+        src.shutdown()
+    return out
+
+
 def main(as_json: bool = False) -> dict:
     results: dict = {}
 
     # ----------------------- wire codec: native vs pure Python (r7)
     results.update(_codec_bench())
 
+    # ----- object plane: manifest vs blob 64 MB pull (r12 zero-copy)
+    results.update(_pull_bench())
+
     # ------- object plane: broadcast tree vs all-pull-from-source (r8)
     results.update(_broadcast_bench())
 
     # ------------- native frame engine: 5k drain A/B (r7)
-    # Back-to-back fresh runtimes, same box, same tree — the OFF run
-    # first (workers inherit the env at spawn), then the identical ON
-    # run, so the pair is the tightest native-vs-python comparison the
-    # bench produces (scenarios further down drift with box load).
-    os.environ["RAY_TPU_DISABLE_NATIVE"] = "1"
-    try:
-        results["drain_5k_nonative"] = _drain_with_frames(5000)
-    finally:
-        os.environ.pop("RAY_TPU_DISABLE_NATIVE", None)
-    results["drain_5k_native"] = _drain_with_frames(5000)
-    results["drain_5k_native"]["native_speedup"] = round(
-        results["drain_5k_native"]["per_second"]
-        / results["drain_5k_nonative"]["per_second"], 2)
+    # Fresh runtime per run (each arm sets its env before its workers
+    # spawn); order alternates across reps — see _ab_pair.
+    def _drain_env(n: int, var: str = None, val: str = "1"):
+        def run() -> dict:
+            if var is not None:
+                os.environ[var] = val
+            try:
+                return _drain_with_frames(n)
+            finally:
+                if var is not None:
+                    os.environ.pop(var, None)
+        return run
+
+    _off, _on = _ab_pair(
+        results, "drain_5k_nonative",
+        _drain_env(5000, "RAY_TPU_DISABLE_NATIVE"),
+        "drain_5k_native", _drain_env(5000))
+    if _off["per_second"]:
+        _on["native_speedup"] = round(
+            _on["per_second"] / _off["per_second"], 2)
 
     # ---------- delegated vs central dispatch: 5k remote drain (r10)
-    # Same box, back-to-back fresh head+agent pairs; the central run
-    # first (its env must be set before the agent spawns).
-    results["drain_5k_central"] = _delegated_drain(5000, delegate=False)
-    results["drain_5k_delegated"] = _delegated_drain(5000, delegate=True)
-    _c, _d = results["drain_5k_central"], results["drain_5k_delegated"]
+    # Fresh head+agent pair per run (each arm's env is set before its
+    # agent spawns, inside _delegated_drain); order alternates.
+    _c, _d = _ab_pair(
+        results, "drain_5k_central",
+        lambda: _delegated_drain(5000, delegate=False),
+        "drain_5k_delegated",
+        lambda: _delegated_drain(5000, delegate=True))
     if _c["per_second"]:
         _d["delegate_speedup"] = round(
             _d["per_second"] / _c["per_second"], 2)
@@ -294,17 +445,13 @@ def main(as_json: bool = False) -> dict:
     # lease/recv/exec/put/done spans and task-plane frames carry 18
     # bytes of trace context — throughput, frames/task, and head-CPU
     # µs/task must stay within noise of the traced-off run.
-    os.environ["RAY_TPU_TRACE"] = "0"
-    try:
-        results["drain_3k_notrace"] = _drain_with_frames(3000)
-    finally:
-        os.environ.pop("RAY_TPU_TRACE", None)
-    results["drain_3k_trace"] = _drain_with_frames(3000)
-    _base = results["drain_3k_notrace"]["per_second"]
-    if _base:
-        results["drain_3k_trace"]["trace_overhead_pct"] = round(
-            (_base / results["drain_3k_trace"]["per_second"] - 1) * 100,
-            1)
+    _b, _t = _ab_pair(
+        results, "drain_3k_notrace",
+        _drain_env(3000, "RAY_TPU_TRACE", "0"),
+        "drain_3k_trace", _drain_env(3000))
+    if _b["per_second"]:
+        _t["trace_overhead_pct"] = round(
+            (_b["per_second"] / _t["per_second"] - 1) * 100, 1)
 
     # --------- metrics plane: metrics-off vs metrics-on 3k drain (r11)
     # Machine-checks the r11 zero-cost claim: with metrics ON (the
@@ -312,17 +459,13 @@ def main(as_json: bool = False) -> dict:
     # a worker exec + head e2e bucket (one bisect + list increment
     # each), and every spec carries a submit stamp — throughput must
     # stay within noise of the RAY_TPU_METRICS=0 run.
-    os.environ["RAY_TPU_METRICS"] = "0"
-    try:
-        results["drain_3k_nometrics"] = _drain_with_frames(3000)
-    finally:
-        os.environ.pop("RAY_TPU_METRICS", None)
-    results["drain_3k_metrics"] = _drain_with_frames(3000)
-    _base = results["drain_3k_nometrics"]["per_second"]
-    if _base:
-        results["drain_3k_metrics"]["metrics_overhead_pct"] = round(
-            (_base / results["drain_3k_metrics"]["per_second"] - 1)
-            * 100, 1)
+    _b, _m = _ab_pair(
+        results, "drain_3k_nometrics",
+        _drain_env(3000, "RAY_TPU_METRICS", "0"),
+        "drain_3k_metrics", _drain_env(3000))
+    if _b["per_second"]:
+        _m["metrics_overhead_pct"] = round(
+            (_b["per_second"] / _m["per_second"] - 1) * 100, 1)
 
     # ------------------- control-frame coalescing: off vs on (r6)
     # The OFF run goes first in its own runtime (workers inherit the

@@ -92,15 +92,23 @@ class BroadcastCoordinator:
         self.trees_built = 0
 
     # ------------------------------------------------------ directory
-    def on_location(self, object_id: str, node_id: str) -> None:
+    def on_location(self, object_id: str, node_id: str,
+                    partial: bool = False) -> None:
         """Directory listener: a node registered a copy — if it is part
-        of an active broadcast, unlock its subtree."""
+        of an active broadcast, unlock its subtree. A PARTIAL
+        registration (r12 cut-through: the node landed its first chunk
+        and serves landed ranges from the in-flight landing) dispatches
+        the children WITHOUT completing the node, so tree depth costs
+        per-chunk instead of per-object latency; the full registration
+        later completes it (children already dispatched are skipped by
+        the job's dispatched set)."""
         with self._lock:
             job = self._jobs.get(object_id)
             if job is None or node_id not in job.pending:
                 return
-            job.pending.discard(node_id)
-            job.completed.add(node_id)
+            if not partial:
+                job.pending.discard(node_id)
+                job.completed.add(node_id)
             to_dispatch = [c for c in job.children.get(node_id, ())
                            if c not in job.dispatched]
             if not job.pending:
@@ -219,12 +227,16 @@ class BroadcastCoordinator:
         if owner:
             for child in job.children.get(source, ()):
                 self._dispatch(job, child, parent=source)
-            # close the registration race: a target whose copy landed
-            # between the target-list read and the job registration
-            # will never fire another directory add event
+            # close the registration race: a target whose copy (or
+            # first cut-through chunk) landed between the target-list
+            # read and the job registration will never fire another
+            # directory add event
             for nid in list(job.pending):
                 if rt.controller.directory.holds(object_id, nid):
                     self.on_location(object_id, nid)
+                elif rt.controller.directory.holds_partial(object_id,
+                                                           nid):
+                    self.on_location(object_id, nid, partial=True)
         # wait in slices so dead nodes are pruned promptly
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)

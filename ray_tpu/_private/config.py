@@ -203,6 +203,28 @@ _define("pull_chunk_retries", 2,
         "Per-pull retries after a dropped/expired chunk: the puller "
         "re-opens a session with the holder and resumes from the "
         "failed chunk index before giving up on that source.")
+_define("pull_manifest", True,
+        "Manifest (zero-copy) object transfer (r12, wire MINOR >= 5): "
+        "pulls ask the holder for a manifest (payload + per-buffer "
+        "sizes) and chunk bodies ride the Envelope raw field straight "
+        "from the holder's mapped shm into the puller's pre-created "
+        "segments — no materialize/pickle copies on either side. "
+        "Negotiated per transfer: an old holder ignores the request "
+        "flag and serves the blob protocol. 0 restores blob pulls "
+        "everywhere.")
+_define("pull_cut_through", True,
+        "Cut-through relay (r12): a node mid-pull registers as a "
+        "PARTIAL holder at its first landed chunk and serves already-"
+        "landed chunk ranges to its broadcast children while its own "
+        "pull is in flight, making tree depth cost per-chunk instead "
+        "of per-object latency. Requires manifest transfers; 0 "
+        "restores store-and-forward relay.")
+_define("pull_partial_chunk_timeout_s", 20.0,
+        "Per-chunk client-side deadline when pulling from a PARTIAL "
+        "holder (its own pull may stall): on expiry the chunk counts "
+        "as dropped and the normal retry / re-root-on-source "
+        "machinery takes over, instead of burning the transfer's "
+        "whole deadline on a stalled relay.")
 _define("pull_session_ttl_s", 120.0,
         "Pull-session idle TTL on the serving side: sessions a dead "
         "puller abandoned are reaped on the next pull/chunk message "

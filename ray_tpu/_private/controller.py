@@ -175,8 +175,8 @@ class Controller:
     # ---- object directory (delegates to the ObjectDirectory
     # subsystem; these remain the control-plane entry points) ----
     def add_location(self, object_id: str, node_id: str,
-                     nbytes: int = 0) -> None:
-        self.directory.add(object_id, node_id, nbytes)
+                     nbytes: int = 0, partial: bool = False) -> None:
+        self.directory.add(object_id, node_id, nbytes, partial=partial)
 
     def remove_location(self, object_id: str,
                         node_id: Optional[str] = None) -> None:

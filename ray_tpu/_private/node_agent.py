@@ -119,7 +119,9 @@ class NodeAgent:
         self._pull_mgr = PullManager(
             self.store, sources_fn=self._pull_sources,
             on_complete=self._on_pull_complete,
-            on_source_failed=self._on_pull_source_failed)
+            on_source_failed=self._on_pull_source_failed,
+            on_partial=self._on_pull_partial,
+            on_partial_failed=self._on_pull_partial_failed)
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -1118,6 +1120,37 @@ class NodeAgent:
             self._send_to_head({"type": protocol.OBJECT_REMOVED,
                                 "object_id": oid,
                                 "node_id": source_id})
+
+    def _on_pull_partial(self, oid: str, nbytes: int) -> None:
+        """Cut-through (r12): first chunk of a winning pull landed —
+        register this node as a PARTIAL holder so the broadcast
+        coordinator dispatches our subtree against the in-flight
+        landing. Gated on the head demonstrating wire MINOR >= 5: an
+        old head would record the partial entry as a FULL location and
+        hand a half-landed copy to getters. Fire-and-forget WITHOUT
+        the outage replay buffer — a partial add replayed after a head
+        outage would be stale advisory state."""
+        head = self.head
+        if head is None or not head.peer_speaks_manifest():
+            return
+        try:
+            head.send({"type": protocol.OBJECT_ADDED, "object_id": oid,
+                       "node_id": self.node_id, "nbytes": nbytes,
+                       "addref": False, "partial": True})
+        except protocol.ConnectionClosed:
+            pass
+
+    def _on_pull_partial_failed(self, oid: str) -> None:
+        """The transfer died after registering partial: retract the
+        advisory location (children re-root via the directory)."""
+        head = self.head
+        if head is None or not head.peer_speaks_manifest():
+            return
+        try:
+            head.send({"type": protocol.OBJECT_REMOVED, "object_id": oid,
+                       "node_id": self.node_id})
+        except protocol.ConnectionClosed:
+            pass
 
     def _peer_conn(self, addr) -> Optional[protocol.Connection]:
         with self._peer_lock:

@@ -27,59 +27,106 @@ class ObjectDirectory:
     def __init__(self):
         self._lock = threading.Lock()
         self._locations: dict[str, set[str]] = {}
+        # PARTIAL holders (r12 cut-through): nodes mid-pull that have
+        # landed >= 1 chunk and can serve landed ranges to
+        # manifest-speaking children. Advisory — never handed to
+        # regular getters, never counted as a real copy (a node whose
+        # only "holders" are partial is still orphaned: a relay whose
+        # source died can never finish). Promoted to _locations on the
+        # full OBJECT_ADDED, retracted on pull failure / node death.
+        self._partial: dict[str, set[str]] = {}
         self._nbytes: dict[str, int] = {}
-        self._listeners: list[Callable[[str, str], None]] = []
+        self._listeners: list[Callable[[str, str, bool], None]] = []
         # counters for the object_plane_stats surface
         self.adds = 0
         self.removes = 0
+        self.partial_adds = 0
 
     # ------------------------------------------------------- mutation
-    def add_listener(self, fn: Callable[[str, str], None]) -> None:
-        """``fn(object_id, node_id)`` runs after every NEW location
-        registration (not on re-adds), outside the directory lock."""
+    def add_listener(self, fn: Callable[[str, str, bool], None]) -> None:
+        """``fn(object_id, node_id, partial)`` runs after every NEW
+        location registration (not on re-adds; partial=True for
+        cut-through partial-holder adds), outside the directory
+        lock."""
         self._listeners.append(fn)
 
-    def add(self, object_id: str, node_id: str, nbytes: int = 0) -> bool:
+    def add(self, object_id: str, node_id: str, nbytes: int = 0,
+            partial: bool = False) -> bool:
         """Register a copy; returns True (and notifies listeners) only
-        when the holder set actually grew."""
+        when the holder set actually grew. ``partial=True`` records an
+        advisory cut-through holder instead (ignored when the node
+        already holds a full copy)."""
         with self._lock:
-            s = self._locations.setdefault(object_id, set())
-            new = node_id not in s
-            s.add(node_id)
-            if nbytes:
-                self._nbytes[object_id] = nbytes
-            if new:
-                self.adds += 1
+            full = self._locations.get(object_id)
+            if partial:
+                if full is not None and node_id in full:
+                    return False          # full copy supersedes
+                p = self._partial.setdefault(object_id, set())
+                new = node_id not in p
+                p.add(node_id)
+                if nbytes:
+                    self._nbytes[object_id] = nbytes
+                if new:
+                    self.partial_adds += 1
+            else:
+                s = self._locations.setdefault(object_id, set())
+                new = node_id not in s
+                s.add(node_id)
+                # promotion: the full copy replaces the partial entry
+                p = self._partial.get(object_id)
+                if p is not None:
+                    p.discard(node_id)
+                    if not p:
+                        self._partial.pop(object_id, None)
+                if nbytes:
+                    self._nbytes[object_id] = nbytes
+                if new:
+                    self.adds += 1
         if new:
             for fn in self._listeners:
                 try:
-                    fn(object_id, node_id)
+                    fn(object_id, node_id, partial)
                 except Exception:
                     pass
         return new
 
     def remove(self, object_id: str,
                node_id: Optional[str] = None) -> None:
-        """Drop one holder, or the whole entry when node_id is None."""
+        """Drop one holder (full AND partial), or the whole entry when
+        node_id is None."""
         with self._lock:
             if node_id is None:
                 if self._locations.pop(object_id, None) is not None:
                     self.removes += 1
+                self._partial.pop(object_id, None)
                 self._nbytes.pop(object_id, None)
                 return
+            p = self._partial.get(object_id)
+            if p is not None and node_id in p:
+                p.discard(node_id)
+                if not p:
+                    self._partial.pop(object_id, None)
             s = self._locations.get(object_id)
             if s is not None and node_id in s:
                 s.discard(node_id)
                 self.removes += 1
                 if not s:
                     self._locations.pop(object_id, None)
+                    self._partial.pop(object_id, None)
                     self._nbytes.pop(object_id, None)
 
     def purge_node(self, node_id: str) -> list[str]:
         """Drop `node_id` from every entry; returns object ids left
-        with NO copy anywhere (lineage-recovery candidates)."""
+        with NO full copy anywhere (lineage-recovery candidates —
+        partial holders don't count: a relay whose source died can
+        never finish its copy)."""
         orphaned: list[str] = []
         with self._lock:
+            for oid in list(self._partial):
+                p = self._partial[oid]
+                p.discard(node_id)
+                if not p:
+                    self._partial.pop(oid, None)
             for oid in list(self._locations):
                 s = self._locations[oid]
                 if node_id in s:
@@ -87,6 +134,7 @@ class ObjectDirectory:
                     self.removes += 1
                     if not s:
                         self._locations.pop(oid, None)
+                        self._partial.pop(oid, None)
                         self._nbytes.pop(oid, None)
                         orphaned.append(oid)
         return orphaned
@@ -103,6 +151,14 @@ class ObjectDirectory:
     def holds(self, object_id: str, node_id: str) -> bool:
         with self._lock:
             return node_id in self._locations.get(object_id, ())
+
+    def holds_partial(self, object_id: str, node_id: str) -> bool:
+        with self._lock:
+            return node_id in self._partial.get(object_id, ())
+
+    def partial_locations(self, object_id: str) -> list[str]:
+        with self._lock:
+            return list(self._partial.get(object_id, ()))
 
     def nbytes(self, object_id: str) -> int:
         with self._lock:
@@ -138,8 +194,12 @@ class ObjectDirectory:
                     dict(self._nbytes))
 
     def restore(self, locations: dict, nbytes: dict) -> None:
+        # partial holders deliberately don't survive a head restart:
+        # they are advisory in-flight state (the pull either completes
+        # and re-registers full, or failed while the head was down)
         with self._lock:
             self._locations = {k: set(v) for k, v in locations.items()}
+            self._partial = {}
             self._nbytes = dict(nbytes)
 
     def stats(self) -> dict:
@@ -148,7 +208,10 @@ class ObjectDirectory:
                 "objects": len(self._locations),
                 "replicas": sum(len(s)
                                 for s in self._locations.values()),
+                "partial_replicas": sum(len(s)
+                                        for s in self._partial.values()),
                 "tracked_bytes": sum(self._nbytes.values()),
                 "adds": self.adds,
                 "removes": self.removes,
+                "partial_adds": self.partial_adds,
             }

@@ -219,7 +219,10 @@ class _SegmentPool:
                                          self._classes.values()),
                     "pool_reused": self.reused,
                     "pool_misses": self.misses,
-                    "pool_released": self.pooled}
+                    "pool_released": self.pooled,
+                    # riding the pool surface into /metrics: the put
+                    # path's user-space byte copies (r12 copy budget)
+                    "put_bytes_copied": COPY_STATS["put_bytes_copied"]}
 
 
 SEGMENT_POOL = _SegmentPool()
@@ -264,16 +267,40 @@ def free_segment(name: str) -> None:
         unlink_segment(name)
 
 
-def _create_segment(name: str, data: memoryview) -> int:
-    """Create (or reuse from the pool) + fill a named segment, then
-    release all process-local resources; the segment persists by name
-    until shm_unlink. Fresh segments are rounded up to the pool's size
-    class so they are poolable when freed (readers map only the data
-    length; mapping a prefix of the file is fine). Returns the
-    allocated kernel size — the class-rounded figure capacity ledgers
-    must charge (a reused segment's already-touched pages can span its
+# Copy accounting for the zero-copy envelope (r12): every user-space
+# byte copy on the put path (serialize -> shm) bumps this, so the
+# bytes-copied-per-byte-transferred bench columns and the metrics
+# plane (ray_tpu_shm_pool{counter="put_bytes_copied"}) can prove copy
+# regressions. Plain int increment under the GIL, WIRE_STATS
+# discipline. Transfer-side copies live in OBJECT_PLANE_STATS.
+COPY_STATS = {"put_bytes_copied": 0}
+
+
+def bulk_copy(dst, dst_off: int, src) -> int:
+    """Copy `src` (any contiguous buffer) into the writable buffer
+    `dst` at `dst_off` — through the native GIL-released memcpy when
+    the library is loadable, else a plain slice assign. Returns bytes
+    copied. The single choke point for object-plane byte copies, so
+    the copy counters cannot drift from the copies."""
+    from ray_tpu import native as _native
+    n = src.nbytes if isinstance(src, memoryview) else len(src)
+    if n >= 65536 and _native.available():
+        _native.buf_copy(dst, dst_off, src)
+    else:
+        dst[dst_off:dst_off + n] = src
+    return n
+
+
+def _open_segment_for_write(name: str, n: int) -> tuple:
+    """Create (or reuse from the pool) a named segment sized for `n`
+    data bytes and return ``(mmap, alloc_size)`` with the mapping left
+    OPEN for the caller to fill; the segment persists by name until
+    shm_unlink. Fresh segments are rounded up to the pool's size class
+    so they are poolable when freed (readers map only the data length;
+    mapping a prefix of the file is fine). alloc_size is the allocated
+    kernel size — the class-rounded figure capacity ledgers must
+    charge (a reused segment's already-touched pages can span its
     whole class regardless of this object's data length)."""
-    n = len(data)
     size = SEGMENT_POOL.class_size(n) if SEGMENT_POOL._enabled() else n
     if SEGMENT_POOL.acquire(name, n):
         try:
@@ -282,9 +309,7 @@ def _create_segment(name: str, data: memoryview) -> int:
                 mm = mmap.mmap(fd, n)
             finally:
                 os.close(fd)
-            mm[:n] = data
-            mm.close()
-            return size
+            return mm, size
         except (OSError, ValueError):
             # reused segment unusable after all: fall through to create
             unlink_segment(name)
@@ -303,7 +328,18 @@ def _create_segment(name: str, data: memoryview) -> int:
         mm = mmap.mmap(fd, n)
     finally:
         os.close(fd)
-    mm[:n] = data
+    return mm, size
+
+
+def _create_segment(name: str, data: memoryview) -> int:
+    """Create (or reuse) + fill a named segment in one step — the
+    serialize() path. One memcpy total (pickle5's buffer_callback
+    hands over zero-copy views of the source arrays), GIL-released
+    through the native core for large buffers. Returns the allocated
+    kernel size (see _open_segment_for_write)."""
+    n = len(data)
+    mm, size = _open_segment_for_write(name, n)
+    COPY_STATS["put_bytes_copied"] += bulk_copy(mm, 0, data)
     mm.close()
     return size
 

@@ -32,8 +32,9 @@ from typing import Any, Callable, Optional
 
 from ray_tpu import native
 from ray_tpu._private.wire import (BATCH_MIN_MINOR, BATCH_TYPE,
-                                   DELEGATE_MIN_MINOR, METRICS_MIN_MINOR,
-                                   TRACE_KEY, TRACE_MIN_MINOR, WIRE_MAJOR,
+                                   DELEGATE_MIN_MINOR, MANIFEST_MIN_MINOR,
+                                   METRICS_MIN_MINOR, RAW_KEY, TRACE_KEY,
+                                   TRACE_MIN_MINOR, WIRE_MAJOR,
                                    WireVersionError, dumps, dumps_batch,
                                    encode_batch_parts, encode_frame_parts,
                                    loads_ex)
@@ -411,6 +412,15 @@ class Connection:
         fan-out deadline (same rule as peer_speaks_delegate)."""
         v = self.peer_wire_version
         return v // 100 == WIRE_MAJOR and v % 100 >= METRICS_MIN_MINOR
+
+    def peer_speaks_manifest(self) -> bool:
+        """Whether the peer understands the r12 manifest object plane
+        (MINOR >= 5). The transfer protocol itself negotiates per
+        message (reply-shape, see object_transfer) — this gate exists
+        for partial-holder OBJECT_ADDED reports, which an old head
+        would misread as full locations. Unknown (0) counts as NO."""
+        v = self.peer_wire_version
+        return v // 100 == WIRE_MAJOR and v % 100 >= MANIFEST_MIN_MINOR
 
     def _peer_speaks_trace(self) -> bool:
         """Whether trace context may ride this connection's envelopes.

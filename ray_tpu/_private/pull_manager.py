@@ -88,11 +88,21 @@ class PullManager:
                                       Iterable[tuple]],
                  on_complete: Optional[Callable] = None,
                  on_source_failed: Optional[Callable] = None,
+                 on_partial: Optional[Callable] = None,
+                 on_partial_failed: Optional[Callable] = None,
                  name: str = ""):
         self._store = store
         self._sources_fn = sources_fn
         self._on_complete = on_complete
         self._on_source_failed = on_source_failed
+        # cut-through hooks (r12): `on_partial(object_id, nbytes)`
+        # fires once per winning transfer at its FIRST landed chunk —
+        # the partial-holder directory registration that unlocks the
+        # node's broadcast subtree while the pull is still in flight.
+        # `on_partial_failed(object_id)` retracts it when the transfer
+        # dies after registering (children fall back multi-source).
+        self._on_partial = on_partial
+        self._on_partial_failed = on_partial_failed
         self.name = name
         self._lock = threading.Lock()
         self._inflight: dict[str, _Flight] = {}
@@ -154,6 +164,21 @@ class PullManager:
         if not acquired:
             OBJECT_PLANE_STATS["pulls_failed"] += 1
             return None
+        partial_fired = {"v": False}
+
+        def _first_chunk(nbytes: int) -> None:
+            # winner-only, once per transfer: register this node as a
+            # PARTIAL holder so the broadcast coordinator dispatches
+            # our subtree against the landing (cut-through)
+            if partial_fired["v"] or not _CFG.pull_cut_through:
+                return
+            partial_fired["v"] = True
+            if self._on_partial is not None:
+                try:
+                    self._on_partial(object_id, nbytes)
+                except Exception:
+                    pass
+
         try:
             stored = self._store.get_stored(object_id, timeout=0)
             if stored is not None:      # landed while we queued
@@ -168,7 +193,9 @@ class PullManager:
                 try:
                     stored = pull_object(conn, object_id,
                                          timeout=remaining,
-                                         budget=self._budget)
+                                         budget=self._budget,
+                                         store=self._store,
+                                         on_first_chunk=_first_chunk)
                 except PullBudgetExceeded:
                     # our own admission control, not the source's
                     # fault: keep the location, and stop rotating —
@@ -186,8 +213,15 @@ class PullManager:
                     stored = None
                 if stored is not None:
                     OBJECT_PLANE_STATS["pulls_completed"] += 1
-                    OBJECT_PLANE_STATS["pull_bytes"] += stored.nbytes
-                    self._store.put_stored(stored)
+                    # the manifest land path sealed into the store
+                    # itself (closing the landing->store serve gap);
+                    # only the blob path still needs the put here.
+                    # contains() is a residency probe (spilled counts):
+                    # get_stored would synchronously restore a copy
+                    # the LRU pass just spilled, on this thread
+                    if not self._store.contains(object_id):
+                        OBJECT_PLANE_STATS["pull_bytes"] += stored.nbytes
+                        self._store.put_stored(stored)
                     if self._on_complete is not None:
                         try:
                             self._on_complete(object_id, stored,
@@ -204,6 +238,18 @@ class PullManager:
             return None
         finally:
             self._sem.release()
+            if partial_fired["v"] and self._on_partial_failed is not None:
+                # the transfer registered as a partial holder but never
+                # completed (this thread is leaving without a store
+                # copy): retract the advisory location. Residency
+                # probe, NOT get_stored: a sealed-then-spilled copy is
+                # still held (retracting would drop the FULL location)
+                # and must not cost a synchronous disk restore here
+                if not self._store.contains(object_id):
+                    try:
+                        self._on_partial_failed(object_id)
+                    except Exception:
+                        pass
 
     def inflight(self) -> int:
         with self._lock:

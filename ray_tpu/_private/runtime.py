@@ -153,7 +153,15 @@ class Runtime(_context.BaseContext):
         self._pull_mgr = PullManager(
             self.store, sources_fn=self._head_pull_sources,
             on_source_failed=lambda oid, nid:
-                self.controller.remove_location(oid, nid))
+                self.controller.remove_location(oid, nid),
+            # cut-through (r12): the head mid-pull serves landed chunk
+            # ranges too — register/retract it as a partial holder so
+            # a broadcast rooted elsewhere can relay through it
+            on_partial=lambda oid, nbytes:
+                self.controller.add_location(oid, self.head_node_id,
+                                             nbytes, partial=True),
+            on_partial_failed=lambda oid:
+                self.controller.remove_location(oid, self.head_node_id))
         self.bcast = BroadcastCoordinator(self)
         self.controller.directory.add_listener(self.bcast.on_location)
         # Cluster metrics plane (r11): head-side scrape fan-out/merge
@@ -884,8 +892,17 @@ class Runtime(_context.BaseContext):
     def _on_object_added(self, msg: dict) -> None:
         """A node sealed/pulled a copy (OBJECT_ADDED, or the legacy
         object_at node event): register the location — the directory
-        listener cascades any active broadcast — and wake getters."""
+        listener cascades any active broadcast — and wake getters.
+        ``partial`` entries (r12 cut-through: the sender landed its
+        first chunk and can relay landed ranges) register advisory
+        partial holders only: no refcount, no waiter wakeups — the
+        object is not actually available there yet."""
         oid = msg["object_id"]
+        if msg.get("partial"):
+            self.controller.add_location(oid, msg["node_id"],
+                                         msg.get("nbytes", 0),
+                                         partial=True)
+            return
         self._seal_contained(oid, msg.get("contained") or [])
         if msg.get("addref"):
             self.controller.addref(oid)

@@ -50,7 +50,7 @@ from ray_tpu import native as _native
 from ray_tpu._private import wire_pb2 as pb
 
 WIRE_MAJOR = 1
-WIRE_MINOR = 4          # 1: BatchFrame coalescing (negotiated by peers)
+WIRE_MINOR = 5          # 1: BatchFrame coalescing (negotiated by peers)
                         # 2: Envelope trace_id/parent_span (tracing
                         #    plane; old peers skip unknown fields)
                         # 3: delegated scheduling ops (NODE_LEASE_BATCH
@@ -58,6 +58,9 @@ WIRE_MINOR = 4          # 1: BatchFrame coalescing (negotiated by peers)
                         #    numbered heartbeat deltas
                         # 4: METRICS_DUMP cluster scrape (metrics
                         #    plane; no envelope change)
+                        # 5: manifest pull protocol + Envelope `raw`
+                        #    bulk-payload field (r12 zero-copy object
+                        #    transfer) + partial-holder OBJECT_ADDED
 WIRE_VERSION = WIRE_MAJOR * 100 + WIRE_MINOR
 
 # First MINOR that understands a type=="batch" Envelope carrying a
@@ -85,6 +88,25 @@ DELEGATE_MIN_MINOR = 3
 # and the collector's shared deadline would burn waiting on a reply
 # that can never come, so the head only fans to proven peers.
 METRICS_MIN_MINOR = 4
+
+# First MINOR that understands the r12 manifest pull protocol: the
+# Envelope `raw` bulk-payload field and partial-holder OBJECT_ADDED
+# entries. The transfer itself negotiates per message (the puller asks
+# for a manifest; an old holder ignores the unknown request key and
+# serves the blob protocol — the reply shape IS the answer), so this
+# constant only gates the one message an OLD receiver would
+# misinterpret rather than ignore: an agent reports partial-holder
+# registrations to the head only when the head demonstrated MINOR >= 5
+# (an old head would record a full location for a half-landed copy).
+MANIFEST_MIN_MINOR = 5
+
+# Message-dict carrier for the Envelope `raw` field. On encode the
+# value is a LIST of buffer objects (bytes/memoryview — mapped shm
+# spans) concatenated into the field by the scatter-gather emit with
+# zero copies; on decode the receiver sees ONE zero-copy memoryview of
+# the whole field (C parser; the protobuf fallback hands over bytes).
+# Never pickled into py_body.
+RAW_KEY = "_raw"
 
 # Message-dict carrier for the Envelope trace fields: senders attach
 # msg["_trace"] = (trace_id, parent_span); codecs move it between the
@@ -216,6 +238,11 @@ def _fill_envelope(env: "pb.Envelope", msg: dict) -> None:
     if tr is not None:
         env.trace_id = tr[0]
         env.parent_span = tr[1]
+    raw = msg.get(RAW_KEY)
+    if raw is not None:
+        # fallback codec: the field is joined (one copy); the scatter-
+        # gather emit path (encode_frame_parts) is the zero-copy one
+        env.raw = b"".join(raw)
     if mtype in STRUCTURAL_TYPES:
         fields = env.fields
         fields.SetInParent()
@@ -225,7 +252,8 @@ def _fill_envelope(env: "pb.Envelope", msg: dict) -> None:
             _encode_value(val, fields.fields[k], 0)
     else:
         rest = {k: v for k, v in msg.items()
-                if k != "type" and k != "rid" and k != TRACE_KEY}
+                if k != "type" and k != "rid" and k != TRACE_KEY
+                and k != RAW_KEY}
         if rest:
             env.py_body = _pickle(rest)
 
@@ -295,6 +323,14 @@ def _trace_tail(tr) -> bytes:
     return out
 
 
+def _raw_prefix(raw) -> bytes:
+    """Key + length varint for the Envelope `raw` field (field 9,
+    length-delimited, tag 0x4a) — the field's payload buffers follow
+    as their own iovecs on the scatter-gather emit. Canonical position:
+    after py_body (5) and the trace fixed64s (7/8)."""
+    return b"\x4a" + _pb_varint(sum(len(b) for b in raw))
+
+
 def _encode_one(msg: dict, eng=None) -> bytes:
     """Serialize ONE message to Envelope bytes (never a batch)."""
     mtype = msg.get("type", "")
@@ -302,12 +338,18 @@ def _encode_one(msg: dict, eng=None) -> bytes:
         eng = _native_codec()
     if eng is not None and mtype not in STRUCTURAL_TYPES:
         rest = {k: v for k, v in msg.items()
-                if k != "type" and k != "rid" and k != TRACE_KEY}
+                if k != "type" and k != "rid" and k != TRACE_KEY
+                and k != RAW_KEY}
         body = _pickle(rest) if rest else b""
         data = eng.env_encode(WIRE_VERSION, mtype.encode(),
                               msg.get("rid", 0), body)
         tr = msg.get(TRACE_KEY)
-        return data + _trace_tail(tr) if tr is not None else data
+        if tr is not None:
+            data += _trace_tail(tr)
+        raw = msg.get(RAW_KEY)
+        if raw is not None:
+            data += _raw_prefix(raw) + b"".join(raw)
+        return data
     env = pb.Envelope()
     _fill_envelope(env, msg)
     return env.SerializeToString()
@@ -354,9 +396,12 @@ def encode_frame_parts(msg: dict, eng=None) -> list[bytes]:
     emit (protocol._emit_locked -> sendmsg): [C-encoded header, pickled
     body] when the C codec is selected or the body clears the
     zero-copy threshold — the body bytes then go from the pickler to
-    the kernel without ever being copied into a joined frame.
-    Structural/batch/other frames collapse to [dumps(msg)]. The
-    buffer-list concatenation is byte-identical to dumps(msg)."""
+    the kernel without ever being copied into a joined frame. A
+    RAW_KEY message additionally carries its buffer list as trailing
+    iovecs (the Envelope `raw` field): mapped shm spans go
+    mapping -> kernel with zero Python copies. Structural/batch/other
+    frames collapse to [dumps(msg)]. The buffer-list concatenation is
+    byte-identical to dumps(msg)."""
     if eng is None:
         eng = _native_codec()
     mtype = msg.get("type", "")
@@ -364,29 +409,43 @@ def encode_frame_parts(msg: dict, eng=None) -> list[bytes]:
         return [dumps(msg)]
     tr = msg.get(TRACE_KEY)
     tail = _trace_tail(tr) if tr is not None else b""
+    raw = msg.get(RAW_KEY)
+    raw_len = sum(len(b) for b in raw) if raw is not None else 0
     rest = {k: v for k, v in msg.items()
-            if k != "type" and k != "rid" and k != TRACE_KEY}
-    if not rest:
+            if k != "type" and k != "rid" and k != TRACE_KEY
+            and k != RAW_KEY}
+    if not rest and raw is None:
         return [dumps(msg)] if eng is None else [
             eng.env_encode_header(WIRE_VERSION, mtype.encode(),
                                   msg.get("rid", 0), 0, 0) + tail]
-    body = _pickle(rest)
+    body = _pickle(rest) if rest else b""
     zero_copy = (eng is not None
-                 or (len(body) >= _ZEROCOPY_MIN_BODY
+                 or ((len(body) >= _ZEROCOPY_MIN_BODY
+                      or raw_len >= _ZEROCOPY_MIN_BODY)
                      and _native.frame_engine_enabled()))
     if not zero_copy:
         env = pb.Envelope()                   # protobuf codec, body
         env.version = WIRE_VERSION            # already pickled above
         env.type = mtype
         env.rid = msg.get("rid", 0)
-        env.py_body = body
+        if body:
+            env.py_body = body
         if tr is not None:
             env.trace_id = tr[0]
             env.parent_span = tr[1]
+        if raw is not None:
+            env.raw = b"".join(raw)
         return [env.SerializeToString()]
     hdr = _native.env_encode_header(WIRE_VERSION, mtype.encode(),
-                                    msg.get("rid", 0), 0x2A, len(body))
-    return [hdr, body, tail] if tail else [hdr, body]
+                                    msg.get("rid", 0),
+                                    0x2A if body else 0, len(body))
+    parts = [hdr, body] if body else [hdr]
+    if tail:
+        parts.append(tail)
+    if raw is not None:
+        parts.append(_raw_prefix(raw))
+        parts.extend(raw)
+    return parts
 
 
 def encode_batch_parts(msgs: list[dict], eng=None) -> list[bytes]:
@@ -424,6 +483,8 @@ def _decode_envelope(env: "pb.Envelope") -> dict:
         msg["rid"] = env.rid
     if env.trace_id or env.parent_span:
         msg[TRACE_KEY] = (env.trace_id, env.parent_span)
+    if env.raw:
+        msg[RAW_KEY] = env.raw
     return msg
 
 
@@ -435,7 +496,8 @@ def _native_decode_one(eng, data: bytes) -> Optional[dict]:
     view = eng.env_decode(data)
     if view is None:
         return None
-    _, rid, tbytes, body, fields_len, _, _, trace_id, parent_span = view
+    (_, rid, tbytes, body, fields_len, _, _, trace_id, parent_span,
+     raw) = view
     if body:
         msg = pickle.loads(body)
     elif fields_len > 0:
@@ -450,6 +512,8 @@ def _native_decode_one(eng, data: bytes) -> Optional[dict]:
         msg["rid"] = rid
     if trace_id or parent_span:
         msg[TRACE_KEY] = (trace_id, parent_span)
+    if raw is not None:
+        msg[RAW_KEY] = raw
     return msg
 
 
@@ -459,7 +523,7 @@ def _native_loads_ex(eng, data: bytes) -> Optional[tuple[dict, int]]:
     if view is None:
         return None
     (version, rid, tbytes, body, fields_len, batch_off, batch_len,
-     trace_id, parent_span) = view
+     trace_id, parent_span, raw) = view
     if version // 100 != WIRE_MAJOR:
         raise WireVersionError(
             f"peer wire version {version} is incompatible with "
@@ -495,6 +559,8 @@ def _native_loads_ex(eng, data: bytes) -> Optional[tuple[dict, int]]:
         msg["rid"] = rid
     if trace_id or parent_span:
         msg[TRACE_KEY] = (trace_id, parent_span)
+    if raw is not None:
+        msg[RAW_KEY] = raw
     return msg, version
 
 

@@ -87,7 +87,9 @@ class _EnvView(ctypes.Structure):
                 ("batch_off", ctypes.c_int64),
                 ("batch_len", ctypes.c_int64),
                 ("trace_id", ctypes.c_uint64),
-                ("parent_span", ctypes.c_uint64)]
+                ("parent_span", ctypes.c_uint64),
+                ("raw_off", ctypes.c_int64),
+                ("raw_len", ctypes.c_int64)]
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -116,6 +118,9 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.rtpu_masked_crc32c.argtypes = [ctypes.c_char_p,
                                            ctypes.c_size_t]
         lib.rtpu_masked_crc32c.restype = ctypes.c_uint32
+        lib.rtpu_memcpy.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_size_t]
+        lib.rtpu_memcpy.restype = None
         # ---- frame engine ----
         lib.rtpu_reader_new.argtypes = [ctypes.c_uint64]
         lib.rtpu_reader_new.restype = ctypes.c_void_p
@@ -213,6 +218,23 @@ def wait_u64s_ge(mv: memoryview, offset: int, count: int, value: int,
     base = ctypes.addressof(ctypes.c_char.from_buffer(mv, offset))
     t_ns = -1 if timeout_s is None else max(0, int(timeout_s * 1e9))
     return lib.rtpu_wait_u64s_ge(base, count, value, t_ns) == 0
+
+
+def buf_copy(dst, dst_off: int, src) -> int:
+    """memcpy `src` (any contiguous buffer, read-only OK) into the
+    WRITABLE buffer `dst` at `dst_off`, GIL released for the whole
+    copy (r12 land path: multi-MB chunk bodies go wire-view -> mapped
+    shm without stalling the runtime's other threads). Caller
+    guarantees both buffers outlive the call; returns bytes copied."""
+    lib = _load()
+    assert lib is not None, "call native.available() first"
+    import numpy as _np
+    s = _np.frombuffer(src, dtype=_np.uint8)
+    n = s.nbytes
+    if n:
+        base = ctypes.addressof(ctypes.c_char.from_buffer(dst, dst_off))
+        lib.rtpu_memcpy(base, s.ctypes.data, n)
+    return n
 
 
 def crc32c(data: bytes) -> int:
@@ -369,25 +391,28 @@ def env_encode_header(version: int, mtype: bytes, rid: int,
 def env_decode(data: bytes):
     """Parse the top-level Envelope fields of `data`. Returns
     ``(version, rid, type_bytes, body_bytes|None, fields_len,
-    batch_off, batch_len, trace_id, parent_span)`` with fields_len =
-    -1 / batch_off = -1 when absent and trace ids 0 when unset, or
-    None when the fast parser can't handle the input (the caller
-    falls back to the real protobuf codec)."""
+    batch_off, batch_len, trace_id, parent_span, raw|None)`` with
+    fields_len = -1 / batch_off = -1 when absent and trace ids 0 when
+    unset, or None when the fast parser can't handle the input (the
+    caller falls back to the real protobuf codec)."""
     lib = _load()
     view = _EnvView()
     if lib.rtpu_env_decode(data, len(data), ctypes.byref(view)) != 0:
         return None
     mtype = (data[view.type_off:view.type_off + view.type_len]
              if view.type_off >= 0 else b"")
-    # body as a zero-copy view: callers hand it straight to
-    # pickle.loads, and a bytes slice would copy multi-MB pull chunks
-    # a second time on every frame
+    # body (and the r12 raw bulk payload) as zero-copy views: callers
+    # hand them straight to pickle.loads / the shm land path, and a
+    # bytes slice would copy multi-MB pull chunks a second time on
+    # every frame
     body = (memoryview(data)[view.body_off:view.body_off + view.body_len]
             if view.body_off >= 0 else None)
+    raw = (memoryview(data)[view.raw_off:view.raw_off + view.raw_len]
+           if view.raw_off >= 0 else None)
     return (view.version, view.rid, mtype, body,
             view.fields_len if view.fields_off >= 0 else -1,
             view.batch_off, view.batch_len,
-            view.trace_id, view.parent_span)
+            view.trace_id, view.parent_span, raw)
 
 
 def batch_split(data: bytes, off: int, length: int):

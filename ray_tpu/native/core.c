@@ -157,6 +157,16 @@ uint32_t rtpu_masked_crc32c(const uint8_t *buf, size_t len) {
     return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
 }
 
+/* GIL-released bulk copy (r12 object-plane land path): Python's
+ * mv[a:b] = src holds the GIL for the whole memcpy, which at multi-MB
+ * chunk sizes starves every other runtime thread (reader pumps,
+ * schedulers) for milliseconds per chunk. Called through ctypes the
+ * copy runs with the GIL released; the caller guarantees both buffers
+ * outlive the call and the ranges do not overlap. */
+void rtpu_memcpy(uint8_t *dst, const uint8_t *src, size_t n) {
+    memcpy(dst, src, n);
+}
+
 /* ================== frame engine: socket read pump ==================
  *
  * Wire framing (protocol.py): every frame is an 8-byte little-endian
@@ -435,6 +445,7 @@ typedef struct {
     int64_t fields_off, fields_len;
     int64_t batch_off, batch_len;
     uint64_t trace_id, parent_span;     /* tracing plane; 0 = unset */
+    int64_t raw_off, raw_len;           /* raw bulk payload (MINOR 5) */
 } rtpu_env_view;
 
 static int pb_varint(const uint8_t *b, uint64_t len, uint64_t *pos,
@@ -484,6 +495,7 @@ static int pb_skip(const uint8_t *b, uint64_t len, uint64_t *pos,
 int rtpu_env_decode(const uint8_t *buf, uint64_t len, rtpu_env_view *v) {
     memset(v, 0, sizeof *v);
     v->type_off = v->body_off = v->fields_off = v->batch_off = -1;
+    v->raw_off = -1;
     uint64_t pos = 0;
     while (pos < len) {
         uint64_t tag, n;
@@ -509,8 +521,8 @@ int rtpu_env_decode(const uint8_t *buf, uint64_t len, rtpu_env_view *v) {
                 v->trace_id = x;
             else
                 v->parent_span = x;
-        } else if ((fno == 2 || fno == 4 || fno == 5 || fno == 6)
-                   && wt == 2) {
+        } else if ((fno == 2 || fno == 4 || fno == 5 || fno == 6
+                    || fno == 9) && wt == 2) {
             if (pb_varint(buf, len, &pos, &n) || len - pos < n)
                 return -1;
             int64_t *off, *fl;
@@ -518,6 +530,7 @@ int rtpu_env_decode(const uint8_t *buf, uint64_t len, rtpu_env_view *v) {
             case 2:  off = &v->type_off;   fl = &v->type_len;   break;
             case 4:  off = &v->fields_off; fl = &v->fields_len; break;
             case 5:  off = &v->body_off;   fl = &v->body_len;   break;
+            case 9:  off = &v->raw_off;    fl = &v->raw_len;    break;
             default: off = &v->batch_off;  fl = &v->batch_len;  break;
             }
             /* duplicate submessage/scalar-bytes fields: protobuf

@@ -254,10 +254,38 @@ def test_env_decode_views():
                       py_body=b"PAYLOAD")
     view = native.env_decode(env.SerializeToString())
     (version, rid, mtype, body, fields_len, batch_off, batch_len,
-     trace_id, parent_span) = view
+     trace_id, parent_span, raw) = view
     assert (version, rid, mtype, body) == (101, 9, b"task", b"PAYLOAD")
     assert fields_len == -1 and batch_off == -1
     assert trace_id == 0 and parent_span == 0
+    assert raw is None
+
+
+@pytestmark_native
+def test_env_decode_raw_field():
+    """r12 zero-copy object plane: the C parser hands the Envelope
+    `raw` bulk payload back as a zero-copy view, byte-compatibly with
+    protobuf's encoding, alongside py_body and the trace fields."""
+    import pickle
+    body = pickle.dumps({"ok": 1})
+    env = pb.Envelope(version=105, type="reply", rid=4,
+                      py_body=body, trace_id=7, raw=b"RAWPAYLOAD")
+    data = env.SerializeToString()
+    view = native.env_decode(data)
+    assert view is not None
+    raw = view[9]
+    assert isinstance(raw, memoryview) and bytes(raw) == b"RAWPAYLOAD"
+    assert bytes(view[3]) == body and view[7] == 7
+    # the wire codec surfaces it under RAW_KEY on every decode path
+    msg, ver = wire.loads_ex(data)
+    assert bytes(msg[wire.RAW_KEY]) == b"RAWPAYLOAD"
+    # and the scatter-gather emit is byte-identical to protobuf
+    parts = wire.encode_frame_parts(
+        {"type": "reply", "rid": 4, "_trace": (7, 0),
+         wire.RAW_KEY: [b"RAW", memoryview(b"PAYLOAD")]})
+    env2 = pb.Envelope(version=wire.WIRE_VERSION, type="reply", rid=4,
+                       trace_id=7, raw=b"RAWPAYLOAD")
+    assert b"".join(parts) == env2.SerializeToString()
 
 
 @pytestmark_native

@@ -104,7 +104,7 @@ def test_build_tree_shape():
 def test_directory_consistency():
     d = ObjectDirectory()
     added = []
-    d.add_listener(lambda oid, nid: added.append((oid, nid)))
+    d.add_listener(lambda oid, nid, partial: added.append((oid, nid)))
     assert d.add("o1", "nA", nbytes=100)
     assert not d.add("o1", "nA")            # re-add: no growth, no event
     d.add("o1", "nB")

@@ -8,11 +8,15 @@ it two ways:
 
 1. C harness (tools/native_sanity_check.c, compiled together with
    core.c): reader pump against a forked dribbling writer, oversized
-   rejection, writev past IOV_MAX, envelope/batch codec roundtrips —
-   buffer-math bugs abort with a sanitizer report instead of shipping.
+   rejection, writev past IOV_MAX (incl. a 4 MB chunk-body iovec, the
+   r12 manifest serve shape), the r12 GIL-released bulk copy, raw-
+   field envelope decode, envelope/batch codec roundtrips — buffer-
+   math bugs abort with a sanitizer report instead of shipping.
 2. Best effort: the native pytest subset (tests/test_native.py,
-   tests/test_native_frame.py, tests/test_wire.py) against a sanitized
-   .so, via ``RAY_TPU_NATIVE_CFLAGS`` + a scratch ``RAY_TPU_NATIVE_DIR``
+   tests/test_native_frame.py, tests/test_wire.py,
+   tests/test_object_manifest.py — the last drives the r12 zero-copy
+   serve/land/cut-through paths end to end) against a sanitized .so,
+   via ``RAY_TPU_NATIVE_CFLAGS`` + a scratch ``RAY_TPU_NATIVE_DIR``
    and LD_PRELOADed libasan. Skipped (cleanly) when libasan can't be
    preloaded under this Python.
 
@@ -109,7 +113,7 @@ def run_pytest_subset(tmp: str) -> bool | None:
     r = subprocess.run(
         [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
          "tests/test_native.py", "tests/test_native_frame.py",
-         "tests/test_wire.py"],
+         "tests/test_wire.py", "tests/test_object_manifest.py"],
         timeout=1200, env=env, cwd=REPO)
     if r.returncode != 0:
         print("FAIL: native test subset under sanitizers")

@@ -38,7 +38,9 @@ typedef struct {
     uint64_t trace_id, parent_span;   /* r9: MUST match core.c's
                                          definition — decode memsets
                                          and writes sizeof(view) */
+    int64_t raw_off, raw_len;         /* r12 raw bulk payload */
 } rtpu_env_view;
+void rtpu_memcpy(uint8_t *dst, const uint8_t *src, size_t n);
 int rtpu_env_decode(const uint8_t *buf, uint64_t len, rtpu_env_view *v);
 long rtpu_batch_split(const uint8_t *buf, uint64_t len,
                       uint64_t *offs, uint64_t *lens, long max);
@@ -131,16 +133,37 @@ static void check_reader(void) {
     fprintf(stderr, "reader ok\n");
 }
 
+static void check_bulk_copy(void) {
+    /* r12 land-path memcpy (the ctypes bulk_copy backend): byte
+     * fidelity at offset, zero-length no-op, multi-MB chunk size */
+    size_t n = 4 << 20;
+    uint8_t *src = malloc(n), *dst = malloc(n + 64);
+    for (size_t i = 0; i < n; i++)
+        src[i] = (uint8_t)(i * 2654435761u >> 24);
+    memset(dst, 0xEE, n + 64);
+    rtpu_memcpy(dst + 64, src, n);
+    assert(memcmp(dst + 64, src, n) == 0);
+    for (int i = 0; i < 64; i++)
+        assert(dst[i] == 0xEE);                /* prefix untouched */
+    rtpu_memcpy(dst, src, 0);                  /* zero-length no-op */
+    assert(dst[0] == 0xEE);
+    free(src);
+    free(dst);
+    fprintf(stderr, "bulk_copy ok\n");
+}
+
 static void check_writev(void) {
     int sv[2];
     assert(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
-    /* 3000 iovecs (past the 1024 chunk) totalling ~3 MB, drained by a
-     * forked reader so partial writes happen */
+    /* 3000 iovecs (past the 1024 chunk) totalling ~3 MB — the first a
+     * 4 MB chunk-body-sized span (the r12 manifest serve shape:
+     * [len, header, raw-prefix, mapped-shm views] in one sendmsg) —
+     * drained by a forked reader so partial writes happen */
     long cnt = 3000;
     struct iovec *iov = calloc(cnt, sizeof *iov);
     size_t total = 0;
     for (long i = 0; i < cnt; i++) {
-        size_t n = (size_t)(i % 2048) + 1;
+        size_t n = i == 0 ? (size_t)4 << 20 : (size_t)(i % 2048) + 1;
         iov[i].iov_base = malloc(n);
         memset(iov[i].iov_base, (int)(i & 0xff), n);
         iov[i].iov_len = n;
@@ -211,6 +234,25 @@ static void check_codec(void) {
     assert(rtpu_env_decode(trunc, 2, &v) == -1);
     const uint8_t shortlen[] = {0x2a, 0x20, 'x'};
     assert(rtpu_env_decode(shortlen, 3, &v) == -1);
+
+    /* r12 raw bulk payload (field 9, tag 0x4a): appended after the
+     * body like the zero-copy emit path does; decode must hand back
+     * an in-place view, reject a short field, and punt duplicates to
+     * the real parser (protobuf merge semantics) instead of silently
+     * keeping one */
+    uint8_t rawf[4120];
+    memcpy(rawf, out, (size_t)n);
+    const uint8_t raw_tail[] = {0x4a, 0x04, 0xde, 0xad, 0xbe, 0xef};
+    memcpy(rawf + n, raw_tail, sizeof raw_tail);
+    assert(rtpu_env_decode(rawf, (uint64_t)n + sizeof raw_tail,
+                           &v) == 0);
+    assert(v.raw_len == 4
+           && memcmp(rawf + v.raw_off, "\xde\xad\xbe\xef", 4) == 0);
+    assert(v.body_len == 9);                 /* body untouched */
+    assert(rtpu_env_decode(rawf, (uint64_t)n + 3, &v) == -1);
+    memcpy(rawf + n + sizeof raw_tail, raw_tail, sizeof raw_tail);
+    assert(rtpu_env_decode(rawf, (uint64_t)n + 2 * sizeof raw_tail,
+                           &v) == -1);
 
     /* batch encode -> split roundtrip, past a small first-pass cap */
     enum { NSUB = 300 };
@@ -296,6 +338,7 @@ static void check_poller(void) {
 
 int main(void) {
     check_codec();
+    check_bulk_copy();
     check_reader();
     check_writev();
     check_poller();

@@ -46,6 +46,8 @@ LABELS = [
     ("put_small_per_s", "put (small objects)"),
     ("put_gbps", "put throughput (8 MB)"),
     ("get_gbps", "get throughput (8 MB)"),
+    ("pull_64mb_blob", "64 MB pull, blob protocol (MINOR<5 peer)"),
+    ("pull_64mb_manifest", "64 MB pull, manifest zero-copy"),
     ("bcast_64mb_flat",
      "broadcast 64 MB x 8 nodes, all-pull-from-source"),
     ("bcast_64mb_tree", "broadcast 64 MB x 8 nodes, fanout tree"),
@@ -85,12 +87,24 @@ def _fmt_result(rec: dict) -> str:
                     f"depth {rec.get('depth', '?')})")
         if "tree_speedup" in rec:
             out += f" (tree speedup {rec['tree_speedup']}x)"
+        if "manifest_speedup" in rec:
+            out += f" (manifest speedup {rec['manifest_speedup']}x)"
+        ab = rec.get("ab")
+        if ab and "order_medians" in ab:
+            # r12 order-bias control: the arm's median when it ran
+            # first vs second in its alternating A/B pair
+            om = ab["order_medians"]
+            if "first" in om and "second" in om:
+                out += (f" [ran-1st/2nd medians "
+                        f"{om['first']}/{om['second']}]")
         return out
     extras = {k: v for k, v in rec.items()
               if k not in ("n", "unit", "frames_per_task",
                            "head_cpu_us_per_task",
                            "trace_overhead_pct",
-                           "metrics_overhead_pct")}
+                           "metrics_overhead_pct", "ab",
+                           "serve_copies_per_byte",
+                           "land_copies_per_byte")}
     return ", ".join(f"{k}={v}" for k, v in extras.items())
 
 
@@ -123,6 +137,18 @@ def _fmt_metrics(rec: dict) -> str:
     return "—"
 
 
+def _fmt_copies(rec: dict) -> str:
+    """The r12 copy-budget column: user-space bytes copied per byte
+    transferred, serve side · land side, straight from the transfer
+    code's own OBJECT_PLANE_STATS accounting (manifest target: 0 · 1;
+    the blob land figure is a lower bound — the decode re-pickle is
+    not counted)."""
+    if "serve_copies_per_byte" in rec:
+        return (f"{rec['serve_copies_per_byte']} · "
+                f"{rec['land_copies_per_byte']}")
+    return "—"
+
+
 def render_block(results: dict) -> str:
     known = [k for k, _ in LABELS]
     rows = [(label, results[key]) for key, label in LABELS
@@ -133,12 +159,13 @@ def render_block(results: dict) -> str:
              "### Latest `bench_core.py` run (machine-generated)",
              "",
              "| Scenario | Result | frames/task · head-CPU/task "
-             "| trace overhead | metrics overhead |",
-             "|---|---|---|---|---|"]
+             "| trace overhead | metrics overhead "
+             "| copies/byte serve · land |",
+             "|---|---|---|---|---|---|"]
     for label, rec in rows:
         lines.append(f"| {label} | {_fmt_result(rec)} | "
                      f"{_fmt_frames(rec)} | {_fmt_trace(rec)} | "
-                     f"{_fmt_metrics(rec)} |")
+                     f"{_fmt_metrics(rec)} | {_fmt_copies(rec)} |")
     lines.append(END)
     return "\n".join(lines)
 

@@ -386,8 +386,119 @@ def _pull_bench(mb: int = 64) -> dict:
     return out
 
 
+def _pipeline_stage_fn(p, h):
+    import jax
+
+    def layer(h, wb):
+        w, b = wb
+        import jax.numpy as jnp
+        return jnp.tanh(h @ w + b), None
+    h, _ = jax.lax.scan(layer, h, (p["w"], p["b"]))
+    return h
+
+
+def _pipeline_loss_fn(y, t):
+    import jax.numpy as jnp
+    return jnp.sum((y - t) ** 2)
+
+
+def _pipeline_bench() -> dict:
+    """MPMD pipeline A/Bs (r13): transfer/compute overlap (ring depth
+    2) vs single-slot channels (depth 1), and the 1F1B schedule vs the
+    GPipe fallback — 4 stage-actor processes over shm channels, one
+    shared runtime, stage actors (and their jit caches) reused across
+    arms so each timed run measures the schedule, not process spawns.
+    Bubble fraction comes from the r9 tracing plane, windowed to the
+    timed steps."""
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu._private import context as _pctx
+    from ray_tpu._private import tracing_plane as _tp
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.parallel.pipeline import partition_layers, slice_stage
+    from ray_tpu.train.pipeline import MPMDPipeline, bubble_fraction
+    CONFIG.reload()
+    ray_tpu.init(num_cpus=6)
+    S, L, D, B, M, STEPS = 4, 8, 256, 32, 8, 4
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(L, D, D)) * 0.2,
+                               jnp.float32),
+              "b": jnp.zeros((L, D), jnp.float32)}
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    T = rng.normal(size=(B, D)).astype(np.float32)
+
+    @ray_tpu.remote
+    class StageWorker:
+        pass
+
+    actors = [StageWorker.remote() for _ in range(S)]
+    parts = partition_layers(L, S)
+    sparams = [slice_stage(params, s, c) for s, c in parts]
+
+    def run(schedule: str, depth: int):
+        def _run() -> dict:
+            pipe = MPMDPipeline(
+                actors, sparams, stage_fn=_pipeline_stage_fn,
+                loss_fn=_pipeline_loss_fn, num_microbatches=M,
+                schedule=schedule, steps=STEPS + 1, transport="shm",
+                ring_depth=depth, capacity=16 << 20)
+            pipe.start()
+            try:
+                pipe.run_step(0, X, T)          # warm the stage jits
+                w0 = _tp.now()
+                t0 = time.perf_counter()
+                for s_ in range(STEPS):
+                    pipe.run_step(1 + s_, X, T)
+                dt = time.perf_counter() - t0
+                w1 = _tp.now()
+                bf = None
+                try:
+                    dump = _pctx.get_ctx().state_op("trace_dump")
+                    bf = bubble_fraction(dump.get("processes", []),
+                                         window=(w0, w1))
+                except Exception:
+                    bf = None
+                pipe.finish(timeout=120)
+            finally:
+                pipe.teardown()
+            n_mb = STEPS * M
+            rec = {"n": n_mb, "seconds": round(dt, 4),
+                   "per_second": round(n_mb / dt, 1),
+                   "unit": "microbatches"}
+            if bf is not None and bf == bf:
+                rec["bubble_fraction"] = bf
+            return rec
+        return _run
+
+    results: dict = {}
+    run("1f1b", 2)()                 # global warmup: actor jax imports
+    off, on = _ab_pair(results, "pipeline_1f1b_depth1", run("1f1b", 1),
+                       "pipeline_1f1b_overlap", run("1f1b", 2))
+    if off["per_second"]:
+        on["overlap_speedup"] = round(
+            on["per_second"] / off["per_second"], 2)
+    gp, fb = _ab_pair(results, "pipeline_gpipe", run("gpipe", 2),
+                      "pipeline_1f1b", run("1f1b", 2))
+    if gp["per_second"]:
+        fb["schedule_speedup"] = round(
+            fb["per_second"] / gp["per_second"], 2)
+    for a in actors:
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
+    ray_tpu.shutdown()
+    return results
+
+
 def main(as_json: bool = False) -> dict:
     results: dict = {}
+
+    # ------- MPMD pipeline: overlap + schedule A/Bs (r13). First so
+    # its 4 stage actors' flight recorders aren't polluted by other
+    # scenarios' spans (bubble fraction is window-filtered anyway).
+    results.update(_pipeline_bench())
 
     # ----------------------- wire codec: native vs pure Python (r7)
     results.update(_codec_bench())

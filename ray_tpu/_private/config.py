@@ -333,6 +333,20 @@ _define("autoscale_queue_latency_cooldown_s", 30.0,
         "Minimum seconds between latency-driven scale-ups: the p95 "
         "stays high until new capacity drains the queue, so without a "
         "cooldown the signal would launch a node per update tick.")
+_define("channel_ring_depth", 2,
+        "Compiled-DAG channel ring slots (r13): how many published-"
+        "but-unconsumed messages a channel buffers before the writer "
+        "blocks. 1 restores the single-slot r5 behavior (the writer "
+        "waits for every reader before each publish — no transfer/"
+        "compute overlap); 2 double-buffers, which is what lets an "
+        "MPMD pipeline stage compute microbatch m+1 while m is still "
+        "in flight to its neighbor. Applies to both the shm and wire "
+        "channel transports.")
+_define("channel_wire_attach_timeout_s", 30.0,
+        "How long a wire-channel reader waits for its attach "
+        "handshake with the writer-side channel server before the "
+        "endpoint raises (the writer's exec loop may still be "
+        "starting).")
 _define("scheduler_locality", True,
         "Locality-aware node selection: prefer placing a task on a "
         "feasible node already holding the most argument bytes "

@@ -32,12 +32,12 @@ from typing import Any, Callable, Optional
 
 from ray_tpu import native
 from ray_tpu._private.wire import (BATCH_MIN_MINOR, BATCH_TYPE,
-                                   DELEGATE_MIN_MINOR, MANIFEST_MIN_MINOR,
-                                   METRICS_MIN_MINOR, RAW_KEY, TRACE_KEY,
-                                   TRACE_MIN_MINOR, WIRE_MAJOR,
-                                   WireVersionError, dumps, dumps_batch,
-                                   encode_batch_parts, encode_frame_parts,
-                                   loads_ex)
+                                   CHANNEL_MIN_MINOR, DELEGATE_MIN_MINOR,
+                                   MANIFEST_MIN_MINOR, METRICS_MIN_MINOR,
+                                   RAW_KEY, TRACE_KEY, TRACE_MIN_MINOR,
+                                   WIRE_MAJOR, WireVersionError, dumps,
+                                   dumps_batch, encode_batch_parts,
+                                   encode_frame_parts, loads_ex)
 
 _LEN = struct.Struct("<Q")
 
@@ -421,6 +421,16 @@ class Connection:
         would misread as full locations. Unknown (0) counts as NO."""
         v = self.peer_wire_version
         return v // 100 == WIRE_MAJOR and v % 100 >= MANIFEST_MIN_MINOR
+
+    def peer_speaks_channel(self) -> bool:
+        """Whether the peer's wire-channel endpoint lands Envelope
+        `raw` CH_DATA payloads (MINOR >= 6). Unknown (0) counts as NO:
+        an older endpoint would decode the frame but miss the raw
+        field's tensor, so the writer ships the pickled-body fallback
+        until the peer's attach frame demonstrates the MINOR (r13
+        wire-channel transport, experimental/wire_channel.py)."""
+        v = self.peer_wire_version
+        return v // 100 == WIRE_MAJOR and v % 100 >= CHANNEL_MIN_MINOR
 
     def _peer_speaks_trace(self) -> bool:
         """Whether trace context may ride this connection's envelopes.

@@ -50,7 +50,7 @@ from ray_tpu import native as _native
 from ray_tpu._private import wire_pb2 as pb
 
 WIRE_MAJOR = 1
-WIRE_MINOR = 5          # 1: BatchFrame coalescing (negotiated by peers)
+WIRE_MINOR = 6          # 1: BatchFrame coalescing (negotiated by peers)
                         # 2: Envelope trace_id/parent_span (tracing
                         #    plane; old peers skip unknown fields)
                         # 3: delegated scheduling ops (NODE_LEASE_BATCH
@@ -61,6 +61,9 @@ WIRE_MINOR = 5          # 1: BatchFrame coalescing (negotiated by peers)
                         # 5: manifest pull protocol + Envelope `raw`
                         #    bulk-payload field (r12 zero-copy object
                         #    transfer) + partial-holder OBJECT_ADDED
+                        # 6: wire-channel ops (ch_attach/data/ack/
+                        #    close) for compiled-DAG channels (r13; no
+                        #    envelope change — CH_DATA reuses `raw`)
 WIRE_VERSION = WIRE_MAJOR * 100 + WIRE_MINOR
 
 # First MINOR that understands a type=="batch" Envelope carrying a
@@ -99,6 +102,17 @@ METRICS_MIN_MINOR = 4
 # registrations to the head only when the head demonstrated MINOR >= 5
 # (an old head would record a full location for a half-landed copy).
 MANIFEST_MIN_MINOR = 5
+
+# First MINOR whose handlers speak the r13 wire-channel transport
+# (experimental/wire_channel.py: CH_ATTACH/CH_DATA/CH_ACK/CH_CLOSE).
+# The endpoints are new code on both sides by construction (a reader
+# dials the writer's per-channel listener), so the constant gates the
+# one thing an OLD peer could misread rather than ignore: a CH_DATA
+# frame whose tensor rides the Envelope `raw` field. The writer emits
+# raw-payload frames only toward a peer that demonstrated MINOR >= 6
+# on its attach frame and falls back to the pickled body otherwise —
+# negotiated by observation, the BatchFrame discipline.
+CHANNEL_MIN_MINOR = 6
 
 # Message-dict carrier for the Envelope `raw` field. On encode the
 # value is a LIST of buffer objects (bytes/memoryview — mapped shm

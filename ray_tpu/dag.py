@@ -36,18 +36,30 @@ class DAGNode:
         self.upstream = upstream
 
     def experimental_compile(self, *, enable_shm_channels: bool = False,
-                             buffer_size_bytes: int = 1 << 20):
+                             buffer_size_bytes: int = 1 << 20,
+                             channel_transport: str = "shm",
+                             channel_ring_depth: "Optional[int]" = None):
         """Compile the graph. With enable_shm_channels=True the DAG runs
-        on mutable shared-memory channels: each actor gets a persistent
-        exec loop reading its inputs from fixed shm slots and writing
-        its output to one — per-execute cost drops to one channel write
-        + one read on the driver, zero task submissions (reference
-        CompiledDAG + shared_memory_channel.py). Channel mode requires
-        all actors on the driver's host and dedicates each actor to the
-        DAG until teardown()."""
+        on mutable channels: each actor gets a persistent exec loop
+        reading its inputs from fixed ring slots and writing its output
+        to one — per-execute cost drops to one channel write + one read
+        on the driver, zero task submissions (reference CompiledDAG +
+        shared_memory_channel.py). Channel mode dedicates each actor to
+        the DAG until teardown().
+
+        channel_transport picks the edge transport (r13): "shm"
+        (default; mapped-shm rings, all endpoints on the driver's
+        host), "wire" (direct writer->reader connections carrying
+        tensors over the Envelope raw zero-copy path — works across
+        hosts), or "auto" (wire only for edges whose endpoints report
+        different host IPs). channel_ring_depth overrides
+        RAY_TPU_CHANNEL_RING_DEPTH (slots buffered per channel; >= 2
+        enables transfer/compute overlap)."""
         if enable_shm_channels:
             from ray_tpu.experimental.dag_channels import ChannelCompiledDAG
-            return ChannelCompiledDAG(self, buffer_size_bytes)
+            return ChannelCompiledDAG(self, buffer_size_bytes,
+                                      transport=channel_transport,
+                                      ring_depth=channel_ring_depth)
         return CompiledDAG(self)
 
     # convenience: execute without explicit compile (reference

@@ -3,6 +3,11 @@ python/ray/experimental/)."""
 from ray_tpu.experimental.channel import (Channel, ChannelClosed,
                                           ChannelReader, ChannelTimeout,
                                           ChannelWriter)
+from ray_tpu.experimental.wire_channel import (WireChannel,
+                                               WireChannelReader,
+                                               WireChannelWriter,
+                                               serve_channel)
 
 __all__ = ["Channel", "ChannelReader", "ChannelWriter", "ChannelClosed",
-           "ChannelTimeout"]
+           "ChannelTimeout", "WireChannel", "WireChannelReader",
+           "WireChannelWriter", "serve_channel"]

@@ -2,28 +2,38 @@
 
 Parity: reference python/ray/experimental/channel/shared_memory_channel.py
 + src/ray/core_worker/experimental_mutable_object_manager.cc — a
-fixed-capacity single-writer / multi-reader shm slot that is REUSED for
+fixed-capacity single-writer / multi-reader shm ring that is REUSED for
 every message, so a compiled DAG's hops exchange data with one memcpy
 and zero store round-trips, task submissions, or driver hops.
 
-Protocol (one 4KiB-aligned segment per channel):
+Protocol (one segment per channel, `depth` payload slots):
 
-    u64 magic | u64 n_readers | u64 seq | u64 len | u64 acks[n_readers]
-    ... payload bytes (capacity) ...
+    u64 magic | u64 n_readers | u64 seq | u64 depth
+    u64 acks[n_readers] | u64 lens[depth]
+    ... depth * capacity payload bytes ...
 
-The writer waits until every reader's ack equals the current seq (all
-consumed), copies the payload, stores len, then publishes seq+1 — a
-single aligned u64 store, which is atomic on every platform XLA targets.
-Reader i polls seq until it reaches its expected value, copies the
-payload out, then stores ack[i]=seq. Each header word has exactly one
-writer, so no cross-process atomics beyond aligned stores are needed.
-Blocking is adaptive spin -> sleep polling (the reference uses
-futex-backed semaphores; at the ~µs scales involved polling is
-competitive and portable).
+Messages are numbered from 1; message s lives in slot (s-1) % depth.
+The writer waits until every reader's ack is >= s - depth (the slot's
+previous occupant is fully consumed), copies the payload into the slot,
+stores lens[slot], then publishes seq=s — a single aligned u64 store,
+which is atomic on every platform XLA targets. Reader i polls seq until
+it reaches its expected value, copies the payload out, then stores
+ack[i]=s. Each header word has exactly one writer, so no cross-process
+atomics beyond aligned stores are needed. Blocking is adaptive spin ->
+sleep polling (the reference uses futex-backed semaphores; at the ~µs
+scales involved polling is competitive and portable).
+
+depth > 1 (r13, default RAY_TPU_CHANNEL_RING_DEPTH=2) is what makes
+transfer/compute OVERLAP possible: with a single slot the writer blocks
+until every reader consumed the previous message, serializing a
+pipeline stage's send with its neighbor's compute; with two slots the
+writer publishes message s and immediately starts computing s+1 while
+the reader drains s (double buffering). MPMD pipeline stages depend on
+this (train/pipeline.py).
 
 Channels are HOST-LOCAL (the segment lives in this host's /dev/shm),
-like the reference's shm channels; cross-host DAG edges need a
-different transport (the reference uses NCCL there).
+like the reference's shm channels; cross-host DAG edges ride the r13
+wire transport instead (experimental/wire_channel.py).
 """
 from __future__ import annotations
 
@@ -31,10 +41,11 @@ import pickle
 import struct
 import time
 import uuid
-from typing import Any, List, Optional
+from typing import Any, Optional
 
 import cloudpickle
 
+from ray_tpu._private import tracing_plane as _tp
 from ray_tpu._private.object_store import (_create_segment, _map_segment,
                                            unlink_segment)
 
@@ -90,6 +101,13 @@ class ChannelTimeout(Exception):
     pass
 
 
+def _ring_depth(depth: Optional[int]) -> int:
+    if depth is None:
+        from ray_tpu._private.config import CONFIG
+        depth = int(CONFIG.channel_ring_depth)
+    return max(1, int(depth))
+
+
 def _wait(predicate, timeout: Optional[float], what: str):
     deadline = None if timeout is None else time.monotonic() + timeout
     spins = 0
@@ -143,31 +161,38 @@ class Channel:
     then hand to exactly one writer and `n_readers` readers (each with a
     distinct reader_index)."""
 
-    def __init__(self, name: str, capacity: int, n_readers: int):
+    transport = "shm"
+
+    def __init__(self, name: str, capacity: int, n_readers: int,
+                 depth: int = 1, label: str = ""):
         self.name = name
         self.capacity = capacity
         self.n_readers = n_readers
+        self.depth = max(1, int(depth))
+        self.label = label or name[-6:]
         self._mv: Optional[memoryview] = None
 
     @classmethod
-    def create(cls, capacity: int = 1 << 20,
-               n_readers: int = 1) -> "Channel":
+    def create(cls, capacity: int = 1 << 20, n_readers: int = 1,
+               depth: Optional[int] = None, label: str = "") -> "Channel":
         from ray_tpu._private.specs import SESSION_TAG
+        depth = _ring_depth(depth)
         name = f"rtpu_{SESSION_TAG}_ch_{uuid.uuid4().hex[:12]}"
-        header = 32 + 8 * n_readers
-        buf = bytearray(header + capacity)
-        struct.pack_into("<QQQQ", buf, 0, _MAGIC, n_readers, 0, 0)
-        ch = cls(name, capacity, n_readers)
+        header = 32 + 8 * n_readers + 8 * depth
+        buf = bytearray(header + depth * capacity)
+        struct.pack_into("<QQQQ", buf, 0, _MAGIC, n_readers, 0, depth)
+        ch = cls(name, capacity, n_readers, depth, label)
         _create_segment(name, memoryview(bytes(buf)))
         return ch
 
     # ------------------------------------------------------- low level
     def _map(self) -> memoryview:
         if self._mv is None:
+            header = 32 + 8 * self.n_readers + 8 * self.depth
             self._mv = _map_segment(
-                self.name, 32 + 8 * self.n_readers + self.capacity)
-            magic, n = struct.unpack_from("<QQ", self._mv, 0)
-            if magic != _MAGIC or n != self.n_readers:
+                self.name, header + self.depth * self.capacity)
+            magic, n, _, d = struct.unpack_from("<QQQQ", self._mv, 0)
+            if magic != _MAGIC or n != self.n_readers or d != self.depth:
                 raise ValueError(f"bad channel segment {self.name}")
         return self._mv
 
@@ -177,22 +202,54 @@ class Channel:
     def _set_u64(self, off: int, val: int) -> None:
         struct.pack_into("<Q", self._map(), off, val)
 
-    @property
-    def _payload_off(self) -> int:
-        return 32 + 8 * self.n_readers
+    def _len_off(self, slot: int) -> int:
+        return 32 + 8 * self.n_readers + 8 * slot
+
+    def _slot_off(self, slot: int) -> int:
+        return (32 + 8 * self.n_readers + 8 * self.depth
+                + slot * self.capacity)
+
+    # ------------------------------------------------------- endpoints
+    def writer(self) -> "ChannelWriter":
+        return ChannelWriter(self)
+
+    def reader(self, reader_index: int) -> "ChannelReader":
+        return ChannelReader(self, reader_index)
 
     def destroy(self) -> None:
         self._mv = None
         unlink_segment(self.name)
 
     def __reduce__(self):
-        return (Channel, (self.name, self.capacity, self.n_readers))
+        return (Channel, (self.name, self.capacity, self.n_readers,
+                          self.depth, self.label))
 
 
 class ChannelWriter:
     def __init__(self, channel: Channel):
         self.ch = channel
         self._seq = channel._u64(16)
+
+    def _acquire_slot(self, timeout: Optional[float]) -> int:
+        """Wait until message self._seq+1's ring slot is consumable and
+        return its index: every reader must have acked the message that
+        last occupied it (s - depth). With depth > 1 the writer runs
+        ahead of its readers — double buffering, the transfer/compute
+        overlap the MPMD pipeline schedules depend on."""
+        ch = self.ch
+        s = self._seq + 1
+        if s > ch.depth:
+            with _tp.span("channel", f"ch.wait:{ch.label}",
+                          extra={"seq": s}):
+                _wait_words(ch, 32, ch.n_readers, s - ch.depth, timeout,
+                            "readers to free a ring slot")
+        return (s - 1) % ch.depth
+
+    def _publish(self, slot: int, len_word: int) -> None:
+        ch = self.ch
+        ch._set_u64(ch._len_off(slot), len_word)
+        self._seq += 1
+        ch._set_u64(16, self._seq)     # publish
 
     def write_bytes(self, data: bytes, *, error: bool = False,
                     timeout: Optional[float] = None) -> None:
@@ -202,15 +259,14 @@ class ChannelWriter:
                 f"message of {len(data)} bytes exceeds channel capacity "
                 f"{ch.capacity}; recompile with a larger "
                 f"buffer_size_bytes")
-        seq = self._seq
-        _wait_words(ch, 32, ch.n_readers, seq, timeout,
-                    "readers to consume previous message")
-        mv = ch._map()
-        off = ch._payload_off
-        mv[off:off + len(data)] = data
-        ch._set_u64(24, len(data) | (_ERROR_FLAG if error else 0))
-        self._seq = seq + 1
-        ch._set_u64(16, self._seq)     # publish
+        slot = self._acquire_slot(timeout)
+        with _tp.span("channel", f"ch.write:{ch.label}",
+                      extra={"bytes": len(data)}):
+            mv = ch._map()
+            off = ch._slot_off(slot)
+            mv[off:off + len(data)] = data
+            self._publish(slot,
+                          len(data) | (_ERROR_FLAG if error else 0))
 
     def write(self, value: Any, **kw) -> None:
         payload = _array_payload(value)
@@ -233,34 +289,35 @@ class ChannelWriter:
                 f"array of {arr.nbytes} bytes exceeds channel capacity "
                 f"{ch.capacity}; recompile with a larger "
                 f"buffer_size_bytes")
-        seq = self._seq
-        _wait_words(ch, 32, ch.n_readers, seq, timeout,
-                    "readers to consume previous message")
-        mv = ch._map()
-        off = ch._payload_off
-        struct.pack_into("<I", mv, off, len(meta))
-        mv[off + 4:off + 4 + len(meta)] = meta
-        body = mv[off + 4 + len(meta):off + total]
-        np.frombuffer(body, dtype=arr.dtype).reshape(arr.shape)[...] = arr
-        ch._set_u64(24, total | _RAW_FLAG)
-        self._seq = seq + 1
-        ch._set_u64(16, self._seq)     # publish
+        slot = self._acquire_slot(timeout)
+        with _tp.span("channel", f"ch.write:{ch.label}",
+                      extra={"bytes": arr.nbytes}):
+            mv = ch._map()
+            off = ch._slot_off(slot)
+            struct.pack_into("<I", mv, off, len(meta))
+            mv[off + 4:off + 4 + len(meta)] = meta
+            body = mv[off + 4 + len(meta):off + total]
+            np.frombuffer(body, dtype=arr.dtype).reshape(
+                arr.shape)[...] = arr
+            self._publish(slot, total | _RAW_FLAG)
 
     def close(self, timeout: float = 5.0) -> None:
-        """Publish the closed marker (readers raise ChannelClosed)."""
+        """Publish the closed marker (readers raise ChannelClosed once
+        they reach it — messages already in the ring drain first)."""
         ch = self.ch
         try:
-            seq = self._seq
-            _wait_words(ch, 32, ch.n_readers, seq, timeout,
-                        "readers before close")
+            slot = self._acquire_slot(timeout)
         except ChannelTimeout:
-            # A reader hasn't consumed the last published message yet;
-            # stomping the len word would silently drop it. Leave the
-            # message intact — stuck readers are handled by teardown.
+            # A ring slot hasn't freed up: a reader is wedged or gone.
+            # Stomping an unconsumed slot would silently drop data;
+            # leave the ring intact — stuck readers are handled by
+            # teardown.
             return
-        ch._set_u64(24, _CLOSED_LEN)
-        self._seq += 1
-        ch._set_u64(16, self._seq)
+        self._publish(slot, _CLOSED_LEN)
+
+    def release(self) -> None:
+        """Transport-symmetric resource hook (wire channels shut their
+        server down here); shm writers hold nothing beyond the mapping."""
 
 
 class ChannelReader:
@@ -270,15 +327,16 @@ class ChannelReader:
         self.ch = channel
         self.idx = reader_index
         # messages are numbered from seq 1; a reader may attach after
-        # the writer's first publish (exec loops start async), and the
-        # writer's ack gate guarantees nothing can be overwritten before
-        # every reader consumed it — so always start at 1
+        # the writer published up to `depth` messages (exec loops start
+        # async), and the writer's ack gate guarantees no slot can be
+        # overwritten before every reader consumed it — so always start
+        # at 1
         self._expect = 1
 
     def read_bytes(self, timeout: Optional[float] = None) -> bytes:
         ch = self.ch
         _wait_words(ch, 16, 1, self._expect, timeout, "message")
-        length = ch._u64(24)
+        length = ch._u64(ch._len_off((self._expect - 1) % ch.depth))
         if length != _CLOSED_LEN and (length & _RAW_FLAG):
             # refuse BEFORE consuming: the frame stays readable via
             # read() (decoding here would ack + advance destructively)
@@ -289,22 +347,25 @@ class ChannelReader:
 
     def _read_frame(self, timeout: Optional[float]):
         ch = self.ch
-        _wait_words(ch, 16, 1, self._expect, timeout, "message")
-        length = ch._u64(24)
-        if length == _CLOSED_LEN:
-            raise ChannelClosed(ch.name)
-        error = bool(length & _ERROR_FLAG)
-        raw = bool(length & _RAW_FLAG)
-        length &= _LEN_MASK
-        off = ch._payload_off
-        if raw:
-            value = self._decode_array(length, off)
+        with _tp.span("channel", f"ch.read:{ch.label}",
+                      extra={"seq": self._expect}):
+            _wait_words(ch, 16, 1, self._expect, timeout, "message")
+            slot = (self._expect - 1) % ch.depth
+            length = ch._u64(ch._len_off(slot))
+            if length == _CLOSED_LEN:
+                raise ChannelClosed(ch.name)
+            error = bool(length & _ERROR_FLAG)
+            raw = bool(length & _RAW_FLAG)
+            length &= _LEN_MASK
+            off = ch._slot_off(slot)
+            if raw:
+                value = self._decode_array(length, off)
+                ch._set_u64(32 + 8 * self.idx, self._expect)   # ack
+                self._expect += 1
+                return value, True
+            data = bytes(ch._map()[off:off + length])
             ch._set_u64(32 + 8 * self.idx, self._expect)   # ack
             self._expect += 1
-            return value, True
-        data = bytes(ch._map()[off:off + length])
-        ch._set_u64(32 + 8 * self.idx, self._expect)   # ack
-        self._expect += 1
         if error:
             raise RuntimeError(
                 f"upstream DAG node failed: {pickle.loads(data)}")
@@ -338,3 +399,7 @@ class ChannelReader:
         if raw:
             return data
         return pickle.loads(data)
+
+    def release(self) -> None:
+        """Transport-symmetric resource hook (wire readers close their
+        connection here); shm readers hold nothing beyond the mapping."""

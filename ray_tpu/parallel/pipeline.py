@@ -25,6 +25,51 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def _shard_map(f, *, mesh, axis_names, in_specs, out_specs,
+               check_vma=False):
+    """jax.shard_map compat: the stable partial-manual API when this
+    jax has it, else jax.experimental.shard_map (axis_names -> its
+    `auto` complement, check_vma -> check_rep). Keeps the pipeline
+    schedules runnable across the jax versions the fleet actually
+    ships."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, axis_names=axis_names,
+                             in_specs=in_specs, out_specs=out_specs,
+                             check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    auto = frozenset(mesh.axis_names) - set(axis_names)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma, auto=auto)
+
+
+def partition_layers(n_layers: int, n_stages: int) -> list:
+    """Canonical stage partition: ``[(start, count), ...]`` per stage,
+    with the remainder layers assigned to the LAST stage (it already
+    sits next to the loss, so in MPMD mode its extra work overlaps the
+    other stages' cooldown bubble). Shared by the SPMD schedules here
+    (uneven splits via ``layer_fn``) and by the MPMD stage assignment
+    (train/pipeline.py), so the two parallelism modes can never
+    disagree about which stage owns which layer."""
+    if n_stages < 1:
+        raise ValueError(f"need at least one stage, got {n_stages}")
+    if n_layers < n_stages:
+        raise ValueError(
+            f"{n_layers} layers cannot fill {n_stages} pipeline stages")
+    k, r = divmod(n_layers, n_stages)
+    parts = [(s * k, k) for s in range(n_stages - 1)]
+    parts.append(((n_stages - 1) * k, k + r))
+    return parts
+
+
+def slice_stage(layer_params: Any, start: int, count: int) -> Any:
+    """One stage's sub-stack: leaves (L, ...) -> (count, ...). The MPMD
+    counterpart of split_stages — per-stage pytrees may be RAGGED
+    across stages (each stage is its own program), which is exactly why
+    uneven splits are free in MPMD mode."""
+    return jax.tree_util.tree_map(
+        lambda p: p[start:start + count], layer_params)
+
+
 def split_stages(layer_params: Any, n_stages: int) -> Any:
     """Reshape layer-stacked leaves (L, ...) -> (S, L//S, ...)."""
     def reshape(p):
@@ -32,9 +77,88 @@ def split_stages(layer_params: Any, n_stages: int) -> Any:
         if L % n_stages:
             raise ValueError(
                 f"{L} layers not divisible into {n_stages} pipeline "
-                f"stages")
+                f"stages; pass layer_fn= for an uneven split "
+                f"(remainder layers go to the last stage, see "
+                f"partition_layers) or use the MPMD pipeline "
+                f"(train/pipeline.py), where ragged stages are free")
         return p.reshape(n_stages, L // n_stages, *p.shape[1:])
     return jax.tree_util.tree_map(reshape, layer_params)
+
+
+def split_stages_padded(layer_params: Any, n_stages: int):
+    """Uneven-split stacking for the SPMD schedules: leaves (L, ...)
+    -> (S, kmax, ...) zero-padded per stage, plus the per-stage valid
+    counts. Padded slots are masked to IDENTITY inside the per-layer
+    scan (`_make_stage_call`), so every shard runs the same program
+    shape while stages apply different layer counts."""
+    leaves = jax.tree_util.tree_leaves(layer_params)
+    if not leaves:
+        raise ValueError("layer_params has no leaves")
+    L = leaves[0].shape[0]
+    parts = partition_layers(L, n_stages)
+    kmax = max(c for _, c in parts)
+
+    def stack(p):
+        rows = []
+        for start, count in parts:
+            block = p[start:start + count]
+            if count < kmax:
+                pad = jnp.zeros((kmax - count,) + p.shape[1:], p.dtype)
+                block = jnp.concatenate([block, pad], axis=0)
+            rows.append(block)
+        return jnp.stack(rows)
+    import numpy as np
+    return (jax.tree_util.tree_map(stack, layer_params),
+            np.asarray([c for _, c in parts], dtype=np.int32))
+
+
+def _unpad_stage_axis(stacked: Any, layer_params: Any,
+                      n_stages: int) -> Any:
+    """Inverse of split_stages_padded along the layer axis: (S, kmax,
+    ...) -> (L, ...) dropping the padded rows (used to return grads in
+    the caller's layer-major layout)."""
+    leaves = jax.tree_util.tree_leaves(layer_params)
+    parts = partition_layers(leaves[0].shape[0], n_stages)
+    return jax.tree_util.tree_map(
+        lambda g: jnp.concatenate(
+            [g[s, :count] for s, (_, count) in enumerate(parts)],
+            axis=0),
+        stacked)
+
+
+def _make_stage_call(stage_fn, layer_fn, counts):
+    """Uniform per-stage apply: ``call(params, x, stage, consts)``.
+
+    stage_fn mode (even splits): the caller's whole-sub-stack function,
+    unchanged. layer_fn mode (uneven splits): a masked per-layer scan —
+    ``layer_fn(one_layer_params, x, *consts) -> x`` is applied to every
+    padded slot, and slots past this stage's valid count pass the
+    activation through unchanged (`where` keeps the program shape
+    identical across shards; grads through padded slots are exactly
+    zero because the output disconnects from them)."""
+    if layer_fn is None:
+        if stage_fn is None:
+            raise ValueError("pass stage_fn or layer_fn")
+        return lambda p, x, stage, consts: stage_fn(p, x, *consts)
+    counts = jnp.asarray(counts, jnp.int32)
+
+    def call(p, x, stage, consts):
+        n_valid = counts[stage]
+
+        def body(carry, layer):
+            i, xx = carry
+            y = layer_fn(layer, xx, *consts)
+            return (i + 1, jnp.where(i < n_valid, y, xx)), None
+        (_, out), _ = lax.scan(body, (jnp.int32(0), x), p)
+        return out
+    return call
+
+
+def _stack_for(mesh_stages: int, layer_params: Any, layer_fn):
+    """(stacked pytree, stage_call counts) for either calling mode."""
+    if layer_fn is None:
+        return split_stages(layer_params, mesh_stages), None
+    return split_stages_padded(layer_params, mesh_stages)
 
 
 def pipeline_apply(mesh: Mesh,
@@ -42,7 +166,8 @@ def pipeline_apply(mesh: Mesh,
                    layer_params: Any,
                    x: jax.Array,
                    num_microbatches: int,
-                   consts: tuple = ()) -> jax.Array:
+                   consts: tuple = (),
+                   layer_fn: Callable[..., jax.Array] = None) -> jax.Array:
     """Run `stage_fn(stage_params, x_microbatch, *consts)` (one stage's
     layer stack applied to one microbatch) over the pp axis with a
     GPipe schedule.
@@ -51,6 +176,12 @@ def pipeline_apply(mesh: Mesh,
     (e.g. rope caches) passed explicitly — closures over tracers don't
     cross the shard_map boundary. Returns x's shape, replicated over pp
     (downstream ops run outside the manual region).
+
+    Uneven layer counts: pass ``layer_fn(one_layer_params, x, *consts)
+    -> x`` INSTEAD of stage_fn. The stack is padded to ceil(L/S) per
+    stage (remainder layers on the last stage, `partition_layers`) and
+    a masked per-layer scan keeps padded slots identity, so L need not
+    divide the stage count.
 
     NOTE: call this under an outer jit (the normal train step). The
     inner jit below exists so EAGER callers work at all (partial-manual
@@ -65,10 +196,11 @@ def pipeline_apply(mesh: Mesh,
     if b % M:
         raise ValueError(f"batch {b} not divisible into {M} microbatches")
     micro = x.reshape(M, b // M, *x.shape[1:])
-    stacked = split_stages(layer_params, n_stages)
+    stacked, counts = _stack_for(n_stages, layer_params, layer_fn)
+    stage_call = _make_stage_call(stage_fn, layer_fn, counts)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, axis_names={"pp"},
+        _shard_map, mesh=mesh, axis_names={"pp"},
         in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked),
                   P(), jax.tree_util.tree_map(lambda _: P(),
                                               tuple(consts))),
@@ -89,7 +221,7 @@ def pipeline_apply(mesh: Mesh,
             inject = lax.dynamic_index_in_dim(
                 micro_local, jnp.clip(t, 0, M - 1), 0, keepdims=False)
             x_in = jnp.where(stage == 0, inject, state)
-            y = stage_fn(params_local, x_in, *consts_local)
+            y = stage_call(params_local, x_in, stage, consts_local)
             # last stage emits microbatch t-(S-1) once the fill ends
             out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
             emit = jnp.logical_and(stage == n_stages - 1,
@@ -125,7 +257,8 @@ def pipeline_grads_1f1b(mesh: Mesh,
                         x: jax.Array,
                         targets: jax.Array,
                         num_microbatches: int,
-                        consts: tuple = ()):
+                        consts: tuple = (),
+                        layer_fn: Callable[..., jax.Array] = None):
     """One-forward-one-backward pipeline schedule (the reference's
     dag_node_operation.py builds exactly this ordering for its NCCL
     actor pipelines; Narayanan et al. PipeDream-Flush / Megatron-LM).
@@ -147,7 +280,9 @@ def pipeline_grads_1f1b(mesh: Mesh,
     Returns (mean loss over all microbatches, grads in the layer-major
     (L, ...) layout of `layer_params`). stage_fn/loss_fn as in
     pipeline_apply, with loss_fn(y_microbatch, target_microbatch) ->
-    scalar summed loss for that microbatch.
+    scalar summed loss for that microbatch. Uneven layer counts: pass
+    ``layer_fn`` instead of stage_fn (see pipeline_apply) — grads come
+    back unpadded in the caller's (L, ...) layout either way.
     """
     n_stages = mesh.shape["pp"]
     if n_stages <= 1:
@@ -159,11 +294,12 @@ def pipeline_grads_1f1b(mesh: Mesh,
         raise ValueError(f"batch {b} not divisible into {M} microbatches")
     micro = x.reshape(M, b // M, *x.shape[1:])
     tmicro = targets.reshape(M, b // M, *targets.shape[1:])
-    stacked = split_stages(layer_params, n_stages)
+    stacked, counts = _stack_for(n_stages, layer_params, layer_fn)
+    stage_call = _make_stage_call(stage_fn, layer_fn, counts)
     A = min(M, 2 * (S - 1) + 1)       # activation ring slots per stage
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, axis_names={"pp"},
+        _shard_map, mesh=mesh, axis_names={"pp"},
         in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked),
                   P(), P(),
                   jax.tree_util.tree_map(lambda _: P(), tuple(consts))),
@@ -179,7 +315,7 @@ def pipeline_grads_1f1b(mesh: Mesh,
         down = [(i, (i - 1) % S) for i in range(S)]
 
         def fwd_only(p, xx):
-            return stage_fn(p, xx, *consts_local)
+            return stage_call(p, xx, stage, consts_local)
 
         zero_act = jnp.zeros_like(micro_local[0])
         ring0 = jnp.zeros((A,) + zero_act.shape, zero_act.dtype)
@@ -238,6 +374,9 @@ def pipeline_grads_1f1b(mesh: Mesh,
 
     loss, stacked_grads = jax.jit(run)(stacked, micro, tmicro,
                                        tuple(consts))
-    grads = jax.tree_util.tree_map(
-        lambda g, p: g.reshape(p.shape), stacked_grads, layer_params)
+    if layer_fn is None:
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g.reshape(p.shape), stacked_grads, layer_params)
+    else:
+        grads = _unpad_stage_axis(stacked_grads, layer_params, S)
     return loss, grads

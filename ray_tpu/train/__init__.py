@@ -17,7 +17,8 @@ from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxConfig 
 from ray_tpu.train.checkpoint import (Checkpoint, CheckpointManager,  # noqa: F401
                                       load_pytree, save_pytree)
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig,  # noqa: F401
-                                  Result, RunConfig, ScalingConfig)
+                                  PipelineConfig, Result, RunConfig,
+                                  ScalingConfig)
 from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
                                    get_dataset_shard,
                                    make_temp_checkpoint_dir, report)

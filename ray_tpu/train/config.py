@@ -70,6 +70,56 @@ class ScalingConfig:
 
 
 @dataclasses.dataclass
+class PipelineConfig:
+    """MPMD pipeline-parallel training (r13): the layer stack is
+    partitioned across `pipeline_stages` worker GROUPS (remainder
+    layers to the last stage, parallel.pipeline.partition_layers), and
+    activations/grads stream stage-to-stage over compiled-DAG channels
+    with a 1F1B (or GPipe) microbatch schedule — each stage is its own
+    set of processes owning its own slice, per "Scaling Deep Learning
+    Training with MPMD Pipeline Parallelism" (PAPERS.md).
+
+    init_params: layer-stacked pytree, leaves (L, ...).
+    stage_fn(stage_params, x, *consts) -> y: applies ONE stage's
+        sub-stack (leaves (L_s, ...), possibly ragged across stages —
+        MPMD stages are independent programs).
+    loss_fn(y, targets) -> scalar summed microbatch loss (the 1F1B
+        contract shared with parallel.pipeline.pipeline_grads_1f1b).
+    batch_fn(step) -> (x, targets): the per-step global batch.
+    update_fn(params, grads, step) -> params: per-stage optimizer
+        applied to that stage's slice with grads already averaged over
+        microbatches; None = SGD with `lr`.
+    transport: "shm" (same-box rings) | "wire" (cross-host, tensors
+        over the Envelope raw zero-copy path) | "auto" (wire for
+        cross-host edges only).
+    ring_depth: channel ring slots (None -> RAY_TPU_CHANNEL_RING_DEPTH;
+        >= 2 overlaps a stage's sends with its neighbors' compute).
+    """
+    init_params: Any = None
+    stage_fn: Any = None
+    loss_fn: Any = None
+    batch_fn: Any = None
+    steps: int = 1
+    consts: tuple = ()
+    num_microbatches: int = 4
+    schedule: str = "1f1b"
+    transport: str = "shm"
+    ring_depth: Optional[int] = None
+    channel_capacity_bytes: int = 4 << 20
+    workers_per_stage: int = 1
+    update_fn: Any = None
+    lr: float = 1e-2
+
+    def __post_init__(self):
+        if self.schedule not in ("1f1b", "gpipe"):
+            raise ValueError("schedule must be 1f1b|gpipe")
+        if self.transport not in ("shm", "wire", "auto"):
+            raise ValueError("transport must be shm|wire|auto")
+        if self.num_microbatches < 1:
+            raise ValueError("num_microbatches must be >= 1")
+
+
+@dataclasses.dataclass
 class CheckpointConfig:
     num_to_keep: Optional[int] = None        # None = keep all
     checkpoint_score_attribute: Optional[str] = None
@@ -113,3 +163,6 @@ class Result:
     error: Optional[BaseException] = None
     # trial config when produced by a Tune sweep (reference Result.config)
     config: Optional[Dict[str, Any]] = None
+    # non-scalar outputs (MPMD pipeline mode returns the reassembled
+    # layer-major params here; metrics stay scalar-only)
+    artifacts: Optional[Dict[str, Any]] = None

@@ -21,7 +21,8 @@ from ray_tpu.exceptions import ActorError, RayTpuError
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig,
-                                  Result, RunConfig, ScalingConfig)
+                                  PipelineConfig, Result, RunConfig,
+                                  ScalingConfig)
 from ray_tpu.train.worker_group import WorkerGroup
 
 logger = logging.getLogger(__name__)
@@ -37,14 +38,16 @@ class JaxTrainer:
     """
 
     def __init__(self,
-                 train_loop_per_worker: Callable,
+                 train_loop_per_worker: Optional[Callable] = None,
                  *,
                  train_loop_config: Optional[Dict[str, Any]] = None,
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  backend_config: Optional[BackendConfig] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None,
-                 datasets: Optional[Dict[str, Any]] = None):
+                 datasets: Optional[Dict[str, Any]] = None,
+                 pipeline_stages: int = 0,
+                 pipeline_config: Optional["PipelineConfig"] = None):
         self._fn = train_loop_per_worker
         self._config = dict(train_loop_config or {})
         self._datasets = dict(datasets or {})
@@ -52,9 +55,27 @@ class JaxTrainer:
         self._run_config = run_config or RunConfig()
         self._backend_config = backend_config or JaxConfig()
         self._resume_checkpoint = resume_from_checkpoint
+        # MPMD pipeline mode (r13): pipeline_stages > 1 partitions the
+        # layer stack across that many stage worker GROUPS and runs
+        # the 1F1B/GPipe microbatch schedule over compiled-DAG
+        # channels instead of the data-parallel loop below (see
+        # train/pipeline.py). train_loop_per_worker is unused there —
+        # the stage program comes from pipeline_config.
+        self._pipeline_stages = int(pipeline_stages)
+        self._pipeline_config = pipeline_config
+        if self._pipeline_stages <= 1 and train_loop_per_worker is None:
+            raise ValueError(
+                "train_loop_per_worker is required unless "
+                "pipeline_stages > 1")
 
     # ------------------------------------------------------------- fit
     def fit(self) -> Result:
+        if self._pipeline_stages > 1:
+            from ray_tpu.train.pipeline import fit_pipeline
+            return fit_pipeline(self)
+        return self._fit_data_parallel()
+
+    def _fit_data_parallel(self) -> Result:
         run_name = self._run_config.name or f"train_{int(time.time())}"
         storage = (self._run_config.storage_path
                    or os.path.expanduser("~/ray_tpu_results"))

@@ -116,10 +116,14 @@ class WorkerGroup:
     def __init__(self, num_workers: int,
                  resources_per_worker: Optional[Dict[str, float]] = None,
                  placement_strategy: str = "PACK",
-                 bundles: Optional[List[Dict[str, float]]] = None):
+                 bundles: Optional[List[Dict[str, float]]] = None,
+                 name: str = "train_worker_group"):
         self.num_workers = num_workers
         self._resources = dict(resources_per_worker or {"CPU": 1.0})
         self._strategy = placement_strategy
+        # Group name (MPMD pipeline mode runs one group PER STAGE, so
+        # each stage's placement group is distinguishable in state ops).
+        self.name = name
         # Explicit per-rank bundles (TPU pod-slice mode: rank 0's bundle
         # carries the TPU-<gen>-head resource).
         self._bundles = bundles
@@ -135,7 +139,7 @@ class WorkerGroup:
         self._pg = placement_group(
             self._bundles or
             [dict(self._resources) for _ in range(self.num_workers)],
-            strategy=self._strategy, name="train_worker_group")
+            strategy=self._strategy, name=self.name)
         if not self._pg.wait(timeout_seconds=60):
             pg, self._pg = self._pg, None
             remove_placement_group(pg)
@@ -184,6 +188,14 @@ class WorkerGroup:
         fn_bytes = cloudpickle.dumps(fn)
         return ray_tpu.get(
             self.workers[rank].run.remote(fn_bytes, args, kwargs))
+
+    def run_on_rank_async(self, rank: int, fn: Callable,
+                          *args, **kwargs) -> Any:
+        """Non-blocking run: returns the ObjectRef. MPMD pipeline stage
+        loops are long-lived calls that must run CONCURRENTLY across
+        stage groups — the blocking fanout above would serialize them."""
+        fn_bytes = cloudpickle.dumps(fn)
+        return self.workers[rank].run.remote(fn_bytes, args, kwargs)
 
     def set_env_on_all(self, env: Dict[str, str]) -> None:
         ray_tpu.get([w.set_env.remote(env) for w in self.workers])

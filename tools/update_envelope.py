@@ -26,6 +26,12 @@ END = "<!-- bench:latest:end -->"
 # scenario key -> human row label (table order follows this list; keys
 # absent from the JSON are skipped, unknown keys are appended as-is)
 LABELS = [
+    ("pipeline_1f1b_depth1",
+     "MPMD pipeline 4-stage, 1F1B, single-slot channels (depth 1)"),
+    ("pipeline_1f1b_overlap",
+     "MPMD pipeline 4-stage, 1F1B, ring depth 2 (overlap)"),
+    ("pipeline_gpipe", "MPMD pipeline 4-stage, GPipe fill-drain"),
+    ("pipeline_1f1b", "MPMD pipeline 4-stage, 1F1B (vs GPipe pair)"),
     ("wire_codec_native", "wire codec, C forced (encode+decode µs)"),
     ("wire_codec_python",
      "wire codec, protobuf backend (encode+decode µs)"),
@@ -89,6 +95,10 @@ def _fmt_result(rec: dict) -> str:
             out += f" (tree speedup {rec['tree_speedup']}x)"
         if "manifest_speedup" in rec:
             out += f" (manifest speedup {rec['manifest_speedup']}x)"
+        if "overlap_speedup" in rec:
+            out += f" (overlap speedup {rec['overlap_speedup']}x)"
+        if "schedule_speedup" in rec:
+            out += f" (1F1B speedup {rec['schedule_speedup']}x)"
         ab = rec.get("ab")
         if ab and "order_medians" in ab:
             # r12 order-bias control: the arm's median when it ran
@@ -104,7 +114,8 @@ def _fmt_result(rec: dict) -> str:
                            "trace_overhead_pct",
                            "metrics_overhead_pct", "ab",
                            "serve_copies_per_byte",
-                           "land_copies_per_byte")}
+                           "land_copies_per_byte",
+                           "bubble_fraction")}
     return ", ".join(f"{k}={v}" for k, v in extras.items())
 
 
@@ -149,6 +160,15 @@ def _fmt_copies(rec: dict) -> str:
     return "—"
 
 
+def _fmt_bubble(rec: dict) -> str:
+    """The r13 pipeline column: per-stage idle fraction over the timed
+    window, from the tracing plane's stage compute spans (1F1B floor
+    is (S-1)/(M+S-1); same-box numbers include core contention)."""
+    if "bubble_fraction" in rec:
+        return f"{rec['bubble_fraction']:.2f}"
+    return "—"
+
+
 def render_block(results: dict) -> str:
     known = [k for k, _ in LABELS]
     rows = [(label, results[key]) for key, label in LABELS
@@ -160,12 +180,13 @@ def render_block(results: dict) -> str:
              "",
              "| Scenario | Result | frames/task · head-CPU/task "
              "| trace overhead | metrics overhead "
-             "| copies/byte serve · land |",
-             "|---|---|---|---|---|---|"]
+             "| copies/byte serve · land | bubble |",
+             "|---|---|---|---|---|---|---|"]
     for label, rec in rows:
         lines.append(f"| {label} | {_fmt_result(rec)} | "
                      f"{_fmt_frames(rec)} | {_fmt_trace(rec)} | "
-                     f"{_fmt_metrics(rec)} | {_fmt_copies(rec)} |")
+                     f"{_fmt_metrics(rec)} | {_fmt_copies(rec)} | "
+                     f"{_fmt_bubble(rec)} |")
     lines.append(END)
     return "\n".join(lines)
 

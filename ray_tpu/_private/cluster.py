@@ -52,6 +52,14 @@ class NodeRecord:
     labels: Dict[str, str] = field(default_factory=dict)
     last_heartbeat: float = field(default_factory=time.monotonic)
     started_at: float = field(default_factory=time.time)
+    # Drain-before-kill state (r14 preemption notice): a draining node
+    # is alive but receives no new placements; drain_acked flips when
+    # every interested party (elastic trainers) has flushed state and
+    # the node may be released before its deadline. The deadline itself
+    # is enforced by whoever issued the drain (the autoscaler's sweep),
+    # not here — the cluster only tracks the routing/ack state.
+    draining: bool = False
+    drain_acked: bool = False
 
 
 @dataclass
@@ -176,6 +184,95 @@ class ClusterTaskManager:
         with self._lock:
             return [n for n in self._nodes.values() if n.alive]
 
+    def schedulable_nodes(self) -> List[NodeRecord]:
+        """Alive nodes that accept NEW placements: draining nodes (a
+        preemption notice is in flight) are excluded so nothing fresh
+        lands on a host about to die."""
+        with self._lock:
+            return [n for n in self._nodes.values()
+                    if n.alive and not n.draining]
+
+    # ------------------------------------------- drain-before-kill (r14)
+    def drain_node(self, node_id: str,
+                   deadline_s: Optional[float] = None) -> bool:
+        """Preemption-notice drain: stop routing new work to `node_id`,
+        reclaim its queued-not-started backlog through the r10 lease-
+        revoke machinery and re-place it elsewhere, and publish a
+        DRAINING node event (elastic trainers flush a checkpoint on
+        it). The node stays ALIVE — the caller terminates it once the
+        drain is acknowledged or `deadline_s` lapses; the deadline is
+        advisory here (the autoscaler's drain sweep owns the clock).
+        Returns False for unknown/dead/head nodes."""
+        del deadline_s                       # caller-enforced (see doc)
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is None or not rec.alive or rec.is_head:
+                return False
+            if rec.draining:
+                return True                  # idempotent re-notice
+            rec.draining = True
+            rec.drain_acked = False
+        try:
+            rec.scheduler.set_draining(True)
+        except Exception:
+            pass
+        self._rt.controller.publish_node_event(
+            node_id, "DRAINING", cause="preemption notice")
+        self._reclaim_draining(rec)
+        return True
+
+    def _reclaim_draining(self, rec: NodeRecord) -> None:
+        """Pull queued-not-started work off a draining node and
+        re-place it. Delegated agents hand specs back via the r10
+        lease_reclaimed event (the runtime re-submits them; routing now
+        skips the draining node); local schedulers reclaim through
+        reclaim_tasks with a resubmit callback. Running tasks stay —
+        they either finish inside the drain window or ride the normal
+        node-death recovery."""
+        h = rec.scheduler
+        if getattr(h, "revoke_lease", None) is not None:
+            # remote agent: reclaim through NODE_LEASE_REVOKE whenever
+            # the peer SPEAKS the op (wire MINOR >= 3) — delegation
+            # off still mirrors pushed specs in _work and the agent's
+            # revoke handler works in either lease mode. An older peer
+            # cannot reclaim; its queued work rides the death path.
+            if h.conn.peer_speaks_delegate():
+                ids = h.queued_task_ids(limit=4096)
+                if ids:
+                    h.revoke_lease(ids)
+            return
+        if not hasattr(h, "reclaim_tasks"):
+            return
+        ids = h.queued_task_ids()
+        if not ids:
+            return
+
+        def _resubmit(specs):
+            for spec in specs:
+                try:
+                    self.submit(spec)
+                except Exception:
+                    log.exception("drain resubmit failed")
+
+        h.reclaim_tasks(ids, _resubmit)
+
+    def acknowledge_drain(self, node_id: str) -> None:
+        """A drain listener (elastic trainer) flushed its state: the
+        node may be released before its deadline. Publishes DRAINED so
+        the autoscaler's next sweep (or an external provider loop) can
+        terminate immediately."""
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is None or not rec.draining or rec.drain_acked:
+                return
+            rec.drain_acked = True
+        self._rt.controller.publish_node_event(node_id, "DRAINED")
+
+    def is_draining(self, node_id: str) -> bool:
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            return bool(rec is not None and rec.alive and rec.draining)
+
     def alive_node_count(self) -> int:
         """LOCK-FREE alive-node count (single atomic dict scan): safe to
         call while holding a node lock, where taking the cluster lock
@@ -275,7 +372,7 @@ class ClusterTaskManager:
         constraints = getattr(spec, "label_constraints", None)
         need = Scheduler.need_of(spec)
         best = None
-        for n in self.alive_nodes():
+        for n in self.schedulable_nodes():
             if n.node_id == from_node_id:
                 continue
             if constraints is not None:
@@ -298,7 +395,9 @@ class ClusterTaskManager:
         node-affinity and PG bundle locations first."""
         affinity = getattr(spec, "node_id", None)
         pg_id = getattr(spec, "placement_group_id", None)
-        nodes = self.alive_nodes()
+        # Draining nodes take nothing new; explicit affinity/PG-bundle
+        # placements below still resolve (the user pinned them there).
+        nodes = self.schedulable_nodes()
         if affinity:
             rec = self.get_node(affinity)
             return rec if rec is not None and rec.alive else None
@@ -484,7 +583,7 @@ class ClusterTaskManager:
         return True
 
     def _plan_bundles(self, pg: PGRecord) -> Optional[List[str]]:
-        nodes = self.alive_nodes()
+        nodes = self.schedulable_nodes()
         if not nodes:
             return None
         # Work on copies of availability so the plan is consistent.
@@ -728,7 +827,8 @@ class ClusterTaskManager:
             if not shapes:
                 continue            # no unmet demand: nothing stuck
             if not any(fits(m.scheduler.effective_avail(), shapes[0])
-                       for m in nodes if m is not n and m.alive):
+                       for m in nodes
+                       if m is not n and m.alive and not m.draining):
                 continue            # nowhere better: leave the lease
             ids = h.steal_candidates()
             if ids:
@@ -803,6 +903,7 @@ class ClusterTaskManager:
             "nodes": [{
                 "node_id": n.node_id, "alive": n.alive,
                 "is_head": n.is_head,
+                "draining": n.draining,
                 "resources_total": dict(n.scheduler.total),
                 "resources_available": dict(n.scheduler.avail),
                 "labels": n.labels,

@@ -347,6 +347,33 @@ _define("channel_wire_attach_timeout_s", 30.0,
         "handshake with the writer-side channel server before the "
         "endpoint raises (the writer's exec loop may still be "
         "starting).")
+_define("elastic", True,
+        "Master switch for elastic training (r14): with a "
+        "ScalingConfig(elastic=ElasticConfig(...)) the JaxTrainer "
+        "reshapes its worker group on node loss/gain (dp mesh shrinks "
+        "or grows), auto-restores from the latest checkpoint with "
+        "broadcast-tree weight delivery, and keeps step accounting "
+        "exact. 0 forces the classic whole-group restart path even "
+        "when an ElasticConfig is present.")
+_define("elastic_poll_s", 0.25,
+        "Driver-side poll period in the elastic training loop: how "
+        "often the trainer checks node events (DRAINING/ALIVE/DEAD) "
+        "and capacity while waiting on worker results. Smaller reacts "
+        "faster to preemption notices at slightly more head traffic.")
+_define("elastic_capacity_timeout_s", 60.0,
+        "How long an elastic fit() waits for cluster capacity to "
+        "reach ElasticConfig.min_workers (initially and after a node "
+        "loss) before giving up and surfacing the failure.")
+_define("elastic_max_reshapes", 16,
+        "Bound on elastic reshapes (node-loss restores + grows) in "
+        "one fit(): a cluster flapping faster than training progresses "
+        "surfaces as an error instead of looping forever.")
+_define("drain_deadline_s", 30.0,
+        "Default drain window for a preemption notice "
+        "(Autoscaler.on_preemption_notice with deadline_s=None): the "
+        "node is released when the drain is acknowledged (elastic "
+        "trainer checkpoint flushed) or this many seconds elapse, "
+        "whichever comes first.")
 _define("scheduler_locality", True,
         "Locality-aware node selection: prefer placing a task on a "
         "feasible node already holding the most argument bytes "

@@ -57,6 +57,11 @@ class RemoteNodeHandle:
         # move via the trace_dump pull) — surfaced by trace_stats
         self.trace_watermark = 0
         self._dead = False
+        # Drain state (r14): head-side routing flag — the agent itself
+        # keeps running so in-flight work finishes and completions
+        # flow; reclaim of its queued backlog goes through the r10
+        # NODE_LEASE_REVOKE machinery (steal_candidates/revoke_lease).
+        self.draining = False
         # ---- delegated bulk-lease dispatch (r10) ----
         # Specs parked for the next NODE_LEASE_BATCH flush. They are
         # ALREADY mirrored in _work (death recovery / cancel see them
@@ -302,6 +307,29 @@ class RemoteNodeHandle:
                     break
         return out
 
+    def queued_task_ids(self, limit: int = 4096) -> list[str]:
+        """Drain-reclaim candidates (r14): every mirrored plain
+        TaskSpec without affinity/PG constraints — the superset of
+        ``steal_candidates`` that also covers specs PUSHED per-task
+        when delegation is off (they sit in ``_work`` too, and the
+        agent handles NODE_LEASE_REVOKE regardless of lease mode).
+        No spill-budget filter: the node is dying, moving is
+        mandatory. The agent-side reclaim still keeps anything
+        already started."""
+        out: list[str] = []
+        with self._lock:
+            for tid, entry in self._work.items():
+                spec = entry[0]
+                if not isinstance(spec, TaskSpec):
+                    continue
+                if (getattr(spec, "node_id", None)
+                        or getattr(spec, "placement_group_id", None)):
+                    continue
+                out.append(tid)
+                if len(out) >= limit:
+                    break
+        return out
+
     def revoke_lease(self, task_ids: list[str]) -> None:
         """Ask the agent to reclaim queued-not-started tasks (lease
         revoke / steal). Fire-and-forget BY DESIGN: the hand-back is
@@ -375,6 +403,11 @@ class RemoteNodeHandle:
     def cancel_running(self, worker_id: str, task_id: str) -> bool:
         return self._send({"type": protocol.NODE_CANCEL_RUNNING,
                            "worker_id": worker_id, "task_id": task_id})
+
+    def set_draining(self, flag: bool = True) -> None:
+        """Head-side drain flag (see __init__); no wire round trip —
+        drain is a routing decision the head alone enforces."""
+        self.draining = bool(flag)
 
     def kill_worker(self, worker_id: str) -> None:
         self._send({"type": protocol.NODE_KILL_WORKER,

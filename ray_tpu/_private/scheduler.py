@@ -184,6 +184,11 @@ class Scheduler:
         self._bundles: dict[tuple, dict] = {}
         self._running = True
         self._spawning = 0
+        # Drain state (r14 preemption notice): a draining node keeps
+        # running what it has but receives no NEW placements — the
+        # cluster's routing (submit/spill/PG planning) skips it and its
+        # queued-not-started backlog is reclaimed via reclaim_tasks.
+        self.draining = False
         # Memory-pressure monitor (reference raylet memory_monitor +
         # worker_killing_policy.cc): injectable for tests.
         self.memory_fraction_fn: Callable[[], float] = \
@@ -863,6 +868,41 @@ class Scheduler:
                 else:
                     unmet.append(need)
             return unmet
+
+    def set_draining(self, flag: bool = True) -> None:
+        """Flip drain state (drain-before-kill, r14). Routing decisions
+        live cluster-side; this flag is what they consult. Dispatch of
+        already-queued work continues — the cluster reclaims what it
+        wants moved via ``reclaim_tasks`` and leaves the rest to finish
+        here before the node is released."""
+        self.draining = bool(flag)
+
+    def queued_task_ids(self, limit: int = 1 << 20) -> list:
+        """Task ids of queued-NOT-(necessarily-)started work on this
+        node: the pending queue plus each worker FIFO's tail (the head
+        entry is likely already executing). The drain path feeds these
+        to ``reclaim_tasks`` — the local-scheduler analogue of the
+        delegated ``steal_candidates`` (r10). Movable work only: actor
+        calls are bound to their actor's worker, and affinity/PG-
+        pinned specs would just be re-routed straight back here."""
+        def _movable(spec) -> bool:
+            return (isinstance(spec, TaskSpec)
+                    and not getattr(spec, "node_id", None)
+                    and not getattr(spec, "placement_group_id", None))
+
+        ids: list = []
+        with self._lock:
+            for spec in self._pending:
+                tid = getattr(spec, "task_id", None)
+                if tid is not None and _movable(spec):
+                    ids.append(tid)
+            for rec in self._workers.values():
+                if rec.state == DEAD:
+                    continue
+                it = iter(rec.tasks.items())
+                next(it, None)
+                ids.extend(tid for tid, spec in it if _movable(spec))
+        return ids[:limit]
 
     def is_idle(self) -> bool:
         """Nothing queued, nothing running, no PG bundles, full

@@ -64,6 +64,19 @@ class NodeProvider:
         slice), or None for single-host nodes."""
         return None
 
+    def on_preemption_notice(self, node_id: str,
+                             deadline_s: Optional[float] = None) -> None:
+        """Cloud preemption warning for `node_id` (GCE preemption
+        notice, TPU queued-resource eviction): forward to the attached
+        autoscaler's drain-before-kill path. Real providers call this
+        from their metadata-watcher/eviction webhook; the node is
+        drained (no new work, queued work reclaimed, trainers flush
+        checkpoints) and terminated on ack or deadline — instead of
+        dying mid-step and costing a lineage-resubmit storm."""
+        asc = getattr(self, "_autoscaler", None)
+        if asc is not None:
+            asc.on_preemption_notice(node_id, deadline_s)
+
     def shutdown(self) -> None:
         pass
 
@@ -184,6 +197,15 @@ class Autoscaler:
         self._thread: Optional[threading.Thread] = None
         self.num_scale_ups = 0
         self.num_scale_downs = 0
+        # Drain-before-kill (r14): node_id -> monotonic deadline. A
+        # preemption notice drains the node (cluster stops routing to
+        # it, trainers flush checkpoints); the update sweep terminates
+        # it on drain-ack or deadline, whichever first. Providers reach
+        # on_preemption_notice through the back-reference below.
+        self._draining: Dict[str, float] = {}
+        self.num_preemption_notices = 0
+        self.num_drained_kills = 0
+        self._provider._autoscaler = self
         # Queue-latency signal (r11, RAY_TPU_AUTOSCALE_QUEUE_LATENCY_S
         # > 0 enables): scale up when the cluster task queue-wait p95
         # over the recent window exceeds the threshold — latency-SLO
@@ -260,8 +282,11 @@ class Autoscaler:
         # could boot, so simulate placement against the other nodes'
         # effective availability before counting a shape as unmet.
         alive_nodes = self._cluster.alive_nodes()
+        # draining nodes can't absorb spillback (routing skips them):
+        # their capacity must not mask demand for replacement hosts
         sim_avail = {n.node_id: dict(n.scheduler.effective_avail())
-                     for n in alive_nodes}
+                     for n in alive_nodes
+                     if not getattr(n, "draining", False)}
         hb_unmet: List[Dict[str, float]] = []
         for node in alive_nodes:
             for shape in node.scheduler.pending_shapes():
@@ -351,20 +376,107 @@ class Autoscaler:
             self._last_latency_scale_up = now
             return
 
+    # ------------------------------------------ preemption drain (r14)
+    def on_preemption_notice(self, node_id: str,
+                             deadline_s: Optional[float] = None) -> None:
+        """The cloud announced `node_id` will be preempted in
+        ~`deadline_s` seconds (RAY_TPU_DRAIN_DEADLINE_S when None).
+        Drain-before-kill: the cluster stops leasing to it and reclaims
+        its queued backlog NOW (r10 revoke machinery), a DRAINING node
+        event tells elastic trainers to flush a checkpoint, and the
+        update sweep releases the node once the drain is acknowledged
+        or the deadline lapses — never before."""
+        from ray_tpu._private.config import CONFIG
+        if deadline_s is None:
+            deadline_s = CONFIG.drain_deadline_s
+        deadline = time.monotonic() + max(0.0, float(deadline_s))
+        # a pod slice preempts ATOMICALLY: terminate_node below deletes
+        # the whole group, so every member must drain now — not just
+        # the host the metadata watcher named
+        group = self._provider.group_of(node_id) or [node_id]
+        drained_any = False
+        for m in group:
+            if self._cluster.drain_node(m, deadline_s=float(deadline_s)):
+                drained_any = True
+        if not drained_any:
+            # head / unknown / already-dead node: nothing was drained,
+            # so nothing may be scheduled for termination either (a
+            # bogus notice must not kill an undrained node at deadline)
+            return
+        self._draining[node_id] = deadline
+        self.num_preemption_notices += 1
+
+    def _drain_sweep(self, now: float) -> None:
+        """Terminate drained nodes (every live member acked, or the
+        deadline lapsed). A group that dies DURING its drain window
+        just drops out of the sweep — the normal death recovery
+        already ran, and keeping the entry would wedge the reconcile
+        loop on a ghost."""
+        for nid, deadline in list(self._draining.items()):
+            # snapshot the group BEFORE terminate_node: slice providers
+            # pop their membership maps on terminate, and the members
+            # must still be cleaned out of _managed afterwards
+            group = self._provider.group_of(nid) or [nid]
+            recs = [self._cluster.get_node(m) for m in group]
+            live = [r for r in recs if r is not None and r.alive]
+            if not live:
+                self._draining.pop(nid, None)
+                continue
+            if not (now >= deadline
+                    or all(getattr(r, "drain_acked", False)
+                           for r in live)):
+                continue
+            try:
+                self._provider.terminate_node(nid)
+            except Exception:
+                # keep the entry: the node is still alive and still
+                # draining cluster-side, so dropping it here would leak
+                # an unschedulable host forever — retry next cycle
+                # (transient cloud-API errors are the common case)
+                import sys
+                sys.stderr.write(f"ray_tpu autoscaler: terminate of "
+                                 f"drained node {nid} failed; will "
+                                 f"retry\n")
+                continue
+            self._draining.pop(nid, None)
+            for m in group:
+                self._managed.pop(m, None)
+                self._idle_since.pop(m, None)
+                self._draining.pop(m, None)
+            self.num_drained_kills += 1
+
     def _fits(self, shape: Dict[str, float],
               resources: Dict[str, float]) -> bool:
         # one feasibility definition for the whole runtime (epsilon'd):
         # scheduler.fits(avail, need)
         return _fits_with_eps(resources, shape)
 
+    def _is_draining(self, node_id: str) -> bool:
+        """Draining per THIS autoscaler's sweep or per the cluster's
+        drain state (covers slice members drained alongside the keyed
+        notice node)."""
+        if node_id in self._draining:
+            return True
+        probe = getattr(self._cluster, "is_draining", None)
+        return bool(probe(node_id)) if probe is not None else False
+
     def _count_type(self, name: str) -> int:
-        return sum(1 for t in self._managed.values() if t == name)
+        # Draining nodes are capacity that is already leaving: they
+        # don't count toward max_workers, so a preempted node's
+        # replacement can launch BEFORE the old host is released
+        # (transiently max_workers + draining hosts exist — the
+        # preemption overlap, not a cap violation).
+        return sum(1 for nid, t in self._managed.items()
+                   if t == name and not self._is_draining(nid))
 
     # ---------------------------------------------------------- update
     def update(self) -> None:
         """One reconcile step (call directly in tests; the background
         loop calls it on update_interval_s)."""
         now = time.monotonic()
+        # preemption drains first: a node past its window must release
+        # this cycle, and dead-mid-drain entries must never wedge below
+        self._drain_sweep(now)
         alive = {n.node_id for n in self._cluster.alive_nodes()}
         # launches leave the in-flight set once the node has
         # REGISTERED with the cluster (alive or since dead — a
@@ -437,6 +549,8 @@ class Autoscaler:
             nid = node.node_id
             if node.is_head or nid not in self._managed:
                 continue
+            if self._is_draining(nid):
+                continue            # the drain sweep owns its release
             if not node.scheduler.is_idle():
                 self._idle_since.pop(nid, None)
                 idle_map[nid] = False
@@ -497,4 +611,7 @@ class Autoscaler:
                 "num_scale_ups": self.num_scale_ups,
                 "num_scale_downs": self.num_scale_downs,
                 "num_latency_scale_ups": self.num_latency_scale_ups,
+                "num_preemption_notices": self.num_preemption_notices,
+                "num_drained_kills": self.num_drained_kills,
+                "draining_nodes": len(self._draining),
                 "last_queue_wait_p95": p95}

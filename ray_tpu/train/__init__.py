@@ -16,11 +16,12 @@ Reference mapping:
 from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxConfig  # noqa: F401
 from ray_tpu.train.checkpoint import (Checkpoint, CheckpointManager,  # noqa: F401
                                       load_pytree, save_pytree)
-from ray_tpu.train.config import (CheckpointConfig, FailureConfig,  # noqa: F401
-                                  PipelineConfig, Result, RunConfig,
-                                  ScalingConfig)
+from ray_tpu.train.config import (CheckpointConfig, ElasticConfig,  # noqa: F401
+                                  FailureConfig, PipelineConfig, Result,
+                                  RunConfig, ScalingConfig)
 from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
                                    get_dataset_shard,
-                                   make_temp_checkpoint_dir, report)
+                                   make_temp_checkpoint_dir, report,
+                                   should_checkpoint)
 from ray_tpu.train.trainer import JaxTrainer  # noqa: F401
 from ray_tpu.train.worker_group import RayTrainWorker, WorkerGroup  # noqa: F401

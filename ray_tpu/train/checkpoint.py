@@ -105,6 +105,39 @@ def _engine(engine: Optional[str]) -> str:
 _ASYNC_CKPTRS: Dict[str, Any] = {}
 
 
+def _publish_dir(staged: str, path: str) -> None:
+    """Atomic checkpoint publication (r14): rename a fully-written
+    staging dir into place so a reader never observes a torn state —
+    a preemption during the WRITE leaves either the previous complete
+    checkpoint or an orphaned ``*.rtpu_tmp*`` dir, never a partial
+    ``path``. Same-directory renames are atomic on POSIX. When `path`
+    already exists the swap itself is two renames, so a vanishingly
+    narrow crash window can leave `path` absent with the previous
+    state parked at ``*.rtpu_old*``; readers (CheckpointManager
+    `latest`) treat a missing dir as unusable and fall back one
+    generation — degraded, never corrupt. The next save sweeps the
+    leftovers (see save_pytree)."""
+    if os.path.exists(path):
+        old = path + ".rtpu_old" + os.path.basename(staged)[-8:]
+        if os.path.exists(old):
+            shutil.rmtree(old, ignore_errors=True)
+        os.rename(path, old)
+        os.rename(staged, path)
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.rename(staged, path)
+
+
+def _sweep_stale_staging(path: str) -> None:
+    """Remove ``*.rtpu_tmp*``/``*.rtpu_old*`` siblings a crashed
+    earlier save left behind for this path (bounds the leak; the
+    content at `path` itself is never touched)."""
+    import glob as _glob
+    for stale in (_glob.glob(path + ".rtpu_tmp*")
+                  + _glob.glob(path + ".rtpu_old*")):
+        shutil.rmtree(stale, ignore_errors=True)
+
+
 def save_pytree(tree: Any, path: str, engine: Optional[str] = None,
                 async_save: bool = False):
     """Persist a pytree under `path` with the chosen engine.
@@ -117,8 +150,8 @@ def save_pytree(tree: Any, path: str, engine: Optional[str] = None,
     eng = _engine(engine)
     if eng not in ("npz", "orbax"):
         raise ValueError(f"unknown checkpoint engine {eng!r}")
-    os.makedirs(path, exist_ok=True)
     if eng == "orbax":
+        os.makedirs(path, exist_ok=True)
         import orbax.checkpoint as ocp
         target = os.path.join(path, "orbax")
         # One AsyncCheckpointer per path, reused: re-saving a path first
@@ -146,20 +179,35 @@ def save_pytree(tree: Any, path: str, engine: Optional[str] = None,
         with open(marker, "w") as f:
             f.write(eng)
         return None
-    with open(os.path.join(path, "engine"), "w") as f:
-        f.write(eng)
-    import jax
-    leaves, treedef = jax.tree.flatten(
-        jax.tree.map(lambda x: np.asarray(x), tree))
-    encoded, tags = [], []
-    for leaf in leaves:
-        e, t = _encode_leaf(leaf)
-        encoded.append(e)
-        tags.append(t)
-    np.savez(os.path.join(path, "leaves.npz"),
-             **{f"leaf_{i}": leaf for i, leaf in enumerate(encoded)})
-    with open(os.path.join(path, "treedef.pkl"), "wb") as f:
-        pickle.dump((treedef, tags), f)
+    # npz engine: write everything into a staging dir, then one rename
+    # publishes it — a preemption mid-save can never leave a torn
+    # "latest" for restore to load (r14 elastic contract).
+    import uuid
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    _sweep_stale_staging(path)
+    staged = f"{path}.rtpu_tmp{uuid.uuid4().hex[:8]}"
+    os.makedirs(staged)
+    try:
+        import jax
+        leaves, treedef = jax.tree.flatten(
+            jax.tree.map(lambda x: np.asarray(x), tree))
+        encoded, tags = [], []
+        for leaf in leaves:
+            e, t = _encode_leaf(leaf)
+            encoded.append(e)
+            tags.append(t)
+        np.savez(os.path.join(staged, "leaves.npz"),
+                 **{f"leaf_{i}": leaf for i, leaf in enumerate(encoded)})
+        with open(os.path.join(staged, "treedef.pkl"), "wb") as f:
+            pickle.dump((treedef, tags), f)
+        # marker last: its presence certifies a complete staging dir
+        with open(os.path.join(staged, "engine"), "w") as f:
+            f.write(eng)
+        _publish_dir(staged, path)
+    except BaseException:
+        shutil.rmtree(staged, ignore_errors=True)
+        raise
     return None
 
 
@@ -266,7 +314,18 @@ class CheckpointManager:
         dest = os.path.join(self.root, f"checkpoint_{self._counter:06d}")
         if os.path.exists(dest):
             shutil.rmtree(dest)
-        unpack_dir(data, dest)
+        # unpack into a staging dir, publish with one rename: a crash
+        # mid-unpack must not leave a torn managed entry that `latest`
+        # would hand to the next restore
+        staged = dest + ".rtpu_tmp"
+        if os.path.exists(staged):
+            shutil.rmtree(staged)
+        try:
+            unpack_dir(data, staged)
+            os.rename(staged, dest)
+        except BaseException:
+            shutil.rmtree(staged, ignore_errors=True)
+            raise
         return self._register_dest(dest, metrics)
 
     def _register_dest(self, dest: str, metrics: Dict) -> Checkpoint:
@@ -290,19 +349,46 @@ class CheckpointManager:
             if os.path.exists(path):
                 shutil.rmtree(path, ignore_errors=True)
 
+    @staticmethod
+    def _usable(path: str) -> bool:
+        """A restorable entry: its directory survived (crash/retention
+        races) and is not a torn write. Entries registered through the
+        staged-rename paths are complete by construction; this guards
+        against external damage (deleted dirs, a pre-atomic save torn
+        by preemption — a `state` dir without its `engine` marker,
+        which save_pytree writes last)."""
+        if not os.path.isdir(path):
+            return False
+        try:
+            if not os.listdir(path):
+                return False
+        except OSError:
+            return False
+        state = os.path.join(path, "state")
+        if os.path.isdir(state) and not os.path.exists(
+                os.path.join(state, "engine")):
+            return False                 # marker is written last
+        return True
+
     @property
     def latest(self) -> Optional[Checkpoint]:
-        if not self._registered:
-            return None
-        return Checkpoint(max(self._registered, key=lambda t: t[1])[2])
+        """Newest USABLE checkpoint — unfinished/corrupt entries are
+        skipped so a preemption mid-save can never feed restore a torn
+        'latest'; falls back to the next-newest survivor."""
+        for _, _, path, _ in sorted(self._registered,
+                                    key=lambda t: -t[1]):
+            if self._usable(path):
+                return Checkpoint(path)
+        return None
 
     @property
     def best(self) -> Optional[Checkpoint]:
-        if not self._registered:
+        usable = [t for t in self._registered if self._usable(t[2])]
+        if not usable:
             return None
-        return Checkpoint(max(self._registered,
-                              key=lambda t: (t[0], t[1]))[2])
+        return Checkpoint(max(usable, key=lambda t: (t[0], t[1]))[2])
 
     def checkpoints(self) -> List[Checkpoint]:
         return [Checkpoint(p) for _, _, p, _ in
-                sorted(self._registered, key=lambda t: t[1])]
+                sorted(self._registered, key=lambda t: t[1])
+                if self._usable(p)]

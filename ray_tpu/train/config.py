@@ -15,6 +15,53 @@ from ray_tpu.parallel.mesh import MeshSpec
 
 
 @dataclasses.dataclass
+class ElasticConfig:
+    """Elastic, preemption-tolerant data-parallel training (r14).
+
+    With ``ScalingConfig(elastic=ElasticConfig(...))``, ``fit()``
+    survives node loss AND gain mid-run: the worker group reshapes (the
+    dp mesh shrinks to the surviving worker count or grows when a
+    replacement host joins), state auto-restores from the latest
+    registered checkpoint — delivered to (re)joining workers through
+    the r8 broadcast tree instead of N head pulls — and step accounting
+    stays exact (a restored run's replayed reports are deduped by step;
+    dataset shards re-split deterministically). On a preemption notice
+    (autoscaler drain) the trainer flushes a checkpoint and
+    acknowledges the drain so the node is released only after state is
+    safe.
+
+    min_workers: reshape floor — below this fit() waits for capacity
+        (RAY_TPU_ELASTIC_CAPACITY_TIMEOUT_S) instead of running with
+        too small a mesh.
+    max_workers: reshape ceiling; 0 = ScalingConfig.num_workers.
+    checkpoint_every_n_steps: cadence the worker loop should honor via
+        ``train.should_checkpoint(step)`` (fires on step n-1, 2n-1, …,
+        plus whenever the trainer requests a flush — drain notices,
+        pre-grow). 0 leaves checkpointing entirely to the user loop,
+        at the cost of replaying from the last user checkpoint on
+        reshape.
+    broadcast_restore: deliver the restore checkpoint via
+        ``ray_tpu.broadcast`` (source serves <= fanout transfers) when
+        remote agents are present; off = every worker pulls from the
+        head.
+    """
+    min_workers: int = 1
+    max_workers: int = 0
+    checkpoint_every_n_steps: int = 1
+    broadcast_restore: bool = True
+
+    def __post_init__(self):
+        if self.min_workers < 1:
+            raise ValueError("min_workers must be >= 1")
+        if self.max_workers and self.max_workers < self.min_workers:
+            raise ValueError(
+                f"max_workers={self.max_workers} < "
+                f"min_workers={self.min_workers}")
+        if self.checkpoint_every_n_steps < 0:
+            raise ValueError("checkpoint_every_n_steps must be >= 0")
+
+
+@dataclasses.dataclass
 class ScalingConfig:
     """How many training workers and what each holds.
 
@@ -34,8 +81,31 @@ class ScalingConfig:
     # pod-slice scheduling, _private/accelerators/tpu.py:334-397).
     topology: Optional[str] = None
     pod_name: Optional[str] = None
+    # Elastic mode (r14): reshape the group on node loss/gain instead
+    # of whole-group restart-in-place; num_workers becomes the DESIRED
+    # world size within [elastic.min_workers, elastic.max_workers].
+    elastic: Optional[ElasticConfig] = None
 
     def __post_init__(self):
+        if self.elastic is not None:
+            # cross-validate against the EFFECTIVE ceiling now (0 means
+            # num_workers): an impossible floor would otherwise surface
+            # only as a misleading capacity timeout at fit() time
+            eff_max = self.elastic.max_workers or self.num_workers
+            if self.elastic.min_workers > eff_max:
+                raise ValueError(
+                    f"elastic.min_workers={self.elastic.min_workers} "
+                    f"exceeds the effective max_workers={eff_max} "
+                    f"(= num_workers when elastic.max_workers is 0)")
+        if self.topology is not None and self.elastic is not None:
+            # A pod slice provisions and dies ATOMICALLY — there is no
+            # per-host shrink to reshape around, and the elastic group
+            # builder has no slice bundle pinning. Fail loudly instead
+            # of silently dropping the slice placement.
+            raise ValueError(
+                "elastic= is not supported with topology= (a pod "
+                "slice preempts atomically; run elastic across "
+                "single-host node types instead)")
         if self.topology is not None:
             from ray_tpu._private.accelerators.tpu import num_hosts
             hosts = num_hosts(self.topology)

@@ -56,7 +56,8 @@ class _TrainSession:
     def __init__(self, fn: Callable, config: Dict[str, Any],
                  context: TrainContext,
                  restore_checkpoint: Optional[Checkpoint],
-                 dataset_shards: Optional[Dict[str, Any]] = None):
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 ckpt_every: int = 0):
         self.context = context
         self.restore_checkpoint = restore_checkpoint
         self.dataset_shards = dataset_shards or {}
@@ -66,6 +67,12 @@ class _TrainSession:
         self._consumed = threading.Semaphore(0)
         self._done = False
         self._error: Optional[BaseException] = None
+        # Elastic checkpoint cadence (r14): the loop asks
+        # should_checkpoint(step) and saves on ElasticConfig's
+        # every-n-steps schedule plus whenever the trainer requested a
+        # flush (preemption drain, pre-grow reshape).
+        self.ckpt_every = int(ckpt_every)
+        self._ckpt_requested = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
     def start(self):
@@ -87,8 +94,22 @@ class _TrainSession:
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None):
+        if checkpoint is not None:
+            self._ckpt_requested.clear()   # flush satisfied
         self._results.put((metrics, checkpoint))
         self._consumed.acquire()  # block until driver drains (parity)
+
+    def request_checkpoint(self) -> None:
+        """Driver-side flush request (drain notice / pre-grow): the
+        next should_checkpoint() returns True until a report carries a
+        checkpoint."""
+        self._ckpt_requested.set()
+
+    def should_checkpoint(self, step: Optional[int] = None) -> bool:
+        if self._ckpt_requested.is_set():
+            return True
+        n = self.ckpt_every
+        return bool(n and step is not None and (int(step) + 1) % n == 0)
 
     def next_result(self, timeout: Optional[float] = None):
         """Driver side: (metrics, checkpoint) | None when finished."""
@@ -121,6 +142,19 @@ def report(metrics: Dict[str, Any],
     if _session is None:
         return  # no-op outside a session, like the reference's local mode
     _session.report(metrics, checkpoint)
+
+
+def should_checkpoint(step: Optional[int] = None) -> bool:
+    """Elastic checkpoint cadence (r14): True on the ElasticConfig
+    every-n-steps schedule (step counts from 0; fires at n-1, 2n-1, …)
+    and whenever the trainer requested a flush (preemption drain,
+    pre-grow reshape). SPMD loops should key the save on the step so
+    every rank reaches the save collective together — the flush request
+    lands on all ranks but is only exact at step granularity. Always
+    False outside a session."""
+    if _session is None:
+        return False
+    return _session.should_checkpoint(step)
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

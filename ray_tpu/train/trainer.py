@@ -73,6 +73,14 @@ class JaxTrainer:
         if self._pipeline_stages > 1:
             from ray_tpu.train.pipeline import fit_pipeline
             return fit_pipeline(self)
+        if self._scaling.elastic is not None:
+            from ray_tpu._private.config import CONFIG
+            if CONFIG.elastic:
+                # Elastic mode (r14): reshape on node loss/gain with
+                # auto-restore from the latest checkpoint instead of
+                # the fixed-size whole-group restart loop below.
+                from ray_tpu.train.elastic import fit_elastic
+                return fit_elastic(self)
         return self._fit_data_parallel()
 
     def _fit_data_parallel(self) -> Result:

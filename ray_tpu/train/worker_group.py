@@ -36,6 +36,11 @@ class RayTrainWorker:
     def get_address(self) -> str:
         return socket.gethostbyname(socket.gethostname())
 
+    def node_id(self) -> Optional[str]:
+        """The cluster node hosting this worker (the elastic trainer
+        maps DRAINING/DEAD node events onto group members with this)."""
+        return os.environ.get("RAY_TPU_NODE_ID")
+
     def find_free_port(self) -> int:
         with socket.socket() as s:
             s.bind(("", 0))
@@ -49,7 +54,8 @@ class RayTrainWorker:
     # --------------------------------------------------------- training
     def init_session(self, fn_bytes: bytes, config: Dict[str, Any],
                      restore_bytes: Optional[bytes],
-                     datasets_bytes: Optional[bytes] = None) -> None:
+                     datasets_bytes: Optional[bytes] = None,
+                     ckpt_every: int = 0) -> None:
         fn = cloudpickle.loads(fn_bytes)
         ctx = TrainContext(
             world_rank=self._rank, world_size=self._world_size,
@@ -68,8 +74,15 @@ class RayTrainWorker:
         shards = (cloudpickle.loads(datasets_bytes)
                   if datasets_bytes else None)
         self._session = _TrainSession(fn, config, ctx, restore,
-                                      dataset_shards=shards)
+                                      dataset_shards=shards,
+                                      ckpt_every=ckpt_every)
         self._session.start()
+
+    def request_checkpoint(self) -> None:
+        """Elastic flush request (drain notice / pre-grow): the user
+        loop's next should_checkpoint() returns True."""
+        if self._session is not None:
+            self._session.request_checkpoint()
 
     def next_result(self):
         """(metrics, checkpoint_tar_bytes|None) or None at loop end.
@@ -164,19 +177,24 @@ class WorkerGroup:
         ray_tpu.get([w.ping.remote() for w in self.workers], timeout=60)
 
     def shutdown(self) -> None:
-        for w in self.workers:
+        """Idempotent, dead-actor-tolerant teardown. The post-chaos
+        state — workers already dead with their node, the PG already in
+        RESCHEDULING, a previous shutdown() half-done — must neither
+        raise nor hang: every step is best-effort and state is detached
+        up front so a re-entrant call is a no-op."""
+        workers, self.workers = self.workers, []
+        pg, self._pg = self._pg, None
+        for w in workers:
             try:
                 ray_tpu.kill(w)
-            except Exception:
-                pass
-        self.workers = []
-        if self._pg is not None:
+            except BaseException:
+                pass                # already dead / node gone
+        if pg is not None:
             from ray_tpu.util.placement_group import remove_placement_group
             try:
-                remove_placement_group(self._pg)
-            except Exception:
+                remove_placement_group(pg)
+            except BaseException:
                 pass
-            self._pg = None
 
     # ------------------------------------------------------------ fanout
     def run_on_all(self, fn: Callable, *args, **kwargs) -> List[Any]:

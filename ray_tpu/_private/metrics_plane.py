@@ -149,6 +149,11 @@ class _RuntimeMetrics:
         self.delegate = g("ray_tpu_delegate",
                           "Agent-side delegated-lease counters",
                           ("counter",))
+        self.head_wal = g("ray_tpu_head_wal",
+                          "Head-HA WAL telemetry (r15): wal_bytes/"
+                          "records/fsyncs, fsync_p99_ms, compactions, "
+                          "last_snapshot_age_s, replayed/deduped "
+                          "completion counts", ("counter",))
 
 
 _mx: Optional[_RuntimeMetrics] = None

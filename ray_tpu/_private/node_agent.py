@@ -175,10 +175,26 @@ class NodeAgent:
         # worker_lost / refcount ordering is preserved)
         self._done_buf: list = []
         self._done_lock = threading.Lock()
+        # counts TASK_DONE handlers in flight between their ledger pops
+        # (scheduler FIFO / lease table) and their done-buffer park:
+        # the rejoin report waits for 0 so a completing task can never
+        # be invisible to every scan at once (it would be re-placed
+        # and run twice)
+        self._done_guard = 0
+        self._done_cv = threading.Condition(self._done_lock)
         self._done_flusher = protocol.FlushLoop(
             self._flush_done_buf,
             lambda: _CFG.delegate_done_delay_ms,
             "rtpu-agent-done-flush")
+        # r15 head HA: ring of recently SENT completion entries. A
+        # batch can be TCP-delivered yet never processed by a dying
+        # head, so on rejoin the tail of this ring (entries younger
+        # than the outage minus RAY_TPU_HEAD_DONE_REPLAY_WINDOW_S) is
+        # replayed — the head dedups against its rehydrated mirror,
+        # making a head restart exactly-once instead of lossy.
+        self._done_sent: _collections.deque = _collections.deque(
+            maxlen=4096)
+        self._head_lost_at: Optional[float] = None
         # ---- N10 heartbeat delta-sync ----
         self._hb_seq = 0
         self._hb_last_norm: Optional[dict] = None
@@ -239,6 +255,8 @@ class NodeAgent:
         self._pull_server.on_conn_closed(conn)
         if self._stop.is_set():
             return
+        if self._head_lost_at is None:
+            self._head_lost_at = time.monotonic()
         window = _CFG.agent_reconnect_window_s
         if window <= 0:
             # Orphaned agent: the head is the only control plane — exit.
@@ -282,6 +300,7 @@ class NodeAgent:
             # instant it processes the register, and completions must go
             # out on the new connection, not the dead one.
             self.head = conn
+            replay = self._replay_done_entries()
             try:
                 rep = conn.request(
                     {"type": protocol.NODE_REGISTER,
@@ -291,10 +310,23 @@ class NodeAgent:
                      "max_workers": self._max_workers,
                      "rejoin": True,
                      "live_actors": self.scheduler.live_actors(),
-                     "objects": self.store.held_objects()},
+                     "objects": self.store.held_objects(),
+                     # r15: every task id this agent still owes the
+                     # head (queued, running, leased, or with a
+                     # completion in flight) — a restarted head
+                     # re-places ONLY mirrored tasks absent from this
+                     # set (they never arrived here)
+                     "inflight_tasks": self._inflight_task_ids(replay)},
                     timeout=30.0)
                 if rep.get("node_id") != self.node_id:
                     raise RuntimeError("rejoin refused")
+                # Replay possibly-unprocessed sent completions FIRST
+                # (they predate everything in the outage buffer); the
+                # head dedups re-processed entries by the mirror pop.
+                if replay:
+                    conn.send({"type": protocol.NODE_TASK_DONE_BATCH,
+                               "node_id": self.node_id, "done": replay,
+                               "replayed": True})
             except BaseException:
                 try:
                     conn.close()
@@ -350,12 +382,75 @@ class NodeAgent:
             if flush_failed:
                 continue
             sys.stderr.write(f"ray_tpu node_agent {self.node_id}: "
-                             f"rejoined head ({flushed} events + "
-                             f"{len(relays)} requests replayed)\n")
+                             f"rejoined head ({len(replay)} sent "
+                             f"completions replayed, {flushed} events + "
+                             f"{len(relays)} requests flushed)\n")
+            self._head_lost_at = None
+            # marker AFTER the buffered backlog (connection FIFO): the
+            # head defers its mirror reconcile until this arrives, so
+            # buffered completions pop their mirror entries before any
+            # resubmit decision is made
+            try:
+                conn.send({"type": protocol.NODE_EVENT,
+                           "kind": "rejoin_drained",
+                           "node_id": self.node_id})
+            except protocol.ConnectionClosed:
+                pass
             for wconn, msg in relays:
                 if not wconn.closed:
                     self._relay_to_head(wconn, msg)
             return
+
+    def _replay_done_entries(self) -> list:
+        """Sent completion entries from just before the outage (the
+        at-risk tail: delivered-but-maybe-unprocessed)."""
+        window = _CFG.head_done_replay_window_s
+        lost_at = self._head_lost_at
+        if window <= 0 or lost_at is None:
+            return []
+        cutoff = lost_at - window
+        with self._done_lock:
+            return [e for ts, e in self._done_sent if ts >= cutoff]
+
+    def _inflight_task_ids(self, replay: list) -> list:
+        """Every task id still on this agent's books at rejoin time:
+        leased/queued/running tasks, completions parked in the batch
+        window, completions buffered through the outage, and the
+        replay tail. The rehydrated head keeps these mirrored; the
+        rest of its mirror re-places."""
+        # Scan in the direction tasks MOVE (FIFO/lease ledgers ->
+        # guard region -> done buffer): a task popped from the ledgers
+        # before the first scan has a guard-counted handler in flight,
+        # and the guard-wait below guarantees its done entry is parked
+        # before the buffer snapshot — so a completing task is always
+        # visible to at least one scan. (Holding _done_lock across the
+        # scheduler scan instead would ABBA against dispatch, which
+        # sends events — and thus flushes the done buffer — under the
+        # scheduler lock.)
+        ids = set(self.scheduler.known_task_ids())
+        with self._lease_lock:
+            ids.update(self._lease_of)
+        with self._done_lock:
+            deadline = time.monotonic() + 2.0
+            while self._done_guard and time.monotonic() < deadline:
+                self._done_cv.wait(0.1)
+            ids.update(e.get("task_id") for e in self._done_buf)
+        ids.update(e.get("task_id") for e in replay)
+        with self._reconnect_lock:
+            pending = list(self._pending_sends)
+        for m in pending:
+            t = m.get("type")
+            if t == protocol.NODE_TASK_DONE_BATCH:
+                ids.update(e.get("task_id") for e in m.get("done", ()))
+            elif t == protocol.NODE_TASK_DONE:
+                ids.add(m.get("task_id"))
+            elif t == protocol.NODE_EVENT \
+                    and m.get("kind") == "lease_reclaimed":
+                # reclaimed specs ride back as an event: the head
+                # re-places them from it — not lost, not resubmittable
+                ids.update(s.task_id for s in m.get("specs", ()))
+        ids.discard(None)
+        return list(ids)
 
     def _buffer_relay(self, conn, msg: dict, depth: int = 0) -> bool:
         """Queue a worker request for replay after the head comes back;
@@ -752,6 +847,11 @@ class NodeAgent:
                 return
             batch, self._done_buf = self._done_buf, []
             self._delegate_stats["done_batches"] += 1
+            # retain what we are about to SEND (r15): the rejoin replay
+            # re-ships the pre-outage tail of this ring, covering the
+            # delivered-but-never-processed window of a dying head
+            now = time.monotonic()
+            self._done_sent.extend((now, e) for e in batch)
         self._send_to_head({"type": protocol.NODE_TASK_DONE_BATCH,
                             "node_id": self.node_id, "done": batch},
                            _flush_done=False)
@@ -944,6 +1044,17 @@ class NodeAgent:
 
     # -------------------------------------------------- task completion
     def _on_task_done(self, conn: protocol.Connection, msg: dict) -> None:
+        with self._done_lock:
+            self._done_guard += 1
+        try:
+            self._on_task_done_inner(conn, msg)
+        finally:
+            with self._done_lock:
+                self._done_guard -= 1
+                self._done_cv.notify_all()
+
+    def _on_task_done_inner(self, conn: protocol.Connection,
+                            msg: dict) -> None:
         worker_id = conn.meta.get("worker_id", "")
         results: list[StoredObject] = msg.get("results", [])
         inline: list[StoredObject] = []

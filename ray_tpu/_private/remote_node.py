@@ -32,8 +32,13 @@ _RPC_TIMEOUT = 30.0
 class RemoteNodeHandle:
     def __init__(self, node_id: str, conn: protocol.Connection,
                  resources: dict[str, float],
-                 advertise_addr: tuple[str, int]):
+                 advertise_addr: tuple[str, int],
+                 wal_log=None):
         self.node_id = node_id
+        # Head-HA WAL hook (r15): mirror adds + lease grants are
+        # logged so a restarted head rehydrates this node's routed
+        # work; None when head persistence is off.
+        self._wal = wal_log
         self.conn = conn
         self.advertise_addr = advertise_addr
         self.total = dict(resources)
@@ -199,6 +204,11 @@ class RemoteNodeHandle:
     def enqueue(self, spec) -> None:
         with self._lock:
             self._work[self._key(spec)] = (spec, False)
+            if self._wal is not None and isinstance(spec, TaskSpec):
+                # the spec itself rides the task-submit record; this
+                # marks WHERE it was routed (actor routing is derived
+                # from the actor table at recovery instead)
+                self._wal("madd", (self.node_id, spec.task_id))
         if isinstance(spec, TaskSpec) and self.delegates():
             self._park_lease(spec)
             return
@@ -260,6 +270,9 @@ class RemoteNodeHandle:
             lease_id = "ls_" + uuid.uuid4().hex[:12]
             self._leases_sent += 1
             self._tasks_leased += len(batch)
+            if self._wal is not None:
+                self._wal("lease",
+                          (self.node_id, [s.task_id for s in batch]))
         if _tp.enabled():
             # one tiny "lease_batch" span per traced spec, spliced
             # between the driver's submit span and the agent-side
@@ -473,6 +486,17 @@ class RemoteNodeHandle:
         restart it if this agent dies."""
         with self._lock:
             self._work["actor:" + actor_id] = (spec, True)
+
+    def adopt_mirror(self, work: dict, leased) -> None:
+        """Inherit mirrored work from a predecessor handle (r15): the
+        rehydrated mirror of a pre-restart head, or the live mirror of
+        the handle this re-registration replaces (a transient agent
+        reconnect used to discard it — completions then popped
+        nothing and accounting silently degraded)."""
+        with self._lock:
+            for key, entry in work.items():
+                self._work.setdefault(key, entry)
+            self._leased.update(leased)
 
     def on_worker_lost(self, worker_id: str) -> None:
         with self._lock:

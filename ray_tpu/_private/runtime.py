@@ -108,6 +108,17 @@ class Runtime(_context.BaseContext):
         self._shutdown = False
         self._actor_states: dict[str, _ActorState] = {}
         self._actor_lock = threading.Lock()
+        # Head HA (r15): persistence coordinator (WAL + snapshots) and
+        # the per-node reconcile state deferred until each rejoining
+        # agent's outage backlog has drained. Set early: the cluster
+        # consults _ha when it builds RemoteNodeHandles.
+        self._ha = None
+        self._pending_reconcile: dict[str, tuple] = {}
+        # serializes snapshot publication: the periodic loop, manual
+        # snapshot_now calls, and WAL compaction share one tmp/.prev
+        # rotation chain — concurrent writers would rename each
+        # other's files out from underneath
+        self._snapshot_lock = threading.Lock()
 
         if num_cpus is None:
             num_cpus = float(max(os.cpu_count() or 1, 4))
@@ -137,9 +148,16 @@ class Runtime(_context.BaseContext):
 
         from ray_tpu._private.cluster import ClusterTaskManager
         self.cluster = ClusterTaskManager(self)
+        # The accept loop starts only AFTER head persistence has
+        # rehydrated (end of __init__): an agent re-registering against
+        # half-restored tables would miss its parked mirror and its
+        # live-actor re-attachment, and a registration processed before
+        # the WAL activates would never be logged — the reference GCS
+        # likewise serves no RPCs until gcs_init_data has loaded.
+        # connect() still succeeds meanwhile (the listener is bound,
+        # backlog holds the handshake).
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ray-tpu-accept", daemon=True)
-        self._accept_thread.start()
         head = self.cluster.add_node(node_res, max_workers=max_workers,
                                      is_head=True,
                                      labels=self._head_labels)
@@ -171,18 +189,49 @@ class Runtime(_context.BaseContext):
         self.metrics = _mp.ClusterCollector(self)
         _mp.set_sampler("head", self._sample_metrics)
         self._init_head_persistence()
+        self._accept_thread.start()
 
     # ================= head fault tolerance =================
     def _init_head_persistence(self) -> None:
         """Reference GCS persistence (gcs_server_main.cc:26-33 storage
         backend + gcs_init_data.cc rehydration): when
         RAY_TPU_HEAD_SNAPSHOT_PATH is set, restore controller tables
-        from the snapshot if one exists, then snapshot periodically."""
+        from disk, then keep them durable. With the r15 WAL
+        (RAY_TPU_HEAD_WAL, default on) every state-mutating event is
+        group-commit logged and snapshots are taken by compaction, so
+        a restarted head rehydrates to the exact pre-crash frontier;
+        RAY_TPU_HEAD_WAL=0 reverts to the 1 Hz snapshot-only mode."""
         from ray_tpu._private.config import CONFIG as _CFG
         self._snapshot_path = _CFG.head_snapshot_path or None
         if self._snapshot_path is None:
             return
-        if os.path.exists(self._snapshot_path):
+        if _CFG.head_wal:
+            from ray_tpu._private.head_ha import HeadPersistence
+            self._ha = HeadPersistence(
+                self._snapshot_path,
+                _CFG.head_wal_path or (self._snapshot_path + ".wal"),
+                fsync_ms=_CFG.head_wal_fsync_ms,
+                compact_bytes=_CFG.head_wal_compact_bytes,
+                compact_interval_s=_CFG.head_wal_compact_interval_s)
+            try:
+                self._rehydrate(self._snapshot_path)
+            except Exception:
+                log.exception("head state restore failed; "
+                              "starting with empty tables")
+            # live logging starts only after replay: the controller
+            # methods replay drives must not re-log their own input
+            self._ha.activate()
+            self.controller.ha = self._ha
+            try:
+                # immediate post-recovery snapshot: everything restored
+                # (and anything registered before activation) is durable
+                # from the first second, and the WAL restarts from a
+                # fresh frontier instead of re-replaying the old tail
+                # on the next crash
+                self.snapshot_now()
+            except Exception:
+                log.exception("post-recovery snapshot failed")
+        elif os.path.exists(self._snapshot_path):
             try:
                 self._rehydrate(self._snapshot_path)
             except Exception:
@@ -195,6 +244,17 @@ class Runtime(_context.BaseContext):
 
     def _snapshot_loop(self) -> None:
         from ray_tpu._private.config import CONFIG as _CFG
+        if self._ha is not None:
+            # WAL mode: snapshots happen at compaction (size/age
+            # triggered), not on a timer — the WAL carries everything
+            # in between
+            while not self._shutdown:
+                time.sleep(1.0)
+                try:
+                    self._ha.maybe_compact(self.snapshot_now)
+                except Exception:
+                    log.exception("head WAL compaction failed")
+            return
         period = max(0.1, _CFG.head_snapshot_period_s)
         while not self._shutdown:
             time.sleep(period)
@@ -203,25 +263,117 @@ class Runtime(_context.BaseContext):
             except Exception:
                 log.exception("head snapshot failed")
 
+    def _mirror_tables(self) -> dict:
+        """Snapshot extra: every remote node's spec mirror + lease
+        ledger (live proxies), merged with mirrors still parked for
+        nodes that have not rejoined yet — a compaction during the
+        rejoin grace window must not drop their work."""
+        mirrors: dict = {}
+        for n in self.cluster.alive_nodes():
+            h = n.scheduler
+            if not hasattr(h, "_work") or not hasattr(h, "_leased"):
+                continue                     # in-process local node
+            with h._lock:
+                mirrors[n.node_id] = {"work": dict(h._work),
+                                      "leased": list(h._leased)}
+        if self._ha is not None:
+            for nid, m in self._ha.pending_mirrors().items():
+                mirrors.setdefault(nid, m)
+        return mirrors
+
     def snapshot_now(self) -> None:
-        """Atomic controller snapshot to disk (tmp + rename)."""
+        """Atomic, torn-write-proof controller snapshot: the blob is
+        version+checksum framed, flushed+fsynced BEFORE the rename
+        (a crash after a bare rename could publish a partially-written
+        file), and the previous snapshot is kept as ``.prev`` so a
+        corrupt blob falls back instead of zeroing the tables."""
         if self._snapshot_path is None or self._shutdown:
             return
-        blob = self.controller.snapshot_state()
-        tmp = self._snapshot_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, self._snapshot_path)
+        from ray_tpu._private import head_ha as _hha
+        # mirrors are captured AFTER the frontier (extra_fn contract):
+        # a task routed in the gap is either in the capture or in a
+        # replayed madd record, never in neither
+        blob = self.controller.snapshot_state(
+            extra_fn=lambda: {"_node_mirrors": self._mirror_tables()})
+        with self._snapshot_lock:
+            if self._ha is not None:
+                self._ha.write_snapshot(blob)
+            else:
+                _hha.write_snapshot_file(self._snapshot_path, blob)
+
+    def _load_snapshot_blob(self, path: str):
+        """Newest intact snapshot blob (current file, else ``.prev``),
+        or None when neither verifies."""
+        from ray_tpu._private import head_ha as _hha
+        if self._ha is not None:
+            return self._ha.load_snapshot()
+        return _hha.load_snapshot_file(path)[0]
 
     def _rehydrate(self, path: str) -> None:
-        """Restore controller tables, then reconcile: agents recorded
-        alive get a rejoin grace window; actors whose node died with the
-        old head (head-local workers, unknown nodes) are restarted
-        through the normal recovery machinery."""
+        """Restore controller tables (snapshot + WAL tail when the WAL
+        is on), park each agent's rehydrated spec mirror until it
+        rejoins, then reconcile: agents recorded alive get a rejoin
+        grace window; actors whose node died with the old head
+        (head-local workers, unknown nodes) restart through the normal
+        recovery machinery; live tasks mirrored to NO node (they were
+        queued or running on the old head's own workers, which died
+        with it) re-place immediately."""
         from ray_tpu._private.config import CONFIG as _CFG
-        with open(path, "rb") as f:
-            blob = f.read()
-        self.controller.restore_state(blob)
+        from ray_tpu._private.specs import TaskSpec as _TaskSpec
+        blob = self._load_snapshot_blob(path)
+        state: dict = {}
+        frontier = 0
+        if blob is not None:
+            state = self.controller.restore_state(blob)
+            frontier = int(state.get("_wal_seq", 0))
+        snap_mirrors = state.get("_node_mirrors") or {}
+        mirrors: dict = {nid: dict(m.get("work", {}))
+                         for nid, m in snap_mirrors.items()}
+        leases: dict = {nid: set(m.get("leased", ()))
+                        for nid, m in snap_mirrors.items()}
+        if self._ha is not None:
+            tail = self._ha.wal_tail()
+            # seed the sequence counter past EVERYTHING recovered: new
+            # records appended to the same segment must sort after the
+            # old process's records and above the snapshot frontier, or
+            # a second crash replays them wrong (skipped or clobbered
+            # by stale state)
+            self._ha.wal.advance_seq(
+                max([frontier] + [r[0] for r in tail]))
+            if blob is None and not tail:
+                return                       # genuinely fresh start
+            self._ha.replay(self.controller, tail, frontier,
+                            mirrors, leases)
+        elif blob is None:
+            return
+        # Resolve mirror entries: WAL-replayed adds carry only the key
+        # (the spec rides the task-submit record); entries whose task
+        # is no longer live completed before the crash — drop them so
+        # a replayed completion dedups and a reconcile cannot
+        # double-place finished work.
+        live_ids = set(self.controller.live_task_ids())
+        mirrored_live: set[str] = set()
+        for nid in list(mirrors):
+            resolved: dict = {}
+            for key, entry in mirrors[nid].items():
+                if isinstance(entry, tuple):
+                    spec, dispatched = entry
+                else:                        # WAL "madd": key only
+                    spec, dispatched = (
+                        self.controller.live_task(key), False)
+                if spec is None or not isinstance(spec, _TaskSpec):
+                    continue                 # done, or an actor entry
+                if spec.task_id not in live_ids:
+                    continue
+                resolved[key] = (spec, bool(dispatched))
+                mirrored_live.add(spec.task_id)
+            if resolved and self._ha is not None:
+                self._ha.park_node(nid, resolved,
+                                   set(leases.get(nid, ()))
+                                   & set(resolved))
+        if self._ha is not None:
+            self._ha.restored_task_ids = set(mirrored_live)
+            self._ha.recovered["live_tasks"] = len(live_ids)
         rejoining: set[str] = set()
         for n in self.controller.list_nodes():
             if n["is_head"] or not n["alive"]:
@@ -239,15 +391,54 @@ class Runtime(_context.BaseContext):
             # worker died with the old head: normal restart bookkeeping
             rec.worker_id = None
             self._recover_actor(rec.spec.actor_id)
-        log.info("head rehydrated from %s: %d actors, %d nodes pending "
+        # Live tasks owned by the dead head's own node: nothing will
+        # ever complete them — re-place now (no retry budget consumed:
+        # the head's death is not the task's failure, r10 agent-death
+        # resubmit semantics).
+        resubmitted = 0
+        for tid in live_ids:
+            if tid in mirrored_live:
+                continue                # an agent still owes this task
+            spec = self.controller.live_task(tid)
+            if spec is None:
+                continue
+            self.controller.record_task_event(
+                tid, getattr(spec, "name", ""), "RESUBMITTED",
+                error="head restart")
+            try:
+                self.cluster.submit(spec)
+                resubmitted += 1
+            except Exception:
+                log.exception("head-restart resubmit of %s failed", tid)
+        if self._ha is not None:
+            self._ha.recovered["resubmitted"] = resubmitted
+        log.info("head rehydrated from %s: %d actors, %d live tasks "
+                 "(%d mirrored, %d resubmitted), %d nodes pending "
                  "rejoin", path, len(self.controller.list_actors()),
+                 len(live_ids), len(mirrored_live), resubmitted,
                  len(rejoining))
 
     def _process_rejoin(self, rec, msg: dict) -> None:
         """An agent re-registered after a head restart (or reconnect):
-        re-attach its live actors and re-learn its object copies."""
+        re-attach its live actors, re-learn its object copies, and
+        hand its rehydrated spec mirror to the fresh proxy. The
+        mirror RECONCILE (re-placing mirrored tasks absent from the
+        agent's reported in-flight set) is deferred until the agent's
+        ``rejoin_drained`` marker — its buffered completions must pop
+        their mirror entries first, or a just-finished task would be
+        re-placed and run twice."""
         proxy = rec.scheduler
         node_id = rec.node_id
+        pend = (self._ha.take_pending_node(node_id)
+                if self._ha is not None else None)
+        if pend is not None:
+            from ray_tpu._private.specs import TaskSpec as _TaskSpec
+            task_work = {k: v for k, v in pend.work.items()
+                         if isinstance(v[0], _TaskSpec)}
+            proxy.adopt_mirror(task_work, pend.leased & set(task_work))
+            known = msg.get("inflight_tasks")
+            self._pending_reconcile[node_id] = (
+                set(task_work), None if known is None else set(known))
         for oid, nbytes in msg.get("objects", ()):
             self.controller.add_location(oid, node_id, nbytes)
             self.waiters.notify(oid)
@@ -275,6 +466,53 @@ class Runtime(_context.BaseContext):
         for actor_id in self.controller.actors_on_node(node_id):
             if actor_id not in reported:
                 self._recover_actor(actor_id)
+
+    def _reconcile_node_mirror(self, node_id: str) -> None:
+        """Post-rejoin lease-ledger resync (r15): of the RESTORED
+        mirror entries (and only those — work enqueued after the
+        rejoin is untouched), entries the agent did not report as
+        in-flight never reached it (lost lease batch / parked lease
+        buffer) — re-place them exactly once; entries whose task is no
+        longer live completed while the backlog drained — drop them.
+        Runs after the agent's ``rejoin_drained`` marker so buffered
+        completions have already popped their mirror entries."""
+        st = self._pending_reconcile.pop(node_id, None)
+        if st is None:
+            return
+        restored_keys, known = st
+        if known is None:
+            return          # agent predates the report: keep mirrored
+        rec = self.cluster.get_node(node_id)
+        if rec is None or not rec.alive:
+            return          # node death recovery already ran
+        proxy = rec.scheduler
+        resubmit = []
+        with proxy._lock:
+            for key in restored_keys:
+                entry = proxy._work.get(key)
+                if entry is None or key in known:
+                    continue
+                if self.controller.live_task(key) is None:
+                    # completed during the drain: off the books
+                    proxy._work.pop(key, None)
+                    proxy._leased.discard(key)
+                    continue
+                proxy._work.pop(key, None)
+                proxy._leased.discard(key)
+                resubmit.append(entry[0])
+        for spec in resubmit:
+            self.controller.record_task_event(
+                spec.task_id, spec.name, "RESUBMITTED",
+                error=f"lease lost in head restart ({node_id})")
+            try:
+                self.cluster.submit(spec)
+            except Exception:
+                log.exception("lease-resync resubmit failed")
+        if resubmit and self._ha is not None:
+            self._ha.recovered["resubmitted"] += len(resubmit)
+        if resubmit:
+            log.info("head HA: re-placed %d task(s) whose lease never "
+                     "reached %s", len(resubmit), node_id)
 
     @property
     def scheduler(self):
@@ -784,6 +1022,14 @@ class Runtime(_context.BaseContext):
             holder = msg.get("holder")
             if holder:
                 self.controller.remove_location(msg["object_id"], holder)
+        elif kind == "rejoin_drained":
+            # the rejoining agent's outage backlog has fully flushed
+            # (connection FIFO): safe to reconcile its restored mirror.
+            # Off the reader thread — resubmits may fan out RPCs.
+            threading.Thread(
+                target=self._reconcile_node_mirror,
+                args=(msg["node_id"],),
+                name="rtpu-ha-reconcile", daemon=True).start()
         elif kind == "actor_task_undeliverable":
             # the agent couldn't hand the pushed task to its worker
             # (worker died in the gap): requeue unless recovery already
@@ -822,14 +1068,20 @@ class Runtime(_context.BaseContext):
         is unchanged."""
         node_id = msg["node_id"]
         proxy = self._proxy_for(node_id)
+        # r15: a rejoining agent re-ships the sent-but-maybe-never-
+        # processed tail of its completion ring; entries the old head
+        # DID process dedup against the rehydrated mirror below
+        replayed = bool(msg.get("replayed"))
         for entry in msg.get("done", ()):
             t_tr = _tp.recv_t0(entry)
             try:
-                self._apply_node_done(node_id, proxy, entry)
+                self._apply_node_done(node_id, proxy, entry,
+                                      replayed=replayed)
             finally:
                 self._record_done(entry, t_tr)
 
-    def _apply_node_done(self, node_id: str, proxy, msg: dict) -> None:
+    def _apply_node_done(self, node_id: str, proxy, msg: dict,
+                         replayed: bool = False) -> None:
         for stored in msg.get("inline", []):
             self._seal_contained(stored.object_id, stored.contained_ids)
             self.store.put_stored(stored)
@@ -882,6 +1134,13 @@ class Runtime(_context.BaseContext):
                                               state, worker_id=worker_id)
             return
         spec = proxy.on_finished(task_id) if proxy is not None else None
+        if replayed and self._ha is not None:
+            # exactly-once accounting across the restart: a replayed
+            # entry whose mirror pop hit counts as a recovered
+            # completion; an empty pop means the pre-crash head (or an
+            # earlier copy of this entry) already processed it
+            self._ha.note_replayed_completion(task_id,
+                                              deduped=spec is None)
         if spec is not None:
             self._unpin(spec.pinned_refs)
             _mp.observe_task_done(spec, node_id)
@@ -1279,6 +1538,27 @@ class Runtime(_context.BaseContext):
         pm = self._pull_mgr.stats()
         m.pull_inflight.set(pm["inflight"])
         m.pull_inflight_bytes.set(pm["inflight_bytes"])
+        if self._ha is not None:
+            # r15 head-HA gauges: WAL volume, fsync tail latency,
+            # snapshot staleness, replayed-completion accounting
+            st = self._ha.stats()
+            wal = st["wal"]
+            rows = [({"counter": "wal_bytes"}, float(wal["bytes"])),
+                    ({"counter": "wal_records"}, float(wal["records"])),
+                    ({"counter": "wal_fsyncs"}, float(wal["fsyncs"])),
+                    ({"counter": "compactions"},
+                     float(wal["compactions"])),
+                    ({"counter": "replayed_completions"}, float(
+                        st["recovered"]["replayed_completions"])),
+                    ({"counter": "deduped_completions"}, float(
+                        st["recovered"]["deduped_completions"]))]
+            if wal["fsync_p99_ms"] is not None:
+                rows.append(({"counter": "fsync_p99_ms"},
+                             float(wal["fsync_p99_ms"])))
+            if st["last_snapshot_age_s"] is not None:
+                rows.append(({"counter": "last_snapshot_age_s"},
+                             float(st["last_snapshot_age_s"])))
+            m.head_wal.set_many(rows)
 
     def _trace_stats(self) -> dict:
         rec = _tp.recorder()
@@ -1336,6 +1616,9 @@ class Runtime(_context.BaseContext):
                 continue
             spec.lineage_resubmits = n + 1
             resubmitted.add(spec.task_id)
+            # back on the live books: the regenerating execution must
+            # survive a head restart too
+            self.controller.task_submitted(spec)
             self.controller.record_task_event(
                 spec.task_id, spec.name, "RESUBMITTED",
                 error=f"lost output {oid} on {node_id}")
@@ -1495,7 +1778,9 @@ class Runtime(_context.BaseContext):
         _mp.submit_stamp(spec)
         for oid in spec.pinned_refs:
             self.controller.pin(oid)
-        self.controller.record_lineage(spec)
+        # lineage + live-task entry + ONE WAL submit record (r15): a
+        # restarted head re-owns this task from here
+        self.controller.task_submitted(spec)
         self.controller.record_task_event(spec.task_id, spec.name, "PENDING")
         self.cluster.submit(spec)
         self._record_submit(tr, spec)
@@ -1739,6 +2024,13 @@ class Runtime(_context.BaseContext):
                 timeout=kwargs.get("timeout", 3.0))
         if op == "metrics_stats":
             return {"enabled": _mp.enabled(), **self.metrics.stats()}
+        if op == "head_ha_stats":
+            # r15 head-HA observability: WAL bytes/records/fsync
+            # latencies, snapshot age, recovery + replay-dedup counts
+            if self._ha is not None:
+                return self._ha.stats()
+            return {"enabled": False,
+                    "snapshot_path": self._snapshot_path}
         if op == "waiter_stats":
             return self.waiters.stats()
         if op == "pubsub_poll":
@@ -1772,7 +2064,9 @@ class Runtime(_context.BaseContext):
         _mp.set_sampler("head", None)
         # each step is independent: a wedged component must not block
         # the ones after it (especially the final shm sweep)
-        for step in (self.cluster.shutdown, self.waiters.shutdown,
+        for step in ((lambda: (self._ha.close()
+                               if self._ha is not None else None)),
+                     self.cluster.shutdown, self.waiters.shutdown,
                      self.controller.pubsub.close,
                      lambda: self._restore_pool.shutdown(wait=False),
                      self._listener.close,

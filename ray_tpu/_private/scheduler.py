@@ -904,6 +904,26 @@ class Scheduler:
                 ids.extend(tid for tid, spec in it if _movable(spec))
         return ids[:limit]
 
+    def known_task_ids(self) -> list:
+        """EVERY plain-task id this node currently holds: the pending
+        queue plus every worker-FIFO entry, including the (likely
+        executing) head of each FIFO. The agent's head-restart rejoin
+        report is built from this (r15): a rehydrated head re-places
+        only mirrored tasks the agent does NOT know — resubmitting a
+        task that is queued, running, or finishing here would break
+        exactly-once."""
+        ids: list = []
+        with self._lock:
+            for spec in self._pending:
+                tid = getattr(spec, "task_id", None)
+                if tid is not None:
+                    ids.append(tid)
+            for rec in self._workers.values():
+                if rec.state == DEAD:
+                    continue
+                ids.extend(rec.tasks.keys())
+        return ids
+
     def is_idle(self) -> bool:
         """Nothing queued, nothing running, no PG bundles, full
         availability — evaluated atomically (autoscaler scale-down)."""

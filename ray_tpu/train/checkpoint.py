@@ -290,6 +290,34 @@ class CheckpointManager:
         self.score_order = score_order
         self._registered: List[Tuple[float, int, str, Dict]] = []
         self._counter = 0
+        # Recover managed entries already on disk (r15 head HA): the
+        # registry used to be memory-only, so a restarted driver's
+        # fresh manager saw an empty `latest` even with intact
+        # checkpoints under the same root — the elastic resume path
+        # across a head restart depends on rediscovering them (with
+        # their persisted metrics, so step seeding works too).
+        import glob as _glob
+        import re as _re
+        for path in sorted(_glob.glob(
+                os.path.join(self.root, "checkpoint_*"))):
+            m = _re.fullmatch(r"checkpoint_(\d+)",
+                              os.path.basename(path))
+            if m is None or not self._usable(path):
+                continue
+            idx = int(m.group(1))
+            metrics = self._load_metrics(path)
+            self._counter = max(self._counter, idx)
+            self._registered.append(
+                (self._score_at(metrics, idx), idx, path, metrics))
+
+    _METRICS_FILE = ".rtpu_metrics.json"
+
+    def _load_metrics(self, dest: str) -> Dict:
+        try:
+            with open(os.path.join(dest, self._METRICS_FILE)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Optional[Dict] = None) -> Checkpoint:
@@ -331,14 +359,39 @@ class CheckpointManager:
     def _register_dest(self, dest: str, metrics: Dict) -> Checkpoint:
         score = self._score(metrics)
         self._registered.append((score, self._counter, dest, metrics))
+        try:
+            # persist the registration metrics beside the data (small,
+            # atomic) so a restarted driver's manager recovers scores
+            # and step numbers, not just directories
+            tmp = os.path.join(dest, self._METRICS_FILE + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump({k: v for k, v in metrics.items()
+                           if isinstance(v, (int, float, str, bool))
+                           or v is None}, f)
+            os.replace(tmp, os.path.join(dest, self._METRICS_FILE))
+        except (OSError, TypeError, ValueError):
+            pass
         self._apply_retention()
         return Checkpoint(dest)
 
     def _score(self, metrics: Dict) -> float:
+        return self._score_at(metrics, self._counter)
+
+    def _score_at(self, metrics: Dict, counter: int) -> float:
         if self.score_attribute and self.score_attribute in metrics:
             v = float(metrics[self.score_attribute])
             return v if self.score_order == "max" else -v
-        return float(self._counter)  # fall back to recency
+        return float(counter)  # fall back to recency
+
+    def metrics_for(self, checkpoint: "Checkpoint") -> Dict:
+        """Registration metrics of a managed checkpoint ({} when
+        unknown) — survives driver restarts via the persisted
+        per-entry metrics file."""
+        path = os.path.abspath(checkpoint.path)
+        for _, _, p, metrics in self._registered:
+            if os.path.abspath(p) == path:
+                return dict(metrics)
+        return self._load_metrics(path)
 
     def _apply_retention(self) -> None:
         if self.num_to_keep is None:

@@ -96,10 +96,27 @@ class _ElasticRun:
             score_attribute=ckpt_cfg.checkpoint_score_attribute,
             score_order=ckpt_cfg.checkpoint_score_order)
         self._restore: Optional[Checkpoint] = trainer._resume_checkpoint
+        if self._restore is None:
+            # r15 head HA: the manager now recovers on-disk entries, so
+            # a driver restarted after a head crash (same run name)
+            # resumes from its own latest checkpoint automatically —
+            # the trainer rides through the restart instead of
+            # retraining from step 0.
+            self._restore = self._manager.latest
         self._history: List[Dict[str, Any]] = []
         self._last_metrics: Dict[str, Any] = {}
         self._last_step = -1            # highest step in the history
         self._last_ckpt_step = -1       # highest step with a checkpoint
+        if self._restore is not None:
+            # seed step accounting from the restore point's persisted
+            # metrics: steps the resumed loop replays (checkpoint ->
+            # crash) dedup exactly like an in-process restore, so the
+            # concatenated (step, loss) history of a restarted run
+            # equals an uninterrupted one
+            seeded = self._manager.metrics_for(self._restore).get("step")
+            if seeded is not None:
+                self._last_step = int(seeded)
+                self._last_ckpt_step = int(seeded)
         self._reshapes = 0
         self._restores = 0
         self._last_bcast: Optional[dict] = None

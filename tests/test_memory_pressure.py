@@ -13,6 +13,10 @@ import numpy as np
 import pytest
 
 
+@pytest.mark.slow    # ~31s (r15 tier-1 budget): park/resume and
+                     # overflow-after-budget stay covered by
+                     # test_store_overflow_admits_after_budget +
+                     # test_job_completes_beyond_capacity
 def test_store_put_backpressure_fully_pinned(monkeypatch):
     """Over capacity with every byte pinned: a put parks (backpressure)
     and resumes the moment pins release, instead of failing or blowing

@@ -229,6 +229,9 @@ def test_actor_manager_timeout_not_fatal(ray_cluster):
 
 
 # ----------------------------------------------------- env runner group
+@pytest.mark.slow    # ~16s (r15 tier-1 budget); runner mechanics
+                     # stay tier-1 via the env_runner unit tests +
+                     # actor_manager suite
 def test_env_runner_group_remote_sampling(ray_cluster):
     grp = EnvRunnerGroup(
         EnvRunnerConfig(num_envs=2, rollout_length=16, seed=11),
@@ -283,6 +286,9 @@ def test_learner_dp_mesh_parity_with_single_device():
                                    atol=1e-6)
 
 
+@pytest.mark.slow    # ~17s (r15 tier-1 budget); dp-mesh parity
+                     # stays tier-1 via
+                     # test_learner_dp_mesh_parity_with_single_device
 def test_learner_group_num_learners_2_loss_parity(ray_cluster):
     """num_learners=2 -> a remote learner over a 2-device dp mesh whose
     metrics match local mode (no more fake replicated updates)."""

@@ -273,6 +273,10 @@ def test_trainer_exhausts_max_failures(tmp_path, fresh_cluster):
     assert result.error is not None
 
 
+@pytest.mark.slow    # ~18s (r15 tier-1 budget); trainer e2e
+                     # coverage stays via test_trainer_two_workers +
+                     # checkpoint/restart tests; the real-model
+                     # slice still runs in the default suite
 @pytest.mark.usefixtures("ray_cluster")
 def test_trainer_real_model_e2e(tmp_path):
     """Tiny transformer trained inside a worker actor, checkpointed,

@@ -271,6 +271,9 @@ def make_pbt_trainable():
     return trainable
 
 
+@pytest.mark.slow    # ~28s (r15 tier-1 budget); the exploit/
+                     # inherit decision logic stays tier-1 via
+                     # test_pbt_unit_exploit_decision
 def test_pbt_e2e_perturbs_and_inherits_checkpoints(ray_cluster, tmp_path):
     """VERDICT r3 item 3 gate: a PBT run that perturbs lr and inherits
     checkpoints — exploited trials restart from the source's checkpoint
@@ -334,6 +337,9 @@ def test_tpe_searcher_categorical_and_loguniform():
     assert all(1e-5 <= c["lr"] <= 1e-1 for c in late)
 
 
+@pytest.mark.slow    # ~29s (r15 tier-1 budget); TPE math stays
+                     # tier-1 via the two tpe_searcher unit tests,
+                     # tuner e2e via test_tuner_grid_sweep_best_result
 def test_tuner_with_tpe_searcher(ray_cluster, tmp_path):
     grid = tune.Tuner(
         make_quadratic_trainable(),
@@ -350,6 +356,8 @@ def test_tuner_with_tpe_searcher(ray_cluster, tmp_path):
 
 
 # ----------------------------------------------- distributed (group) trials
+@pytest.mark.slow    # ~26s (r15 tier-1 budget); ASHA rung logic
+                     # stays tier-1 via the three asha unit tests
 def test_tuner_distributed_trials_jaxtrainer_asha(ray_cluster, tmp_path):
     """VERDICT r3 item 3 gate: tune a 2-worker JaxTrainer under ASHA —
     each trial is a PG-placed worker group; ASHA stops the bad lr
@@ -387,6 +395,10 @@ def test_tuner_distributed_trials_jaxtrainer_asha(ray_cluster, tmp_path):
     assert best.metrics["world_size"] == 2      # really a 2-worker group
 
 
+@pytest.mark.slow    # ~31s (r15 tier-1 budget); lazy-suggest is
+                     # also exercised by the (slow) TPE tuner e2e;
+                     # searcher feedback math stays tier-1 via
+                     # test_tpe_searcher_converges_toward_optimum
 def test_searcher_gets_feedback_before_late_suggestions(ray_cluster,
                                                         tmp_path):
     """suggest() must run lazily at trial launch so later suggestions

@@ -45,6 +45,10 @@ LABELS = [
     ("drain_3k_trace", "3k drain, tracing on (default)"),
     ("drain_3k_nometrics", "3k drain, RAY_TPU_METRICS=0"),
     ("drain_3k_metrics", "3k drain, metrics on (default)"),
+    ("drain_3k_nowal", "3k drain, head persistence off"),
+    ("drain_3k_wal", "3k drain, head WAL + group-commit fsync (r15)"),
+    ("head_restart_recovery",
+     "head SIGKILL mid-3k-delegated-drain: WAL recovery (r15)"),
     ("tasks_sync_per_s", "tasks, sync round-trip"),
     ("tasks_batch_per_s", "tasks, batched"),
     ("actor_calls_sync_per_s", "actor calls, sync"),
@@ -95,6 +99,10 @@ def _fmt_result(rec: dict) -> str:
             out += f" (tree speedup {rec['tree_speedup']}x)"
         if "manifest_speedup" in rec:
             out += f" (manifest speedup {rec['manifest_speedup']}x)"
+        if "wal_overhead_pct" in rec:
+            # r15 head-HA column-mate: throughput delta of the WAL-on
+            # run vs its persistence-off twin (negative = box noise)
+            out += f" (wal overhead {rec['wal_overhead_pct']:+}%)"
         if "overlap_speedup" in rec:
             out += f" (overlap speedup {rec['overlap_speedup']}x)"
         if "schedule_speedup" in rec:

@@ -677,25 +677,46 @@ def main(as_json: bool = False) -> dict:
             _d["per_second"] / _c["per_second"], 2)
 
     # --------------------- 100k-task drain: sustained head envelope
-    # (r10 acceptance scenario — the scale at which per-task head
-    # participation used to be the wall; local workers, so the number
-    # tracks the full submit->dispatch->done pipeline, not one box's
-    # agent protocol)
+    # (r10 acceptance scenario; r16 acceptance metric — the scale at
+    # which per-task head cost used to GROW with the in-flight
+    # population; local workers, so the number tracks the full
+    # submit->dispatch->done pipeline, not one box's agent protocol).
+    # The r16 criterion rides the record: 100k per-task head CPU as a
+    # multiple of the same-session 5k-delegated floor measured above.
     results["drain_100k"] = _drain_with_frames(100_000)
+    floor = results.get("drain_5k_delegated",
+                        {}).get("head_cpu_us_per_task")
+    if floor:
+        results["drain_100k"]["vs_delegated_floor"] = round(
+            results["drain_100k"]["head_cpu_us_per_task"] / floor, 2)
 
     # ------------- tracing plane: trace-off vs trace-on 3k drain (r9)
-    # Machine-checks the "near-zero at default settings" claim: with
-    # tracing ON (the default) every task records its submit/queue/
-    # lease/recv/exec/put/done spans and task-plane frames carry 18
-    # bytes of trace context — throughput, frames/task, and head-CPU
-    # µs/task must stay within noise of the traced-off run.
+    # Machine-checks the cost of FULL tracing (sampling stride forced
+    # to 1 — the pre-r16 default): every task records its submit/
+    # queue/lease/recv/exec/put/done spans and task-plane frames carry
+    # 18 bytes of trace context. r14 measured this at +17%, which is
+    # why r16 samples by default (the pair below).
     _b, _t = _ab_pair(
         results, "drain_3k_notrace",
         _drain_env(3000, "RAY_TPU_TRACE", "0"),
-        "drain_3k_trace", _drain_env(3000))
+        "drain_3k_trace", _drain_env(3000, "RAY_TPU_TRACE_SAMPLE", "1"))
     if _b["per_second"]:
         _t["trace_overhead_pct"] = round(
             (_b["per_second"] / _t["per_second"] - 1) * 100, 1)
+
+    # ------- sampled tracing: trace-off vs DEFAULT sampling (r16)
+    # The r16 acceptance pair: at the default RAY_TPU_TRACE_SAMPLE
+    # stride, 1-in-64 tasks carry a whole-or-nothing trace and the
+    # rest pay zero ring writes / zero wire bytes — the overhead
+    # column must sit within box noise (<2%), which is what makes
+    # tracing cheap enough to leave on.
+    _b, _s = _ab_pair(
+        results, "drain_3k_trace_off",
+        _drain_env(3000, "RAY_TPU_TRACE", "0"),
+        "drain_3k_trace_sampled", _drain_env(3000))
+    if _b["per_second"]:
+        _s["trace_overhead_pct"] = round(
+            (_b["per_second"] / _s["per_second"] - 1) * 100, 1)
 
     # ------------- head HA: WAL-off vs WAL-on 3k drain (r15)
     # Machine-checks the r15 claim: with the write-ahead log on

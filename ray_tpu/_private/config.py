@@ -404,6 +404,50 @@ _define("drain_deadline_s", 30.0,
         "node is released when the drain is acknowledged (elastic "
         "trainer checkpoint flushed) or this many seconds elapse, "
         "whichever comes first.")
+_define("head_shards", 8,
+        "Stripe count for the head's hot tables (r16): the ref/pin "
+        "table, live-task spec mirror, lineage mirror, and object "
+        "directory are split into this many independently locked "
+        "shards keyed by task/object id, so submits, completions, and "
+        "decref storms stop convoying through one controller lock at "
+        "100k-task scale. Rounded up to a power of two. 0 (or 1) "
+        "reverts to the single-shard pre-r16 topology.")
+_define("head_lineage_max", 100_000,
+        "Resident-entry cap on the head's lineage mirror (return "
+        "object id -> producing spec, kept for lost-copy "
+        "reconstruction). FIFO eviction past the cap bounds head "
+        "memory under sustained 100k-task in-flight populations; an "
+        "evicted entry only disables lineage reconstruction for that "
+        "object (reference max_lineage_bytes degrades the same way). "
+        "0 = unbounded.")
+_define("decref_delta", True,
+        "Route worker decref storms through the node agent as "
+        "coalesced per-object count deltas (r16 NODE_DECREF_DELTA): "
+        "the agent merges its workers' DECREF/DECREF_BATCH traffic "
+        "into one seq-numbered {object_id: n} frame per flush window "
+        "and the head applies each frame per-shard (one stripe-lock "
+        "round trip per shard, not per release), with rejoin replays "
+        "deduped by a per-node watermark. Requires the head to speak "
+        "wire MINOR >= 7; 0 restores per-connection DECREF_BATCH "
+        "forwarding.")
+_define("decref_delta_delay_ms", 2.0,
+        "Collect-then-flush window for the agent-side decref-delta "
+        "buffer (the delegate_done_delay_ms discipline): the first "
+        "parked release opens a window of this width; every release "
+        "arriving inside it rides the same NODE_DECREF_DELTA frame.")
+_define("decref_delta_max", 512,
+        "Distinct object ids parked in the agent's decref-delta "
+        "buffer that force an immediate flush (bounds both frame size "
+        "and how much release traffic an agent crash can lose).")
+_define("trace_sample", 64,
+        "Trace sampling stride (r16): the head starts a trace for 1 "
+        "in this many root task submissions and propagates the "
+        "decision in the existing spec/envelope trace fields, so a "
+        "sampled task is whole-or-nothing across every process it "
+        "touches while unsampled tasks pay zero ring writes and zero "
+        "wire bytes (exactly like RAY_TPU_TRACE=0). Nested submissions "
+        "inside a sampled trace inherit it. 1 traces every task; 0 "
+        "reverts to the pre-r16 always-trace behavior.")
 _define("scheduler_locality", True,
         "Locality-aware node selection: prefer placing a task on a "
         "feasible node already holding the most argument bytes "

@@ -17,6 +17,18 @@ topology). Head fault tolerance: ``snapshot_state()`` serializes every
 table and ``restore_state()`` rehydrates a restarted head from it
 (reference gcs/gcs_server/gcs_init_data.cc loading from
 gcs/store_client/redis_store_client.h storage).
+
+r16 hot-table striping: the three tables every submit/done/decref
+touches — the ref/pin table, the live-task spec mirror (+ lineage),
+and the object directory — no longer live under ``controller._lock``.
+They are striped shards with per-shard plain locks (striped.py), so
+the driver submit thread, the poller's completion handling, and the
+decref flusher stop convoying through one reentrant lock at 100k-task
+scale. ``_lock`` still guards the cold tables (KV, actors, nodes,
+PGs, task events). WAL composition: sharded mutations complete BEFORE
+their record is appended, and ``snapshot_state`` captures the WAL
+frontier BEFORE capturing any sharded table — see striped.py for why
+that preserves the r15 exact-frontier recovery invariant.
 """
 from __future__ import annotations
 
@@ -26,6 +38,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ray_tpu._private import striped
 from ray_tpu._private.head_ha import TERMINAL_TASK_STATES
 from ray_tpu._private.specs import ActorSpec
 
@@ -64,16 +77,19 @@ class NodeTableRecord:
 
 class Controller:
     def __init__(self, task_event_capacity: Optional[int] = None):
+        from ray_tpu._private.config import CONFIG as _CFG
         if task_event_capacity is None:
-            from ray_tpu._private.config import CONFIG as _CFG
             task_event_capacity = _CFG.task_event_history
         from ray_tpu._private.debug_sync import make_lock
         self._lock = make_lock("controller", reentrant=True)
         self._kv: dict[tuple[str, str], Any] = {}
         self._actors: dict[str, ActorRecord] = {}
         self._named_actors: dict[tuple[str, str], str] = {}
-        self._refcounts: dict[str, int] = {}
-        self._pins: dict[str, int] = collections.defaultdict(int)
+        # Striped ref/pin table (r16): one [refcount, pins] entry per
+        # object id, per-stripe locks, entries evicted at zero/zero.
+        # The WAL hook runs INSIDE the stripe lock (absolute values
+        # must log in mutation order — striped.py docstring).
+        self._refs = striped.RefTable(log=self._log_ref_locked)
         self._pgs: dict[str, dict] = {}
         self._nodes: dict[str, NodeTableRecord] = {}
         # Cluster object directory: object_id -> {node_id} holding a
@@ -86,8 +102,14 @@ class Controller:
         # Lineage: return object_id -> producing TaskSpec, kept while
         # the object is referenced so a lost copy can be re-executed
         # (reference task_manager.h:269 ResubmitTask,
-        # object_recovery_manager.h:41).
-        self._lineage: dict[str, Any] = {}
+        # object_recovery_manager.h:41). Striped + FIFO-bounded: it is
+        # the one hot table with no natural terminal event while refs
+        # stay live, so a 100k drain would otherwise keep 100k specs
+        # resident. Evicting an old entry only disables lost-copy
+        # reconstruction for that object (reference lineage eviction
+        # under max_lineage_bytes degrades the same way).
+        self._lineage = striped.StripedMap(
+            max_entries=_CFG.head_lineage_max)
         # Nested-ref ownership (reference reference_count.cc contained
         # refs): enclosing object id -> inner object ids it holds a
         # count on; released when the enclosing object is deleted.
@@ -98,8 +120,15 @@ class Controller:
         # submitted-not-terminal driver task. This is what a restarted
         # head consults to decide which specs are still owed an
         # execution (mirrored-to-an-agent specs wait for the rejoin
-        # reconcile; the rest re-place immediately).
-        self._live_tasks: dict[str, Any] = {}
+        # reconcile; the rest re-place immediately). Striped (r16):
+        # submit inserts and terminal pops ride per-shard locks.
+        self._live_tasks = striped.StripedMap()
+        # Batched decref-delta watermarks (r16): node_id -> highest
+        # applied delta seq. The dedup that extends the r15 rejoin
+        # replay rules to NODE_DECREF_DELTA frames: a replayed delta at
+        # or below the watermark was already applied by this head (or
+        # survives in the snapshot/WAL refs records) and is skipped.
+        self._decref_seqs: dict[str, int] = {}
         # Head-HA logger (r15): set by the runtime once recovery is
         # done; while None (or during replay) the _walog hooks no-op.
         self.ha = None
@@ -109,22 +138,25 @@ class Controller:
 
     # ---- head-HA write-ahead logging (r15) ----
     def _walog(self, rtype: str, data: Any) -> None:
-        """Append one WAL record. Called INSIDE the locked region that
-        performed the mutation, so mutate+log pairs are atomic w.r.t.
-        the snapshot frontier capture in snapshot_state (the lock is
-        reentrant; the WAL never calls back into the controller)."""
+        """Append one WAL record. For ``_lock``-guarded tables this is
+        called inside the locked region that performed the mutation
+        (mutate+log atomic w.r.t. the frontier capture, which shares
+        ``_lock``). For the striped tables the call site sequences the
+        append AFTER the mutation instead; snapshot_state captures the
+        frontier BEFORE the striped tables, which preserves the same
+        replay invariant (striped.py docstring)."""
         ha = self.ha
         if ha is not None:
             ha.log(rtype, data)
 
-    def _walog_ref(self, object_id: str) -> None:
-        """Absolute refcount+pin record (set semantics — replay-safe
-        under duplication), coalesced WAL-side per flush window."""
+    def _log_ref_locked(self, object_id: str, refcount: int,
+                        pins: int) -> None:
+        """RefTable WAL hook: absolute refcount+pin record (set
+        semantics — replay-safe under duplication), coalesced WAL-side
+        per flush window. Runs with the object's stripe lock held."""
         ha = self.ha
         if ha is not None:
-            ha.log_ref(object_id,
-                       self._refcounts.get(object_id, 0),
-                       self._pins.get(object_id, 0))
+            ha.log_ref(object_id, refcount, pins)
 
     # ---- KV (GcsInternalKVManager parity) ----
     def kv_put(self, key: str, value: Any, namespace: str = "default",
@@ -164,51 +196,59 @@ class Controller:
     def get_function(self, func_id: str) -> Optional[bytes]:
         return self.kv_get(func_id, namespace="_functions")
 
-    # ---- refcounts ----
+    # ---- refcounts (striped RefTable; per-shard locks) ----
     def addref(self, object_id: str, n: int = 1) -> None:
-        with self._lock:
-            self._refcounts[object_id] = self._refcounts.get(object_id, 0) + n
-            self._walog_ref(object_id)
+        self._refs.addref(object_id, n)
 
     def decref(self, object_id: str) -> bool:
         """Returns True when the object is now unreferenced and unpinned."""
+        return self._refs.decref(object_id)
+
+    def apply_decref_delta(self, node_id: str, seq: int,
+                           counts: dict) -> Optional[list[str]]:
+        """Batched decref delta from a delegated agent (r16): apply
+        ``{oid: n}`` per-shard and return the ids now deletable, or
+        None when the delta is a replayed duplicate (its seq is at or
+        below the node's watermark). The watermark advances — and WAL-
+        logs — BEFORE the counts apply: a crash in between loses the
+        releases (objects leak until shutdown, the safe direction)
+        instead of double-applying them on replay (premature free)."""
+        if seq:
+            with self._lock:
+                if seq <= self._decref_seqs.get(node_id, 0):
+                    return None
+                self._decref_seqs[node_id] = seq
+                self._walog("dref_seq", (node_id, seq))
+        return self._refs.apply_deltas(counts)
+
+    def reset_decref_seq(self, node_id: str) -> None:
+        """A FRESH (non-rejoin) agent registered under this node id:
+        its delta counter restarts, so the watermark must too."""
         with self._lock:
-            c = self._refcounts.get(object_id, 0) - 1
-            if c > 0:
-                self._refcounts[object_id] = c
-                self._walog_ref(object_id)
-                return False
-            self._refcounts.pop(object_id, None)
-            self._walog_ref(object_id)
-            return self._pins[object_id] == 0
+            if self._decref_seqs.pop(node_id, None) is not None:
+                self._walog("dref_seq", (node_id, 0))
 
     def pin(self, object_id: str) -> None:
-        with self._lock:
-            self._pins[object_id] += 1
-            self._walog_ref(object_id)
+        self._refs.pin(object_id)
 
     def unpin(self, object_id: str) -> bool:
         """Returns True when the object is now unreferenced and unpinned."""
-        with self._lock:
-            self._pins[object_id] = max(0, self._pins[object_id] - 1)
-            self._walog_ref(object_id)
-            return (self._pins[object_id] == 0
-                    and self._refcounts.get(object_id, 0) == 0)
+        return self._refs.unpin(object_id)
 
     def refcount(self, object_id: str) -> int:
-        with self._lock:
-            return self._refcounts.get(object_id, 0)
+        return self._refs.refcount(object_id)
 
     def pinned_ids(self) -> list[str]:
         """Objects pinned by in-flight work — the store's spill policy
         must not touch these (they may be mid-transfer as task args)."""
-        with self._lock:
-            return [oid for oid, n in self._pins.items() if n > 0]
+        return self._refs.pinned_ids()
 
     def unreferenced(self, object_id: str) -> bool:
-        with self._lock:
-            return (self._refcounts.get(object_id, 0) == 0
-                    and self._pins[object_id] == 0)
+        return self._refs.unreferenced(object_id)
+
+    def ref_tables(self) -> tuple[dict, dict]:
+        """(refcounts, pins) merged one-dict views (tests, snapshots)."""
+        return self._refs.snapshot()
 
     # ---- object directory (delegates to the ObjectDirectory
     # subsystem; these remain the control-plane entry points) ----
@@ -251,11 +291,17 @@ class Controller:
             old = self._contained.get(object_id)
             if old == new or (old is None and not new):
                 return []
+            # inner-ref counts FIRST (each logs its absolute value
+            # inside its stripe lock, taken UNDER _lock — the
+            # controller-lock -> stripe-lock order apply_decref_delta
+            # also uses): a crash between these appends and the
+            # contained record below leaks conservatively, while the
+            # reverse order would let replay decref counts that were
+            # never incremented — a premature free
+            for cid in new:
+                self._refs.addref(cid)
             if new:
                 self._contained[object_id] = new
-                for cid in new:
-                    self._refcounts[cid] = self._refcounts.get(cid, 0) + 1
-                    self._walog_ref(cid)
             else:
                 self._contained.pop(object_id, None)
             self._walog("contained", (object_id, new))
@@ -270,40 +316,35 @@ class Controller:
 
     # ---- lineage (ResubmitTask parity) ----
     def record_lineage(self, spec: Any) -> None:
-        with self._lock:
-            for oid in getattr(spec, "return_ids", ()):
-                self._lineage[oid] = spec
+        for oid in getattr(spec, "return_ids", ()):
+            self._lineage.put(oid, spec)
 
     # ---- live-task accounting (r15 head HA) ----
     def task_submitted(self, spec: Any) -> None:
-        """One locked region records everything a restarted head needs
-        to re-own this task: lineage for its return objects, the
-        live-task entry that marks it submitted-not-terminal, and ONE
-        WAL record carrying the spec (replay rebuilds both tables from
-        it)."""
-        with self._lock:
-            for oid in getattr(spec, "return_ids", ()):
-                self._lineage[oid] = spec
-            tid = getattr(spec, "task_id", None)
-            if tid is not None:
-                self._live_tasks[tid] = spec
-            self._walog("task", spec)
+        """Record everything a restarted head needs to re-own this
+        task: lineage for its return objects, the live-task entry that
+        marks it submitted-not-terminal, and ONE WAL record carrying
+        the spec (replay rebuilds both tables from it). Mutations
+        complete before the record is appended — the striped-table WAL
+        invariant (striped.py)."""
+        for oid in getattr(spec, "return_ids", ()):
+            self._lineage.put(oid, spec)
+        tid = getattr(spec, "task_id", None)
+        if tid is not None:
+            self._live_tasks.put(tid, spec)
+        self._walog("task", spec)
 
     def live_task(self, task_id: str) -> Any:
-        with self._lock:
-            return self._live_tasks.get(task_id)
+        return self._live_tasks.get(task_id)
 
     def live_task_ids(self) -> list[str]:
-        with self._lock:
-            return list(self._live_tasks)
+        return self._live_tasks.keys()
 
     def lineage_for(self, object_id: str) -> Any:
-        with self._lock:
-            return self._lineage.get(object_id)
+        return self._lineage.get(object_id)
 
     def drop_lineage(self, object_id: str) -> None:
-        with self._lock:
-            self._lineage.pop(object_id, None)
+        self._lineage.pop(object_id)
 
     # ---- actors ----
     def register_actor(self, spec: ActorSpec) -> ActorRecord:
@@ -428,25 +469,28 @@ class Controller:
                     if r.node_id == node_id and r.state != DEAD]
 
     # ---- persistence (GCS storage parity) ----
-    _SNAPSHOT_TABLES = ("_kv", "_actors", "_named_actors", "_refcounts",
-                        "_pins", "_pgs", "_nodes", "_lineage",
-                        "_contained", "_live_tasks")
+    # Cold tables captured under _lock; the striped tables keep their
+    # legacy blob keys but are captured shard-aware (after the
+    # frontier) — the blob SHAPE is unchanged across r15 <-> r16.
+    _SNAPSHOT_TABLES = ("_kv", "_actors", "_named_actors", "_pgs",
+                        "_nodes", "_contained", "_decref_seqs")
+    _STRIPED_TABLES = ("_refcounts", "_pins", "_lineage", "_live_tasks")
 
     def snapshot_state(self, extra_fn: Optional[Any] = None) -> bytes:
         """Snapshot every table into one blob (reference GCS tables are
-        flushed to the storage backend). Only the shallow table copies
-        happen under the lock; the pickle — the expensive part — runs
-        outside so the periodic snapshot never stalls the control
-        plane. With the r15 WAL attached, the blob embeds the WAL
-        sequence frontier it covers — captured under THE SAME lock the
-        mutate+log pairs hold, so replay of records at or below it is
-        provably redundant. ``extra_fn`` supplies runtime-owned tables
-        (per-node spec mirrors + lease ledgers) and runs AFTER the
-        frontier capture: a mirror add logged at seq <= frontier is
-        then guaranteed visible in the captured mirror (it happened
-        before the capture), while one logged later replays from the
-        WAL — captured-before-frontier mirrors would silently drop the
-        gap and double-place those tasks on recovery."""
+        flushed to the storage backend). Only shallow table copies
+        happen under locks; the pickle — the expensive part — runs
+        outside. With the r15 WAL attached, the blob embeds the WAL
+        sequence frontier it covers. Capture order is the r16
+        invariant: frontier FIRST (under ``_lock``, atomic with the
+        cold-table capture whose mutate+log pairs share that lock),
+        striped tables and the directory AFTER — a record at or below
+        the frontier is then provably visible in the captured shard
+        (striped.py docstring). ``extra_fn`` supplies runtime-owned
+        tables (per-node spec mirrors + lease ledgers) and likewise
+        runs after the frontier capture: a mirror add logged at
+        seq <= frontier is guaranteed visible in the captured mirror,
+        while one logged later replays from the WAL."""
         import pickle
 
         import cloudpickle
@@ -456,8 +500,14 @@ class Controller:
             state["_task_events"] = list(self._task_events)
             if self.ha is not None:
                 state["_wal_seq"] = self.ha.wal_seq()
-        # the directory snapshots under its own lock (its table keys
-        # keep the pre-extraction names for blob continuity)
+        # striped tables: captured per-shard AFTER the frontier, merged
+        # into the legacy one-dict blob keys
+        (state["_refcounts"],
+         state["_pins"]) = self._refs.snapshot()
+        state["_lineage"] = self._lineage.snapshot()
+        state["_live_tasks"] = self._live_tasks.snapshot()
+        # the directory snapshots under its own stripe locks (its table
+        # keys keep the pre-extraction names for blob continuity)
         (state["_locations"],
          state["_location_nbytes"]) = self.directory.snapshot()
         if extra_fn is not None:
@@ -479,13 +529,17 @@ class Controller:
         with self._lock:
             current = dict(self._nodes)          # the new head's record(s)
             for name in self._SNAPSHOT_TABLES:
+                # _decref_seqs rides this loop too (it is in
+                # _SNAPSHOT_TABLES; r15-era blobs simply lack the key)
                 setattr(self, name, state.get(name, {}))
-            self._pins = collections.defaultdict(
-                int, state.get("_pins", {}))     # keep defaulting behavior
             self._nodes = {nid: r for nid, r in self._nodes.items()
                            if not r.is_head}
             self._nodes.update(current)
             self._task_events.extend(state.get("_task_events", ()))
+        self._refs.restore(state.get("_refcounts", {}),
+                           state.get("_pins", {}))
+        self._lineage.restore(state.get("_lineage", {}))
+        self._live_tasks.restore(state.get("_live_tasks", {}))
         self.directory.restore(state.get("_locations", {}),
                                state.get("_location_nbytes", {}))
         return state
@@ -494,29 +548,28 @@ class Controller:
         """Replay one WAL record onto the tables (r15 recovery). Every
         branch is set-semantics: applying a record twice — the torn-
         compaction overlap, or a test replaying the tail again —
-        converges to the same state."""
+        converges to the same state. Striped-table branches go through
+        the shard-aware entry points (r16)."""
         if rtype == "task":
             spec = data
-            with self._lock:
-                tid = getattr(spec, "task_id", None)
-                if tid is not None:
-                    self._live_tasks[tid] = spec
-                for oid in getattr(spec, "return_ids", ()):
-                    self._lineage[oid] = spec
+            tid = getattr(spec, "task_id", None)
+            if tid is not None:
+                self._live_tasks.put(tid, spec)
+            for oid in getattr(spec, "return_ids", ()):
+                self._lineage.put(oid, spec)
         elif rtype == "task_done":
-            with self._lock:
-                self._live_tasks.pop(data, None)
+            self._live_tasks.pop(data)
         elif rtype == "refs":
+            for oid, (ref, pin) in data.items():
+                self._refs.set_absolute(oid, ref, pin)
+        elif rtype == "dref_seq":
+            node_id, seq = data
             with self._lock:
-                for oid, (ref, pin) in data.items():
-                    if ref > 0:
-                        self._refcounts[oid] = ref
-                    else:
-                        self._refcounts.pop(oid, None)
-                    if pin > 0:
-                        self._pins[oid] = pin
-                    else:
-                        self._pins.pop(oid, None)
+                if seq:
+                    cur = self._decref_seqs.get(node_id, 0)
+                    self._decref_seqs[node_id] = max(cur, int(seq))
+                else:
+                    self._decref_seqs.pop(node_id, None)
         elif rtype == "kv":
             ns, key, value = data
             with self._lock:
@@ -592,11 +645,12 @@ class Controller:
                 "task_id": task_id, "name": name, "state": state,
                 "worker_id": worker_id, "error": error, "ts": time.time(),
             })
-            if state in TERMINAL_TASK_STATES:
-                # the task is off the head's books: a restarted head
-                # must not re-own (and re-place) it
-                if self._live_tasks.pop(task_id, None) is not None:
-                    self._walog("task_done", task_id)
+        if state in TERMINAL_TASK_STATES:
+            # the task is off the head's books: a restarted head
+            # must not re-own (and re-place) it — terminal specs evict
+            # eagerly (stripe pop), then the pop is logged
+            if self._live_tasks.pop(task_id) is not None:
+                self._walog("task_done", task_id)
 
     def record_task_events(self, events: list[dict]) -> None:
         """Batched ingest from worker-side event buffers (reference
@@ -619,3 +673,16 @@ class Controller:
         for ev in latest.values():
             counts[ev["state"]] += 1
         return dict(counts)
+
+    # ---- shard observability (r16) ----
+    def shard_stats(self) -> dict:
+        """Per-table stripe occupancy/contention for /metrics and the
+        head_shard gauges: proves the striping spreads load instead of
+        asserting it."""
+        return {
+            "refs": self._refs.stats(),
+            "live_tasks": self._live_tasks.stats(),
+            "lineage": dict(self._lineage.stats(),
+                            evicted=self._lineage.evicted),
+            "directory": self.directory.shard_stats(),
+        }

@@ -154,6 +154,17 @@ class _RuntimeMetrics:
                           "records/fsyncs, fsync_p99_ms, compactions, "
                           "last_snapshot_age_s, replayed/deduped "
                           "completion counts", ("counter",))
+        self.head_shard = g(
+            "ray_tpu_head_shard",
+            "Striped head-table occupancy/contention (r16): entries, "
+            "max_stripe, contended lock acquisitions per table — "
+            "proves the stripes spread load", ("table", "counter"))
+        self.decref_delta = g(
+            "ray_tpu_decref_delta",
+            "Batched decref-delta counters (r16): agent-side frames/"
+            "entries/releases coalesced (plus buffered + forwarded "
+            "fallbacks); head-side frames/entries applied and "
+            "replayed frames deduped", ("counter",))
 
 
 _mx: Optional[_RuntimeMetrics] = None

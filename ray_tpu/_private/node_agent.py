@@ -195,6 +195,34 @@ class NodeAgent:
         self._done_sent: _collections.deque = _collections.deque(
             maxlen=4096)
         self._head_lost_at: Optional[float] = None
+        # ---- batched decref deltas (r16) ----
+        # Worker DECREF/DECREF_BATCH traffic coalesces here as
+        # per-object release counts and flushes as seq-numbered
+        # NODE_DECREF_DELTA frames (collect-then-flush, the done-batch
+        # discipline) toward a MINOR >= 7 head; the sent ring backs
+        # the rejoin replay (head dedups by the per-node seq
+        # watermark — the r15 done-replay rule extended to decrefs).
+        self._decref_lock = threading.Lock()
+        self._decref_buf: dict[str, int] = {}
+        self._decref_seq = 0
+        # serializes seq-assignment + SEND as one unit: the pacer
+        # thread and an inline threshold flush racing could otherwise
+        # emit seq N+1 before seq N, and the head's watermark dedup
+        # would then drop frame N's releases permanently (done batches
+        # tolerate reordering because they dedup per task id, not per
+        # frame seq). Ordering: _decref_send_lock before _decref_lock,
+        # never inverse.
+        self._decref_send_lock = threading.Lock()
+        self._decref_sent: _collections.deque = _collections.deque(
+            maxlen=256)
+        self._decref_stats = {
+            "delta_frames": 0, "delta_entries": 0, "releases": 0,
+            "forwarded": 0,
+        }
+        self._decref_flusher = protocol.FlushLoop(
+            self._flush_decref_buf,
+            lambda: _CFG.decref_delta_delay_ms,
+            "rtpu-agent-decref-flush")
         # ---- N10 heartbeat delta-sync ----
         self._hb_seq = 0
         self._hb_last_norm: Optional[dict] = None
@@ -301,6 +329,7 @@ class NodeAgent:
             # out on the new connection, not the dead one.
             self.head = conn
             replay = self._replay_done_entries()
+            dreplay = self._replay_decref_entries()
             try:
                 rep = conn.request(
                     {"type": protocol.NODE_REGISTER,
@@ -327,6 +356,11 @@ class NodeAgent:
                     conn.send({"type": protocol.NODE_TASK_DONE_BATCH,
                                "node_id": self.node_id, "done": replay,
                                "replayed": True})
+                # replayed decref deltas keep their original seqs: a
+                # restarted head's rehydrated watermark (or the live
+                # head that already processed them) dedups each frame
+                for f in dreplay:
+                    conn.send(dict(f, replayed=True))
             except BaseException:
                 try:
                     conn.close()
@@ -480,6 +514,11 @@ class NodeAgent:
         m.delegate.set_many(
             [({"counter": k}, float(v)) for k, v in st.items()]
             + [({"counter": "outstanding"}, float(outstanding))])
+        with self._decref_lock:
+            dst = dict(self._decref_stats,
+                       buffered=len(self._decref_buf))
+        m.decref_delta.set_many(
+            [({"counter": k}, float(v)) for k, v in dst.items()])
         pm = self._pull_mgr.stats()
         m.pull_inflight.set(pm["inflight"])
         m.pull_inflight_bytes.set(pm["inflight_bytes"])
@@ -490,11 +529,17 @@ class NodeAgent:
         self._stop.set()
         _mp.set_sampler("agent", None)
         self._done_flusher.stop()
+        self._decref_flusher.stop()
         try:
             # graceful drain: completions still parked in the batch
             # window must reach the head, or it re-executes finished
             # tasks after declaring this node dead
             self._flush_done_buf()
+        except Exception:
+            pass
+        try:
+            # parked releases too, or they leak for the session
+            self._flush_decref_buf()
         except Exception:
             pass
         try:
@@ -856,6 +901,76 @@ class NodeAgent:
                             "node_id": self.node_id, "done": batch},
                            _flush_done=False)
 
+    # ------------------------------- batched decref deltas (r16)
+    def _delta_decrefs_to_head(self) -> bool:
+        return (bool(_CFG.decref_delta)
+                and self.head.peer_speaks_decref_delta())
+
+    def _on_worker_decref(self, msg: dict) -> None:
+        """A worker released references: coalesce into the per-object
+        delta buffer (one NODE_DECREF_DELTA frame per flush window
+        instead of forwarding every DECREF_BATCH), falling back to
+        plain forwarding toward a pre-MINOR-7 head or with
+        RAY_TPU_DECREF_DELTA=0."""
+        if not self._delta_decrefs_to_head():
+            self._decref_stats["forwarded"] += 1
+            self._send_to_head(dict(msg))
+            return
+        ids = (msg.get("object_ids") if msg["type"]
+               == protocol.DECREF_BATCH else [msg["object_id"]])
+        with self._decref_lock:
+            buf = self._decref_buf
+            for oid in ids:
+                buf[oid] = buf.get(oid, 0) + 1
+            self._decref_stats["releases"] += len(ids)
+            n = len(buf)
+        if n >= max(1, _CFG.decref_delta_max):
+            self._flush_decref_buf()
+        else:
+            self._decref_flusher.wake()
+
+    def _flush_decref_buf(self) -> None:
+        """Drain the delta buffer as one-or-more NODE_DECREF_DELTA
+        frames (<= 64 entries each — the wire's structural dict
+        bound). Frames are seq-numbered under the buffer lock and
+        retained in the sent ring for the rejoin replay; a head
+        outage parks them in the ordinary outage buffer, so ordering
+        and replay both ride the existing machinery."""
+        with self._decref_send_lock:
+            while True:
+                with self._decref_lock:
+                    if not self._decref_buf:
+                        return
+                    buf = self._decref_buf
+                    if len(buf) <= 64:
+                        counts, self._decref_buf = buf, {}
+                    else:
+                        counts = {}
+                        for oid in list(buf)[:64]:
+                            counts[oid] = buf.pop(oid)
+                    self._decref_seq += 1
+                    frame = {"type": protocol.NODE_DECREF_DELTA,
+                             "node_id": self.node_id,
+                             "seq": self._decref_seq, "counts": counts}
+                    self._decref_stats["delta_frames"] += 1
+                    self._decref_stats["delta_entries"] += len(counts)
+                    self._decref_sent.append((time.monotonic(), frame))
+                # still under the SEND lock: frames leave in seq order
+                self._send_to_head(frame, _flush_done=False)
+
+    def _replay_decref_entries(self) -> list:
+        """Sent delta frames from just before the outage (the at-risk
+        delivered-but-maybe-unprocessed tail, the done-entry replay
+        rule): the head drops any frame at or below its per-node seq
+        watermark, so over-replaying is free."""
+        window = _CFG.head_done_replay_window_s
+        lost_at = self._head_lost_at
+        if window <= 0 or lost_at is None:
+            return []
+        cutoff = lost_at - window
+        with self._decref_lock:
+            return [f for ts, f in self._decref_sent if ts >= cutoff]
+
     def _trace_dump_reply(self, conn: protocol.Connection,
                           msg: dict) -> None:
         """Drain this node's recorders: the agent's own first (the
@@ -989,9 +1104,13 @@ class NodeAgent:
                        protocol.SUBMIT_ACTOR, protocol.SUBMIT_ACTOR_TASK,
                        protocol.KV_OP, protocol.STATE_OP):
             self._relay_to_head(conn, msg)
-        elif mtype in (protocol.DECREF, protocol.ADDREF,
-                       protocol.DECREF_BATCH):
+        elif mtype == protocol.ADDREF:
+            # addrefs go straight through: delaying a release is
+            # always safe (the delta buffer), delaying a borrow
+            # registration is not
             self._send_to_head(dict(msg))
+        elif mtype in (protocol.DECREF, protocol.DECREF_BATCH):
+            self._on_worker_decref(msg)
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)
 

@@ -32,7 +32,9 @@ from typing import Any, Callable, Optional
 
 from ray_tpu import native
 from ray_tpu._private.wire import (BATCH_MIN_MINOR, BATCH_TYPE,
-                                   CHANNEL_MIN_MINOR, DELEGATE_MIN_MINOR,
+                                   CHANNEL_MIN_MINOR,
+                                   DECREF_DELTA_MIN_MINOR,
+                                   DELEGATE_MIN_MINOR,
                                    MANIFEST_MIN_MINOR, METRICS_MIN_MINOR,
                                    RAW_KEY, TRACE_KEY, TRACE_MIN_MINOR,
                                    WIRE_MAJOR, WireVersionError, dumps,
@@ -155,6 +157,15 @@ NODE_HB_RESYNC = "node_hb_resync"      # head -> agent: heartbeat seq
                                        #   gap observed; send a full
                                        #   snapshot next beat (N10
                                        #   delta-sync)
+NODE_DECREF_DELTA = "node_decref_delta"  # agent -> head (r16; wire
+                                       #   MINOR >= 7): coalesced
+                                       #   per-object refcount
+                                       #   releases {oid: n} + a
+                                       #   per-node seq the head
+                                       #   watermarks so rejoin
+                                       #   replays dedup (the r15
+                                       #   done-batch discipline
+                                       #   extended to decrefs)
 
 
 class ConnectionClosed(Exception):
@@ -431,6 +442,16 @@ class Connection:
         wire-channel transport, experimental/wire_channel.py)."""
         v = self.peer_wire_version
         return v // 100 == WIRE_MAJOR and v % 100 >= CHANNEL_MIN_MINOR
+
+    def peer_speaks_decref_delta(self) -> bool:
+        """Whether the peer applies NODE_DECREF_DELTA frames
+        (MINOR >= 7). Unknown (0) counts as NO: an old head would
+        silently drop the unknown type and every release in it would
+        leak for the session, so agents forward the workers' own
+        DECREF_BATCH frames until the head proves itself."""
+        v = self.peer_wire_version
+        return (v // 100 == WIRE_MAJOR
+                and v % 100 >= DECREF_DELTA_MIN_MINOR)
 
     def _peer_speaks_trace(self) -> bool:
         """Whether trace context may ride this connection's envelopes.
@@ -1013,7 +1034,13 @@ class Poller:
             return
         self._stop.set()
         self._wake()
-        self._thread.join(timeout=5.0)
+        if self._thread is not threading.current_thread():
+            # an agent's NODE_SHUTDOWN handler runs ON the loop thread
+            # (shutdown -> poller.close); joining ourselves raises and
+            # the exception used to abort the CALLER's remaining
+            # teardown steps (store shutdown, shm sweep) — the loop
+            # exits on the stop flag either way
+            self._thread.join(timeout=5.0)
         with self._lock:
             conns, self._conns = dict(self._conns), {}
         for fd, conn in conns.items():

@@ -21,7 +21,10 @@ WaitForRefRemoved):
 
 Known conservatism: a borrowing worker that is SIGKILLed never sends its
 deferred DECREF, so its borrows leak until session shutdown (the
-reference reclaims these via per-borrower death cleanup).
+reference reclaims these via per-borrower death cleanup). Decrefs
+deferred while NO context is installed park in a BOUNDED set and drain
+on the next context attach (r16; see ``_PARK_MAX`` below) — previously
+they parked unbounded until session shutdown.
 """
 from __future__ import annotations
 
@@ -42,7 +45,19 @@ _capture = threading.local()
 # guaranteed self-deadlock. So __del__ only appends the id here; a
 # dedicated flusher thread performs the actual decref (the reference
 # defers destructor work to the core worker's io service the same way).
+#
+# Parked-set bound (r16): while NO context is installed (shutdown /
+# re-init gap, or a process that dropped refs before ever attaching),
+# the ids PARK here. Unbounded parking was the documented borrow leak —
+# a context-less process collecting refs forever grew this deque until
+# session end. Past _PARK_MAX the flusher trims the OLDEST parked ids
+# (their owner-side counts leak, counted in `dropped_parked`, the same
+# conservative direction as a SIGKILLed borrower); everything still
+# parked drains the moment a context attaches (context.set_ctx wakes
+# the flusher).
+_PARK_MAX = 65_536
 _deferred: collections.deque = collections.deque()
+dropped_parked = 0
 _flush_wake = threading.Event()
 _flusher_started = False
 _flusher_lock = threading.Lock()
@@ -61,6 +76,7 @@ def _ensure_flusher() -> None:
 
 
 def _flush_loop() -> None:
+    global dropped_parked
     while True:
         if not _deferred:
             _flush_wake.wait(0.2)
@@ -70,7 +86,16 @@ def _flush_loop() -> None:
         if ctx is None:
             # No context (shutdown / re-init gap): leave the ids parked
             # — popping here would leak the owner-side count forever.
-            # set_ctx wakes us the moment a new context installs.
+            # set_ctx wakes us the moment a new context installs and
+            # the parked backlog drains first thing. The set is
+            # BOUNDED (r16): trim the oldest past _PARK_MAX so a
+            # context-less process cannot grow it for its lifetime.
+            while len(_deferred) > _PARK_MAX:
+                try:
+                    _deferred.popleft()
+                    dropped_parked += 1
+                except IndexError:
+                    break
             _flush_wake.wait(0.2)
             _flush_wake.clear()
             continue

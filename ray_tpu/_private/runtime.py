@@ -114,6 +114,10 @@ class Runtime(_context.BaseContext):
         # consults _ha when it builds RemoteNodeHandles.
         self._ha = None
         self._pending_reconcile: dict[str, tuple] = {}
+        # r16 decref-delta accounting (head side): applied frames/
+        # entries + replayed frames dropped by the seq watermark
+        self._decref_delta_stats = {"frames": 0, "entries": 0,
+                                    "deduped_frames": 0}
         # serializes snapshot publication: the periodic loop, manual
         # snapshot_now calls, and WAL compaction share one tmp/.prev
         # rotation chain — concurrent writers would rename each
@@ -772,8 +776,9 @@ class Runtime(_context.BaseContext):
         elif mtype == protocol.DECREF:
             self.decref(msg["object_id"])
         elif mtype == protocol.DECREF_BATCH:
-            for oid in msg["object_ids"]:
-                self.decref(oid)
+            self.decref_batch(msg["object_ids"])
+        elif mtype == protocol.NODE_DECREF_DELTA:
+            self._on_decref_delta(msg)
         elif mtype == protocol.ADDREF:
             self.controller.addref(msg["object_id"])
         elif mtype == protocol.STATE_OP:
@@ -860,6 +865,11 @@ class Runtime(_context.BaseContext):
             conn.meta["node_id"] = rec.node_id
             if msg.get("rejoin"):
                 self._process_rejoin(rec, msg)
+            else:
+                # a FRESH agent process under this node id restarts
+                # its decref-delta seq counter: drop the watermark or
+                # its first frames would be deduped as replays
+                self.controller.reset_decref_seq(rec.node_id)
             conn.reply(msg, node_id=rec.node_id)
         elif mtype == protocol.NODE_HEARTBEAT:
             nid = msg["node_id"]
@@ -1559,6 +1569,19 @@ class Runtime(_context.BaseContext):
                 rows.append(({"counter": "last_snapshot_age_s"},
                              float(st["last_snapshot_age_s"])))
             m.head_wal.set_many(rows)
+        # r16: striped-table occupancy/contention + decref-delta
+        # application counters — the sharding win observable, not
+        # just benchable
+        rows = []
+        for table, st in self.controller.shard_stats().items():
+            for k in ("entries", "max_stripe", "contended", "evicted"):
+                if k in st:
+                    rows.append(({"table": table, "counter": k},
+                                 float(st[k])))
+        m.head_shard.set_many(rows)
+        m.decref_delta.set_many(
+            [({"counter": "head_" + k}, float(v))
+             for k, v in self._decref_delta_stats.items()])
 
     def _trace_stats(self) -> dict:
         rec = _tp.recorder()
@@ -1732,14 +1755,51 @@ class Runtime(_context.BaseContext):
         if self.controller.decref(object_id):
             self._delete_everywhere(object_id)
 
+    def decref_batch(self, object_ids: list[str]) -> None:
+        """Batched release (head-local workers' DECREF_BATCH and the
+        driver's own flusher): counts apply per shard — one stripe
+        lock per shard, not one controller lock per release (r16)."""
+        if self._shutdown or not object_ids:
+            return
+        counts: dict[str, int] = {}
+        for oid in object_ids:
+            counts[oid] = counts.get(oid, 0) + 1
+        for oid in self.controller.apply_decref_delta("", 0, counts) or ():
+            self._delete_everywhere(oid)
+
+    def _on_decref_delta(self, msg: dict) -> None:
+        """NODE_DECREF_DELTA (r16): a delegated agent's coalesced
+        release counts. The controller's per-node seq watermark drops
+        replayed frames (rejoin replay after a head restart or
+        reconnect) so no release is ever applied twice."""
+        counts = msg.get("counts") or {}
+        dead = self.controller.apply_decref_delta(
+            msg.get("node_id", ""), int(msg.get("seq", 0)), counts)
+        st = self._decref_delta_stats
+        if dead is None:
+            st["deduped_frames"] += 1
+            return
+        st["frames"] += 1
+        st["entries"] += len(counts)
+        if not self._shutdown:
+            for oid in dead:
+                self._delete_everywhere(oid)
+
     # ---- tracing plane (r9) ----
     def _stamp_trace(self, spec) -> Optional[tuple]:
         """Open the spec's submit span: join the caller's active trace
         (or the trace a relaying worker already stamped on the spec;
-        else start a fresh one) and point the spec's parent_span at
-        this span, so downstream scheduler/worker spans chain under
-        it. Returns (trace_id, span_id, parent, t0_ns) for
-        _record_submit, or None when tracing is off."""
+        else — when the sampler elects this root submission,
+        RAY_TPU_TRACE_SAMPLE — start a fresh one) and point the spec's
+        parent_span at this span, so downstream scheduler/worker spans
+        chain under it. The decision here is the WHOLE decision (r16):
+        an unsampled spec keeps trace_id 0, so every downstream
+        emission site (scheduler queue/lease, agent, worker recv/exec/
+        put, pull manager, done) skips its span and its frames carry
+        zero trace bytes — whole-or-nothing across processes, exactly
+        the RAY_TPU_TRACE=0 byte shape. Returns (trace_id, span_id,
+        parent, t0_ns) for _record_submit, or None when tracing is off
+        or this task is unsampled."""
         if not _tp.enabled():
             return None
         tid = getattr(spec, "trace_id", 0)   # pre-r9-pickled specs
@@ -1747,8 +1807,12 @@ class Runtime(_context.BaseContext):
             parent = getattr(spec, "parent_span", 0)   # relayed
         else:
             cur = _tp.current()
-            tid = cur[0] if cur else _tp.new_id()
-            parent = cur[1] if cur else 0
+            if cur:
+                tid, parent = cur[0], cur[1]   # nested: inherit
+            elif _tp.sample():
+                tid, parent = _tp.new_id(), 0  # sampled root
+            else:
+                return None                    # unsampled: no trace
             spec.trace_id = tid
         sid = _tp.new_id()
         spec.parent_span = sid
@@ -2024,6 +2088,10 @@ class Runtime(_context.BaseContext):
                 timeout=kwargs.get("timeout", 3.0))
         if op == "metrics_stats":
             return {"enabled": _mp.enabled(), **self.metrics.stats()}
+        if op == "head_shard_stats":
+            # r16 striped-table + decref-delta observability
+            return {"shards": self.controller.shard_stats(),
+                    "decref_delta": dict(self._decref_delta_stats)}
         if op == "head_ha_stats":
             # r15 head-HA observability: WAL bytes/records/fsync
             # latencies, snapshot age, recovery + replay-dedup counts

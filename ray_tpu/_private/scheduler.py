@@ -755,6 +755,21 @@ class Scheduler:
                 pass
         return h
 
+    @staticmethod
+    def _spec_need_key(spec) -> tuple:
+        """Cached hashable shape of a spec's resource need (r16 sweep
+        miss-memo key component). Resources never change after
+        submission, so the tuple is computed once per spec — a full
+        sweep over a 100k backlog must not rebuild it per pass."""
+        k = getattr(spec, "_need_key_cache", None)
+        if k is None:
+            k = tuple(sorted(Scheduler.need_of(spec).items()))
+            try:
+                spec._need_key_cache = k
+            except AttributeError:
+                pass
+        return k
+
     def _pick_worker(self, spec=None) -> Optional[WorkerRec]:
         """Idle worker, preferring one whose last applied runtime env
         matches the spec's (runtime-env-keyed reuse). Pipelining onto a
@@ -1169,15 +1184,50 @@ class Scheduler:
         else:
             import itertools as _it
             snapshot = list(_it.islice(self._pending, scan_limit))
+        # r16 saturated-sweep miss memo: once a plain (no-PG, no-actor)
+        # spec of a given (env, need-shape) found neither pool room nor
+        # a piggyback slot, every later same-shape spec in THIS sweep
+        # skips on one set lookup. Sound within a sweep, including for
+        # incomparable multi-resource shapes: (a) fits() cannot start
+        # succeeding — the pool only shrinks under the held lock
+        # (dispatches acquire, nothing releases; completions need this
+        # lock). (b) A piggyback slot for missed shape S cannot open —
+        # it requires a worker whose LAST queued need D >= S
+        # componentwise (the chain condition), and any mid-sweep
+        # dispatch of such a D either passed fits(D) on a pool smaller
+        # than the one fits(S) already failed on (D >= S makes that a
+        # contradiction) or itself piggybacked behind some P >= D >= S
+        # on a worker whose eligibility cannot have improved since S's
+        # probe (the eligible set is fixed, FIFO depth only grows
+        # mid-sweep, blocked_depth needs this lock). Without the memo,
+        # the 2 s full-sweep backstop over a saturated 100k backlog
+        # paid O(n) worker probes per pass — head cost proportional to
+        # the in-flight population, the very thing r16 removes.
+        misses: set = set()
         for spec in snapshot:
             if id(spec) not in self._queued_at:
                 continue              # removed while the lock was dropped
-            need = self._effective_need(spec)
             pg_key = self._bundle_for(spec)
             if getattr(spec, "placement_group_id", None) and pg_key is None:
                 self._send_dispatch_outbox(outbox)   # next call drops lock
                 self._fail_if_pg_removed(spec)
                 continue                  # bundle not (yet) on this node
+            mkey = None
+            if pg_key is None:
+                # one cached tuple per spec: the memo probe must cost
+                # a getattr + set hit, not an env-hash + need rebuild,
+                # or scanning a deep backlog stays expensive
+                mkey = getattr(spec, "_sweep_key_cache", None)
+                if mkey is None and not isinstance(spec, ActorSpec):
+                    mkey = (self._spec_env_hash(spec),
+                            self._spec_need_key(spec))
+                    try:
+                        spec._sweep_key_cache = mkey
+                    except AttributeError:
+                        pass
+                if mkey is not None and mkey in misses:
+                    continue          # proven unplaceable this sweep
+            need = self._effective_need(spec)
             pool = (self._bundles[pg_key]["avail"] if pg_key is not None
                     else self.avail)
             charged = True
@@ -1190,6 +1240,8 @@ class Scheduler:
                 # multi-spec TASK frames and paired TASK_DONEs.
                 worker = self._pick_piggyback(spec, need, pg_key, refillable)
                 if worker is None:
+                    if mkey is not None:
+                        misses.add(mkey)
                     continue
                 charged = False
             else:

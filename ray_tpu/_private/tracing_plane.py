@@ -44,6 +44,7 @@ is the same chrome://tracing JSON `ray timeline` emits.
 """
 from __future__ import annotations
 
+import itertools
 import os
 import random
 import threading
@@ -185,6 +186,31 @@ def record(kind: str, name: str, t0_ns: int, t1_ns: int,
 # msg[TRACE_KEY] = (trace_id, parent_span); the wire codecs move it
 # between the dict and the proto fields (wire.py re-exports this).
 TRACE_KEY = "_trace"
+
+# ------------------------------------------------- sampling (r16)
+# The head decides once, at submit, whether a ROOT task starts a
+# trace (RAY_TPU_TRACE_SAMPLE = stride; 1-in-stride sampled). The
+# decision propagates in the existing spec/envelope trace fields, so
+# every downstream emission site keeps its r9 gate (`trace_id` truthy
+# or a wire-carried ctx) and a sampled task is whole-or-nothing
+# across driver, scheduler, agent, worker, and pull manager —
+# unsampled tasks record nothing anywhere and their frames are
+# byte-identical to RAY_TPU_TRACE=0 frames. The counter is a
+# thread-safe itertools.count (deterministic: task k is sampled iff
+# k % stride == 0, which the whole-or-nothing test relies on).
+_sample_counter = itertools.count()
+
+
+def sample() -> bool:
+    """Head-side sampling decision for a new root trace. stride <= 1
+    (incl. the 0 = off revert) keeps the pre-r16 trace-everything
+    behavior; the counter only advances for root-submission decisions
+    so nested/relayed submissions never skew the stride."""
+    from ray_tpu._private.config import CONFIG
+    stride = int(CONFIG.trace_sample)
+    if stride <= 1:
+        return True
+    return next(_sample_counter) % stride == 0
 
 # ---------------------------------------------------- trace context
 _tls = threading.local()

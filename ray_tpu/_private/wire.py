@@ -50,7 +50,7 @@ from ray_tpu import native as _native
 from ray_tpu._private import wire_pb2 as pb
 
 WIRE_MAJOR = 1
-WIRE_MINOR = 6          # 1: BatchFrame coalescing (negotiated by peers)
+WIRE_MINOR = 7          # 1: BatchFrame coalescing (negotiated by peers)
                         # 2: Envelope trace_id/parent_span (tracing
                         #    plane; old peers skip unknown fields)
                         # 3: delegated scheduling ops (NODE_LEASE_BATCH
@@ -64,6 +64,8 @@ WIRE_MINOR = 6          # 1: BatchFrame coalescing (negotiated by peers)
                         # 6: wire-channel ops (ch_attach/data/ack/
                         #    close) for compiled-DAG channels (r13; no
                         #    envelope change — CH_DATA reuses `raw`)
+                        # 7: NODE_DECREF_DELTA coalesced refcount
+                        #    deltas (r16; no envelope change)
 WIRE_VERSION = WIRE_MAJOR * 100 + WIRE_MINOR
 
 # First MINOR that understands a type=="batch" Envelope carrying a
@@ -114,6 +116,15 @@ MANIFEST_MIN_MINOR = 5
 # negotiated by observation, the BatchFrame discipline.
 CHANNEL_MIN_MINOR = 6
 
+# First MINOR whose handlers understand a NODE_DECREF_DELTA frame
+# (r16 batched decref deltas). An OLD head would silently drop the
+# unknown type — every release in the frame would leak for the
+# session — so agents coalesce deltas only toward a head that
+# demonstrated MINOR >= 7 and fall back to forwarding the workers'
+# DECREF_BATCH frames otherwise (negotiated by observation, the
+# BatchFrame discipline).
+DECREF_DELTA_MIN_MINOR = 7
+
 # Message-dict carrier for the Envelope `raw` field. On encode the
 # value is a LIST of buffer objects (bytes/memoryview — mapped shm
 # spans) concatenated into the field by the scatter-gather emit with
@@ -144,6 +155,7 @@ class WireVersionError(Exception):
 # Kept in sync with protocol.py constants; anything else rides `__py__`.
 STRUCTURAL_TYPES = frozenset({
     "register", "ping", "decref", "addref", "decref_batch",
+    "node_decref_delta",
     "node_register", "node_heartbeat", "node_event",
     "node_kill_worker", "node_delete_object", "node_shutdown",
     "node_hb_resync",

@@ -99,6 +99,8 @@ def test_min_workers_floor_and_idle_scale_down(scaled_cluster):
     assert len(cluster.alive_nodes()) <= n_before + 2
 
 
+@pytest.mark.slow    # ~12s (r16 tier-1 budget); cap/floor logic
+# keeps its tier-1 sibling test_min_workers_floor_and_idle_scale_down
 def test_max_workers_cap(scaled_cluster):
     from ray_tpu._private import context
     cluster = context.get_ctx().cluster
@@ -175,6 +177,9 @@ def test_type_infeasible_demand_fails_fast(scaled_cluster):
         placement_group([{"CPU": 100}])
 
 
+@pytest.mark.slow    # ~10s (r16 tier-1 budget); provider scale-up
+# keeps tier-1 siblings test_scale_up_for_infeasible_task +
+# test_scale_up_for_pending_placement_group
 def test_tpu_pod_provider_scales_slice_pg_from_zero(scaled_cluster):
     """The judge's done-criterion: a queued STRICT_SPREAD slice PG
     scales a pod-slice node group up FROM ZERO worker nodes through the

@@ -117,6 +117,8 @@ def test_multiprocessing_pool_initializer_and_errors(ray_cluster):
 
 
 # ---------------------------------------------------------------- joblib
+@pytest.mark.slow    # ~15s (r16 tier-1 budget); pool/backend
+# mechanics stay tier-1 via the multiprocessing_pool tests
 def test_joblib_backend(ray_cluster):
     joblib = pytest.importorskip("joblib")
     from ray_tpu.util.joblib import register_ray

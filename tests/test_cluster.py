@@ -68,6 +68,9 @@ def test_node_affinity_routes_and_custom_resources(three_node_cluster):
     assert got == n3
 
 
+@pytest.mark.slow    # ~12s (r16 tier-1 budget); node-death recovery
+# keeps tier-1 siblings test_node_kill_restarts_actor_elsewhere +
+# the delegated agent-death exactly-once test
 def test_node_kill_detected_and_task_retried(three_node_cluster):
     c, n2, _ = three_node_cluster
     soft = NodeAffinitySchedulingStrategy(node_id=n2, soft=True)

@@ -124,6 +124,9 @@ def test_bc_clones_heuristic_policy(tmp_path):
 
 
 # --------------------------------------------------------------- tracing
+@pytest.mark.slow    # ~20s (r16 tier-1 budget); annotate/profile
+# mechanics stay tier-1 in test_tracing_plane (annotate-lands-in-
+# recorder + timeline export)
 def test_tracing_profile_and_annotate(tmp_path):
     import jax
     import jax.numpy as jnp
@@ -175,6 +178,9 @@ def test_marwil_beats_noisy_dataset(tmp_path):
     assert ev["episode_return_mean"] >= 100, ev
 
 
+@pytest.mark.slow    # ~12s (r16 tier-1 budget); offline-learning
+# gates keep tier-1 siblings: test_bc_clones_heuristic_policy +
+# test_marwil_beats_noisy_dataset
 def test_cql_learns_from_offline_data(tmp_path):
     """Discrete CQL: TD + conservative penalty trains a usable greedy
     policy from recorded data (reference cql learning tests)."""

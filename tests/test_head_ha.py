@@ -126,9 +126,11 @@ def test_wal_replay_is_idempotent(tmp_path):
 
     c1, c2 = build(1), build(2)
     assert c1._kv == c2._kv == {("default", "k"): "v"}
-    assert list(c1._live_tasks) == list(c2._live_tasks) == ["bb" * 8]
-    assert c1._refcounts == c2._refcounts == {"obj1": 3}
-    assert dict(c1._pins) == dict(c2._pins) == {"obj1": 1}
+    assert (c1.live_task_ids() == c2.live_task_ids() == ["bb" * 8])
+    refs1, pins1 = c1.ref_tables()
+    refs2, pins2 = c2.ref_tables()
+    assert refs1 == refs2 == {"obj1": 3}
+    assert pins1 == pins2 == {"obj1": 1}
     assert (c1.locations("obj1") == c2.locations("obj1")
             == ["node_x"])
 
@@ -149,8 +151,8 @@ def test_snapshot_plus_wal_tail_equals_live_tables(ha_runtime):
     rt._ha.wal.sync()
     rt.snapshot_now()           # frontier captured under controller lock
     live_kv = dict(rt.controller._kv)
-    live_refs = dict(rt.controller._refcounts)
-    live_live = dict(rt.controller._live_tasks)
+    live_refs = rt.controller.ref_tables()[0]
+    live_live = rt.controller.live_task_ids()
 
     ha2 = HeadPersistence(snap, snap + ".wal")
     c2 = Controller()
@@ -158,8 +160,8 @@ def test_snapshot_plus_wal_tail_equals_live_tables(ha_runtime):
     ha2.replay(c2, ha2.wal_tail(), int(state.get("_wal_seq", 0)), {}, {})
     ha2.close()
     assert c2._kv == live_kv
-    assert c2._refcounts == live_refs
-    assert c2._live_tasks.keys() == live_live.keys() == set()
+    assert c2.ref_tables()[0] == live_refs
+    assert set(c2.live_task_ids()) == set(live_live) == set()
     assert c2.kv_get("mykey") == {"a": 1}
 
 

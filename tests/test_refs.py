@@ -124,6 +124,51 @@ def test_deferred_decref_parks_without_context():
         _context.set_ctx(None)
 
 
+def test_parked_decref_set_is_bounded_and_drains_on_attach(
+        monkeypatch):
+    """r16 borrow-leak fix: with NO context installed, the deferred
+    set is BOUNDED (oldest trimmed past _PARK_MAX, counted loudly)
+    instead of growing for the process lifetime — and everything
+    still parked drains the moment a context attaches."""
+    from ray_tpu._private import context as _context
+    from ray_tpu._private import refs
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    assert _context.maybe_ctx() is None
+    monkeypatch.setattr(refs, "_PARK_MAX", 500)
+    base_dropped = refs.dropped_parked
+    refs._deferred.clear()
+    for i in range(1300):
+        refs._deferred.append(f"bound_test_{i}")
+    refs._flush_wake.set()
+    refs._ensure_flusher()
+    deadline = time.monotonic() + 10
+    while (len(refs._deferred) > 500
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert len(refs._deferred) <= 500
+    assert refs.dropped_parked - base_dropped == 800
+    # the NEWEST parked ids survived (oldest were trimmed)
+    assert "bound_test_1299" in refs._deferred
+    assert "bound_test_0" not in refs._deferred
+
+    drained = []
+
+    class _Ctx(ray_tpu._private.context.BaseContext):
+        def decref_batch(self, object_ids):
+            drained.extend(object_ids)
+
+    _context.set_ctx(_Ctx())
+    try:
+        deadline = time.monotonic() + 10
+        while len(drained) < 500 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(drained) == 500 and not refs._deferred
+        assert "bound_test_1299" in drained
+    finally:
+        _context.set_ctx(None)
+
+
 def test_deferred_decrefs_flush_as_batches(rt):
     """The flusher drains in DECREF_BATCH-sized groups through the
     context's decref_batch hook (one frame per batch on wire-hop

@@ -337,6 +337,9 @@ def test_vtrace_reduces_to_gae_on_policy():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow    # ~10s (r16 tier-1 budget); IMPALA keeps its
+# tier-1 siblings (vtrace math, learner updates, actor-manager
+# suite); the cartpole learning gate was already slow-marked
 def test_impala_async_pipeline_runs(ray_cluster):
     """Structural test: 2 async runners keep the queue fed; updates
     consume off-policy batches; weights version advances."""

@@ -29,10 +29,15 @@ from ray_tpu._private.config import CONFIG
 def tracing_on():
     os.environ.pop("RAY_TPU_TRACE", None)
     os.environ.pop("RAY_TPU_TRACE_RING", None)
+    # r16 sampled tracing: stride 1 = every task traced, which is what
+    # these parentage/byte-shape assertions are about (sampling has its
+    # own tests below)
+    os.environ["RAY_TPU_TRACE_SAMPLE"] = "1"
     CONFIG.reload()
     yield
     os.environ.pop("RAY_TPU_TRACE", None)
     os.environ.pop("RAY_TPU_TRACE_RING", None)
+    os.environ.pop("RAY_TPU_TRACE_SAMPLE", None)
     CONFIG.reload()
 
 
@@ -355,4 +360,111 @@ def test_two_agent_trace_parentage(tmp_path, tracing_on):
             a.terminate()
         for a in agents:
             a.wait(10)
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------- sampled tracing (r16)
+def test_sample_stride_deterministic_and_knob_reverts(tracing_on):
+    import itertools
+    os.environ["RAY_TPU_TRACE_SAMPLE"] = "4"
+    CONFIG.reload()
+    tp._sample_counter = itertools.count()
+    assert ([tp.sample() for _ in range(8)]
+            == [True, False, False, False] * 2)
+    # 0 reverts to pre-r16 trace-everything (the =0/off discipline);
+    # 1 is explicit trace-everything
+    for revert in ("0", "1"):
+        os.environ["RAY_TPU_TRACE_SAMPLE"] = revert
+        CONFIG.reload()
+        assert all(tp.sample() for _ in range(5))
+
+
+def test_unsampled_task_bytes_identical_to_trace_off(tracing_on):
+    """The head's sampling decision is whole-or-nothing at the byte
+    level: an unsampled spec carries trace_id 0 and its TASK frame is
+    byte-identical to the RAY_TPU_TRACE=0 encoding (zero wire bytes),
+    while a sampled spec records the submit span and stamps the spec."""
+    import itertools
+
+    from ray_tpu._private.runtime import Runtime
+    from ray_tpu._private.specs import TaskSpec
+
+    def spec():
+        return TaskSpec(task_id="ab" * 8, func_id="f" * 16,
+                        return_ids=["ab" * 8 + "r0"])
+
+    os.environ["RAY_TPU_TRACE"] = "0"
+    CONFIG.reload()
+    off = spec()
+    assert Runtime._stamp_trace(None, off) is None
+    off_bytes = wire.dumps({"type": "task", "rid": 5, "spec": off})
+
+    os.environ.pop("RAY_TPU_TRACE", None)
+    os.environ["RAY_TPU_TRACE_SAMPLE"] = "1000"
+    CONFIG.reload()
+    tp._sample_counter = itertools.count()
+    base = tp.recorder().watermark()
+    sampled = spec()
+    tr = Runtime._stamp_trace(None, sampled)      # count 0 -> sampled
+    assert tr is not None and sampled.trace_id
+    unsampled = spec()
+    assert Runtime._stamp_trace(None, unsampled) is None
+    assert unsampled.trace_id == 0
+    assert (wire.dumps({"type": "task", "rid": 5, "spec": unsampled})
+            == off_bytes)
+    # no ring writes happened for the unsampled path (the sampled
+    # submit span only records at _record_submit, not here)
+    assert tp.recorder().watermark() == base
+
+
+def test_sampling_whole_or_nothing_across_processes(tracing_on):
+    """Acceptance: at stride N on a live runtime, exactly the sampled
+    tasks produce spans — and each sampled task's spans appear in
+    EVERY process it touched (driver submit/done + worker recv/exec),
+    while unsampled tasks leave zero records anywhere."""
+    import itertools
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    os.environ["RAY_TPU_TRACE_SAMPLE"] = "3"
+    CONFIG.reload()
+    rt = ray_tpu.init(num_cpus=1, max_workers=1)
+    try:
+        @ray_tpu.remote
+        def job(i):
+            return i
+
+        @ray_tpu.remote
+        def warmup():
+            return -1
+
+        # warm the single worker so exec spans don't race the spawn
+        # (distinct name: the warm task may itself be sampled and must
+        # not count against the stride-window assertion below)
+        assert ray_tpu.get(warmup.remote(), timeout=60) == -1
+        time.sleep(0.2)
+        tp._sample_counter = itertools.count()
+        refs = [job.remote(i) for i in range(6)]     # samples #0, #3
+        assert ray_tpu.get(refs, timeout=60) == list(range(6))
+        time.sleep(0.5)                  # trailing TASK_DONEs land
+        dump = rt.state_op("trace_dump")
+        traces = _events_by_trace(dump["processes"])
+        exec_tids = {t for t, evs in traces.items()
+                     if any(e[4].startswith("exec:")
+                            and e[4].endswith("job")
+                            for _, _, e in evs)}
+        # exactly 2 of the 6 tasks were sampled...
+        sampled = set()
+        for t in exec_tids:
+            kinds = {(role, e[3]) for role, _, e in traces[t]}
+            if ("driver", "submit") in kinds:
+                sampled.add(t)
+                # ...and each sampled trace is WHOLE: spans in both
+                # the driver and the worker process
+                assert ("worker", "worker") in kinds, kinds
+                assert ("driver", "done") in kinds, kinds
+                roles = {role for role, _, _ in traces[t]}
+                assert {"driver", "worker"} <= roles
+        assert len(sampled) == 2, (len(sampled), len(exec_tids))
+    finally:
         ray_tpu.shutdown()

@@ -42,7 +42,10 @@ LABELS = [
     ("drain_5k_delegated", "5k remote drain, delegated bulk leases"),
     ("drain_100k", "100k drain, local workers"),
     ("drain_3k_notrace", "3k drain, RAY_TPU_TRACE=0"),
-    ("drain_3k_trace", "3k drain, tracing on (default)"),
+    ("drain_3k_trace", "3k drain, FULL tracing (RAY_TPU_TRACE_SAMPLE=1)"),
+    ("drain_3k_trace_off", "3k drain, RAY_TPU_TRACE=0 (sampled-pair twin)"),
+    ("drain_3k_trace_sampled",
+     "3k drain, sampled tracing (default RAY_TPU_TRACE_SAMPLE)"),
     ("drain_3k_nometrics", "3k drain, RAY_TPU_METRICS=0"),
     ("drain_3k_metrics", "3k drain, metrics on (default)"),
     ("drain_3k_nowal", "3k drain, head persistence off"),
@@ -103,6 +106,11 @@ def _fmt_result(rec: dict) -> str:
             # r15 head-HA column-mate: throughput delta of the WAL-on
             # run vs its persistence-off twin (negative = box noise)
             out += f" (wal overhead {rec['wal_overhead_pct']:+}%)"
+        if "vs_delegated_floor" in rec:
+            # r16 acceptance metric: 100k per-task head CPU as a
+            # multiple of the same-session 5k-delegated floor
+            out += (f" ({rec['vs_delegated_floor']}x the 5k-delegated "
+                    f"head-CPU floor)")
         if "overlap_speedup" in rec:
             out += f" (overlap speedup {rec['overlap_speedup']}x)"
         if "schedule_speedup" in rec:

@@ -30,7 +30,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private.scheduler import Scheduler, fits
-from ray_tpu._private.specs import ActorSpec, TaskSpec
+from ray_tpu._private.specs import ActorSpec, TaskSpec, bump_attempt
 from ray_tpu.exceptions import PlacementGroupUnschedulableError
 
 # PG states (reference rpc::PlacementGroupTableData).
@@ -60,6 +60,16 @@ class NodeRecord:
     # not here — the cluster only tracks the routing/ack state.
     draining: bool = False
     drain_acked: bool = False
+    # Suspicion state (r17 gray failures): heartbeat older than
+    # RAY_TPU_SUSPECT_S but younger than the death timeout. A suspect
+    # node is alive — no recovery runs — but routing/rebalance/spill
+    # skip it, pulls deprioritize it, and the autoscaler excludes its
+    # capacity. The NEXT heartbeat clears the flag inline (recovery is
+    # free); recovered_pending defers the RECOVERED event + infeasible
+    # retry to the monitor sweep, which may publish/lock — heartbeat()
+    # is called from under node locks and must stay lock-free.
+    suspect: bool = False
+    recovered_pending: bool = False
 
 
 @dataclass
@@ -91,6 +101,13 @@ class ClusterTaskManager:
         self._pgs: Dict[str, PGRecord] = {}
         self._pending_pgs: List[str] = []
         self._infeasible: List = []       # specs no live node can EVER fit
+        # r17 membership observability (liveness_stats / metrics);
+        # bumped via bump_liveness from the monitor thread AND
+        # per-connection reader threads — dict += is a non-atomic
+        # read-modify-write, so increments go through one small lock
+        self.liveness_counters: Dict[str, int] = {
+            "suspected": 0, "recovered": 0, "deaths": 0, "fenced": 0}
+        self._counter_lock = threading.Lock()
         # node_id -> rejoin deadline: rehydrated agents expected to
         # re-register after a head restart (reference: raylets reconnect
         # to a restarted GCS; gcs_init_data.cc rehydrated node table)
@@ -145,6 +162,10 @@ class ClusterTaskManager:
                                           else None))
         rec = NodeRecord(node_id=node_id, scheduler=proxy, is_head=False,
                          labels=dict(labels or {}))
+        # r17: every (re)registration earns a fresh incarnation; the
+        # runtime stamps it on the agent's connection and frames from
+        # older epochs are fenced at the frame-apply points.
+        proxy.incarnation = self._rt.controller.mint_incarnation(node_id)
         with self._lock:
             old = self._nodes.get(node_id)
             self._nodes[node_id] = rec
@@ -205,11 +226,14 @@ class ClusterTaskManager:
 
     def schedulable_nodes(self) -> List[NodeRecord]:
         """Alive nodes that accept NEW placements: draining nodes (a
-        preemption notice is in flight) are excluded so nothing fresh
-        lands on a host about to die."""
+        preemption notice is in flight) and SUSPECT nodes (heartbeat
+        stale past RAY_TPU_SUSPECT_S — a gray failure in progress) are
+        excluded so nothing fresh lands on a host about to die. A
+        suspect node rejoins this set the instant its next heartbeat
+        lands (heartbeat() clears the flag inline)."""
         with self._lock:
             return [n for n in self._nodes.values()
-                    if n.alive and not n.draining]
+                    if n.alive and not n.draining and not n.suspect]
 
     # ------------------------------------------- drain-before-kill (r14)
     def drain_node(self, node_id: str,
@@ -269,6 +293,7 @@ class ClusterTaskManager:
         def _resubmit(specs):
             for spec in specs:
                 try:
+                    bump_attempt(spec)
                     self.submit(spec)
                 except Exception:
                     log.exception("drain resubmit failed")
@@ -303,9 +328,45 @@ class ClusterTaskManager:
             return self._nodes.get(node_id)
 
     def heartbeat(self, node_id: str) -> None:
+        # Lock-free by contract: local schedulers call this from under
+        # their own node lock every dispatch tick. Clearing suspicion
+        # here is what makes blip recovery FREE — the node is
+        # schedulable again before the monitor's next 0.5 s sweep; the
+        # sweep only publishes the deferred RECOVERED event.
         rec = self._nodes.get(node_id)
         if rec is not None:
             rec.last_heartbeat = time.monotonic()
+            if rec.suspect:
+                rec.suspect = False
+                rec.recovered_pending = True
+
+    def bump_liveness(self, key: str, n: int = 1) -> None:
+        with self._counter_lock:
+            self.liveness_counters[key] = \
+                self.liveness_counters.get(key, 0) + n
+
+    def is_suspect(self, node_id: str) -> bool:
+        rec = self._nodes.get(node_id)
+        return bool(rec is not None and rec.alive and rec.suspect)
+
+    def liveness_stats(self) -> dict:
+        """Per-node liveness view + transition counters (r17): the
+        `liveness_stats` state op and the /metrics liveness gauges
+        read this."""
+        now = time.monotonic()
+        with self._lock:
+            nodes = [{
+                "node_id": n.node_id,
+                "is_head": n.is_head,
+                "state": ("dead" if not n.alive
+                          else "suspect" if n.suspect
+                          else "draining" if n.draining
+                          else "alive"),
+                "last_heartbeat_age_s": round(now - n.last_heartbeat, 3),
+            } for n in self._nodes.values()]
+        with self._counter_lock:
+            counters = dict(self.liveness_counters)
+        return {"nodes": nodes, "counters": counters}
 
     def total_resources(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
@@ -798,6 +859,7 @@ class ClusterTaskManager:
         # agent-death resubmit semantics, driven from persisted state)
         ha = getattr(self._rt, "_ha", None)
         pend = ha.take_pending_node(node_id) if ha is not None else None
+        self._rt.controller.bump_incarnation(node_id)
         if pend is not None:
             for key, (spec, _dispatched) in pend.work.items():
                 if isinstance(spec, TaskSpec):
@@ -806,6 +868,7 @@ class ClusterTaskManager:
                         error=f"node {node_id} did not rejoin after "
                               f"head restart")
                     try:
+                        bump_attempt(spec)
                         self.submit(spec)
                     except Exception:
                         log.exception("rejoin-expiry resubmit failed")
@@ -864,7 +927,8 @@ class ClusterTaskManager:
                 continue            # no unmet demand: nothing stuck
             if not any(fits(m.scheduler.effective_avail(), shapes[0])
                        for m in nodes
-                       if m is not n and m.alive and not m.draining):
+                       if m is not n and m.alive and not m.draining
+                       and not m.suspect):
                 continue            # nowhere better: leave the lease
             ids = h.steal_candidates()
             if ids:
@@ -879,29 +943,89 @@ class ClusterTaskManager:
         """GcsHealthCheckManager parity: staleness-based liveness."""
         while self._running:
             time.sleep(0.5)
-            now = time.monotonic()
-            dead = []
-            expired = []
-            with self._lock:
-                for n in self._nodes.values():
-                    if (n.alive and
-                            now - n.last_heartbeat > _CFG.heartbeat_timeout_s):
-                        dead.append(n.node_id)
-                for nid, deadline in list(self._rejoining.items()):
-                    if now > deadline:
-                        self._rejoining.pop(nid)
-                        expired.append(nid)
-            for nid in dead:
-                self._on_node_death(nid, cause="heartbeat timeout")
-            for nid in expired:
-                try:
-                    self._fail_rejoining_node(nid)
-                except Exception:
-                    # the node was already popped from _rejoining, so
-                    # this recovery will not re-run — never lose it
-                    # silently
-                    log.exception("rejoin-expiry recovery for %s failed",
-                                  nid)
+            try:
+                self._sweep_liveness()
+            except Exception:
+                log.exception("liveness sweep failed")
+
+    def _sweep_liveness(self) -> None:
+        """One liveness pass (r17: alive -> SUSPECT -> dead instead of
+        alive -> dead). Separated from the loop so tests drive
+        deterministic transitions. SUSPECT is pure routing state — no
+        recovery runs, which is the whole point: a blip shorter than
+        the death timeout costs scheduling preference, not a node-
+        death recovery (and heartbeat() clears it for free)."""
+        now = time.monotonic()
+        suspect_s = _CFG.suspect_s
+        dead_s = _CFG.heartbeat_timeout_s
+        if suspect_s >= dead_s > 0:
+            # the documented constraint is suspect_s < timeout; an
+            # operator lowering the death timeout alone would
+            # otherwise silently lose the whole suspect state (the
+            # death branch always wins) — clamp and say so once
+            if not getattr(self, "_suspect_clamp_warned", False):
+                self._suspect_clamp_warned = True
+                log.warning(
+                    "RAY_TPU_SUSPECT_S (%.2fs) >= heartbeat_timeout_s "
+                    "(%.2fs); clamping suspicion to %.2fs", suspect_s,
+                    dead_s, dead_s / 2.0)
+            suspect_s = dead_s / 2.0
+        dead = []
+        expired = []
+        suspected = []
+        recovered = []
+        with self._lock:
+            for n in self._nodes.values():
+                if not n.alive:
+                    # death already superseded any pending recovery
+                    # event (never publish RECOVERED after DEAD)
+                    n.recovered_pending = False
+                    continue
+                if n.recovered_pending:
+                    n.recovered_pending = False
+                    recovered.append(n.node_id)
+                age = now - n.last_heartbeat
+                if age > dead_s:
+                    dead.append(n.node_id)
+                elif (suspect_s > 0 and not n.suspect and not n.is_head
+                        and age > suspect_s):
+                    n.suspect = True
+                    # heartbeat() is lock-free by contract and may
+                    # have landed between our age read and the flag
+                    # set: re-check so a fresh beat is never wrongly
+                    # suspected for a whole sweep period
+                    if now - n.last_heartbeat <= suspect_s:
+                        n.suspect = False
+                        n.recovered_pending = False
+                    else:
+                        suspected.append(n.node_id)
+            for nid, deadline in list(self._rejoining.items()):
+                if now > deadline:
+                    self._rejoining.pop(nid)
+                    expired.append(nid)
+        for nid in suspected:
+            self.bump_liveness("suspected")
+            self._rt.controller.publish_node_event(
+                nid, "SUSPECT", cause="heartbeat stale")
+        for nid in recovered:
+            self.bump_liveness("recovered")
+            self._rt.controller.publish_node_event(
+                nid, "RECOVERED", cause="heartbeat resumed")
+        if recovered:
+            # a blip may have parked fresh submissions as infeasible
+            # (every capable node was suspect): re-place them now
+            self._retry_infeasible()
+        for nid in dead:
+            self._on_node_death(nid, cause="heartbeat timeout")
+        for nid in expired:
+            try:
+                self._fail_rejoining_node(nid)
+            except Exception:
+                # the node was already popped from _rejoining, so
+                # this recovery will not re-run — never lose it
+                # silently
+                log.exception("rejoin-expiry recovery for %s failed",
+                              nid)
 
     def _on_node_death(self, node_id: str, cause: str) -> None:
         with self._lock:
@@ -909,14 +1033,63 @@ class ClusterTaskManager:
             if rec is None or not rec.alive:
                 return
             rec.alive = False
+            rec.suspect = False
+            rec.recovered_pending = False
             self._rt.controller.publish_node_event(node_id, "DEAD",
                                                    cause=cause)
+        self.bump_liveness("deaths")
         self._rt.controller.set_node_state(node_id, alive=False,
                                            cause=cause)
-        # 1. Tear down the node's workers; collect its queue + running work.
-        queued, running_tasks, actor_ids = rec.scheduler.drain_for_death()
-        # 2. Re-place queued work.
+        # 0. Fence the incarnation BEFORE any re-placement (r17): the
+        #    node may be a partitioned/stalled zombie, not a corpse —
+        #    from here on, frames still arriving under its old epoch
+        #    are dropped and answered with NODE_FENCED, so nothing the
+        #    zombie produces can race the recovery below.
+        self._rt.controller.bump_incarnation(node_id)
+        # 1. Tear down the node's workers; collect its queue + running
+        #    work. A death declared by HEARTBEAT STALENESS keeps the
+        #    agent's control connection open (a partition delivers no
+        #    FIN either): if the node is actually alive, its next
+        #    frame on that connection earns the NODE_FENCED answer
+        #    that tells it to reset and re-register — closing the
+        #    socket here would instead surface as a clean reconnect
+        #    and hide the split-brain.
+        keep_conn = (cause == "heartbeat timeout"
+                     and getattr(rec.scheduler, "conn", None) is not None)
+        if keep_conn:
+            queued, running_tasks, actor_ids = \
+                rec.scheduler.drain_for_death(close_conn=False)
+            # Bounded fencing window: if the node really is dead (no
+            # process left to ever close its end), the kept socket
+            # would leak for the head's lifetime — reap it once the
+            # window lapses and no NEW registration replaced it. A
+            # partition outlasting the window still recovers: the
+            # agent sees the close on heal and rejoins, where the
+            # fresh incarnation + drained-mirror dedup give the same
+            # exactly-once outcome as the fence path.
+            old_conn = rec.scheduler.conn
+            window = max(10.0, 3.0 * _CFG.heartbeat_timeout_s)
+
+            def _reap(conn=old_conn):
+                # idempotent: a fenced agent already closed its side,
+                # and an ACTIVE chaos partition defers this close just
+                # like any other (the relay keeps test semantics)
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+            t = threading.Timer(window, _reap)
+            t.daemon = True
+            t.start()
+        else:
+            queued, running_tasks, actor_ids = \
+                rec.scheduler.drain_for_death()
+        # 2. Re-place queued work (attempt bumped: a zombie's terminal
+        #    event for the old attempt must lose to the re-placed
+        #    winner, first-terminal-wins).
         for spec in queued:
+            bump_attempt(spec)
             self.submit(spec)
         # 3. Recover running tasks and actors through the runtime's
         #    existing retry/restart machinery.
@@ -940,6 +1113,7 @@ class ClusterTaskManager:
                 "node_id": n.node_id, "alive": n.alive,
                 "is_head": n.is_head,
                 "draining": n.draining,
+                "suspect": n.suspect,
                 "resources_total": dict(n.scheduler.total),
                 "resources_available": dict(n.scheduler.avail),
                 "labels": n.labels,

@@ -52,6 +52,35 @@ def _define(name: str, default: Any, doc: str) -> None:
 _define("heartbeat_timeout_s", 3.0,
         "Node declared dead after this long without a heartbeat "
         "(reference gcs_health_check_manager period*threshold).")
+_define("suspect_s", 1.5,
+        "Suspicion threshold for gray failures (r17): a node whose "
+        "last heartbeat is older than this (but younger than "
+        "heartbeat_timeout_s) enters SUSPECT — routing, rebalance, "
+        "spillback, and PG planning skip it, the pull manager "
+        "deprioritizes it as a source, and the autoscaler excludes "
+        "its capacity — but NO recovery runs, so the next heartbeat "
+        "restores it for free (a 2 s blip costs routing preference, "
+        "not a node-death recovery). Must be < heartbeat_timeout_s; "
+        "0 disables the suspect state.")
+_define("chaos", False,
+        "Enable the protocol-level network fault-injection layer "
+        "(r17): tests/chaos.py can then partition, blackhole, slow, "
+        "or probabilistically drop frames per connection pair under "
+        "seeded rules (both wire engines). Off (default) the layer "
+        "is never constructed and the wire behavior is byte-"
+        "identical to a build without it. NEVER enable in "
+        "production.")
+_define("chaos_seed", 0,
+        "Seed for the chaos layer's probabilistic frame-drop rules, "
+        "so a failing chaos run replays deterministically.")
+_define("reconnect_backoff_base_s", 0.25,
+        "Initial delay between an agent's head-redial attempts after "
+        "a lost head connection; doubles per failure (jittered "
+        "+/-50%) up to reconnect_backoff_cap_s instead of hammering "
+        "the dead address at a fixed rate.")
+_define("reconnect_backoff_cap_s", 2.0,
+        "Ceiling on the agent's jittered exponential reconnect "
+        "backoff.")
 _define("spill_delay_s", 1.0,
         "Queued-task age before the scheduler offers it back to the "
         "cluster for spillback to another node.")

@@ -129,6 +129,17 @@ class Controller:
         # or below the watermark was already applied by this head (or
         # survives in the snapshot/WAL refs records) and is skipped.
         self._decref_seqs: dict[str, int] = {}
+        # Node incarnations (r17 partition-tolerant membership):
+        # node_id -> monotonic epoch, minted at every registration and
+        # bumped at every death declaration. WAL-logged and
+        # snapshotted, so incarnations stay monotonic across head
+        # restarts — a zombie from before ANY restart still fences.
+        # The head stamps the registration's incarnation on the
+        # agent's connection; frames from a connection whose
+        # incarnation trails this table are dropped and answered with
+        # NODE_FENCED (reference: GCS rejects RPCs from de-registered
+        # raylets the same way).
+        self._incarnations: dict[str, int] = {}
         # Head-HA logger (r15): set by the runtime once recovery is
         # done; while None (or during replay) the _walog hooks no-op.
         self.ha = None
@@ -447,6 +458,37 @@ class Controller:
                 if not rec.is_head:
                     self._walog("node_state", (node_id, alive, cause))
 
+    # ---- node incarnations (r17) ----
+    def mint_incarnation(self, node_id: str) -> int:
+        """Next incarnation for a (re)registering node. Monotonic per
+        node_id across head restarts (WAL-logged, snapshotted)."""
+        with self._lock:
+            inc = self._incarnations.get(node_id, 0) + 1
+            self._incarnations[node_id] = inc
+            self._walog("incarnation", (node_id, inc))
+            return inc
+
+    def bump_incarnation(self, node_id: str) -> int:
+        """Invalidate the node's current incarnation (death
+        declaration): any connection still carrying the old epoch is
+        fenced from here on — the zombie window closes the moment the
+        death recovery that re-places its work begins."""
+        return self.mint_incarnation(node_id)
+
+    def node_incarnation(self, node_id: str) -> Optional[int]:
+        # LOCK-FREE by design: called on every state-bearing agent
+        # frame (the fence admission check) — a GIL-atomic dict read
+        # of an int that only ever rises. Worst case a frame racing a
+        # death bump is admitted one beat early, which the death
+        # recovery's mirror drain already tolerates; taking the global
+        # controller lock here would re-serialize the hot dispatch
+        # path the r16 striping work got off it.
+        return self._incarnations.get(node_id)
+
+    def incarnations(self) -> dict:
+        with self._lock:
+            return dict(self._incarnations)
+
     def update_host_stats(self, node_id: str, stats: dict) -> None:
         with self._lock:
             rec = self._nodes.get(node_id)
@@ -473,7 +515,8 @@ class Controller:
     # legacy blob keys but are captured shard-aware (after the
     # frontier) — the blob SHAPE is unchanged across r15 <-> r16.
     _SNAPSHOT_TABLES = ("_kv", "_actors", "_named_actors", "_pgs",
-                        "_nodes", "_contained", "_decref_seqs")
+                        "_nodes", "_contained", "_decref_seqs",
+                        "_incarnations")
     _STRIPED_TABLES = ("_refcounts", "_pins", "_lineage", "_live_tasks")
 
     def snapshot_state(self, extra_fn: Optional[Any] = None) -> bytes:
@@ -570,6 +613,11 @@ class Controller:
                     self._decref_seqs[node_id] = max(cur, int(seq))
                 else:
                     self._decref_seqs.pop(node_id, None)
+        elif rtype == "incarnation":
+            node_id, inc = data
+            with self._lock:
+                cur = self._incarnations.get(node_id, 0)
+                self._incarnations[node_id] = max(cur, int(inc))
         elif rtype == "kv":
             ns, key, value = data
             with self._lock:

@@ -35,9 +35,12 @@ Record design note: records are SET-semantics wherever an increment
 would make replay order- or multiplicity-sensitive — refcounts and
 pins are logged as absolute values (coalesced into one ``refs`` record
 per flush window, the WAL's decref-batch analogue), mirrors and
-directories as keyed add/remove. Replaying a tail twice therefore
-converges to the same tables, which is what the recovery matrix in
-``tests/test_head_ha.py`` asserts.
+directories as keyed add/remove, and node ``incarnation`` records
+(r17 fencing epochs) as absolute values merged by max, so replaying a
+rotated segment can never roll an epoch back and resurrect a zombie.
+Replaying a tail twice therefore converges to the same tables, which
+is what the recovery matrix in ``tests/test_head_ha.py`` (and the
+incarnation round-trip in ``tests/test_membership.py``) asserts.
 """
 from __future__ import annotations
 

@@ -165,6 +165,20 @@ class _RuntimeMetrics:
             "entries/releases coalesced (plus buffered + forwarded "
             "fallbacks); head-side frames/entries applied and "
             "replayed frames deduped", ("counter",))
+        self.node_liveness = g(
+            "ray_tpu_node_liveness",
+            "Per-node liveness (r17): 1 for the node's current state "
+            "(alive / suspect / draining / dead)", ("node", "state"))
+        self.node_heartbeat_age = g(
+            "ray_tpu_node_heartbeat_age_s",
+            "Seconds since each node's last heartbeat (r17 liveness "
+            "plane)", ("node",))
+        self.membership = g(
+            "ray_tpu_membership",
+            "Partition-tolerant membership counters (r17): suspected/"
+            "recovered/deaths/fenced node transitions, fenced frames "
+            "dropped, fence notices sent, stale-attempt terminal "
+            "drops", ("counter",))
 
 
 _mx: Optional[_RuntimeMetrics] = None

@@ -146,6 +146,8 @@ class NodeAgent:
         # and re-register on GCS restart)
         self._reconnect_lock = threading.Lock()
         self._reconnecting = False
+        self._fencing = False          # r17 fence reset in progress
+        self.incarnation = 0           # r17 epoch (set at register)
         self._pending_relays: list = []          # (conn, msg) to replay
         # state-bearing fire-and-forget messages (task completions,
         # object locations, worker deaths) that failed during a head
@@ -265,6 +267,10 @@ class NodeAgent:
              "advertise_addr": self.advertise_addr,
              "max_workers": max_workers}, timeout=30.0)
         assert rep.get("node_id") == self.node_id
+        # r17: the epoch the head minted for this registration. The
+        # head checks it connection-side (no per-frame bytes); we keep
+        # it for logging and the fence handler's sanity check.
+        self.incarnation = int(rep.get("incarnation") or 0)
 
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="rtpu-agent-accept", daemon=True)
@@ -282,6 +288,10 @@ class NodeAgent:
         # sessions it abandoned before deciding what the outage means
         self._pull_server.on_conn_closed(conn)
         if self._stop.is_set():
+            return
+        if conn is not self.head:
+            # a SUPERSEDED head connection died (fence reset / rejoin
+            # already swapped in a fresh one): not an outage
             return
         if self._head_lost_at is None:
             self._head_lost_at = time.monotonic()
@@ -307,16 +317,22 @@ class NodeAgent:
         sys.stderr.write(f"ray_tpu node_agent {self.node_id}: head "
                          f"connection lost; reconnecting for up to "
                          f"{window:.0f}s\n")
+        import random as _random
         deadline = time.monotonic() + window
-        backoff = 0.25
+        backoff = max(0.05, _CFG.reconnect_backoff_base_s)
+        cap = max(backoff, _CFG.reconnect_backoff_cap_s)
         while not self._stop.is_set():
             if time.monotonic() > deadline:
                 sys.stderr.write("ray_tpu node_agent: head did not come "
                                  "back; shutting down\n")
                 self.shutdown()
                 return
-            self._stop.wait(backoff)
-            backoff = min(backoff * 1.6, 2.0)
+            # jittered exponential backoff (r17): a pod of agents
+            # losing one head must not redial in lockstep, and the
+            # doubling keeps a long outage from burning CPU on
+            # connect attempts
+            self._stop.wait(backoff * _random.uniform(0.5, 1.5))
+            backoff = min(backoff * 2.0, cap)
             try:
                 conn = protocol.connect(self.head_addr,
                                         self._handle_head_msg,
@@ -653,6 +669,17 @@ class NodeAgent:
     def _heartbeat_loop(self) -> None:
         last_spo: dict = {}
         while not self._stop.is_set():
+            # During a head outage the reconnect loop owns the socket:
+            # skip the beat entirely (r17) instead of building a
+            # payload and hammering the dead connection every 0.5 s —
+            # the rejoin's register + outage-buffer flush is what
+            # matters, and the post-swap connection check below resets
+            # the delta base for a full first beat anyway.
+            with self._reconnect_lock:
+                reconnecting = self._reconnecting
+            if reconnecting or self._fencing:
+                self._stop.wait(HEARTBEAT_PERIOD_S)
+                continue
             try:
                 # per-object serve counts ride the heartbeat only when
                 # they CHANGED (the head merges, keeping its last copy):
@@ -795,10 +822,121 @@ class NodeAgent:
                              args=(conn, msg),
                              name="rtpu-agent-metrics-dump",
                              daemon=True).start()
+        elif mtype == protocol.NODE_FENCED:
+            # off the reader thread: the reset kills workers, redials
+            # the head, and blocks in a register request — none of
+            # which may run on the shared poller loop
+            threading.Thread(target=self._on_fenced, args=(msg,),
+                             name="rtpu-agent-fenced",
+                             daemon=True).start()
         elif mtype == protocol.NODE_SHUTDOWN:
             self.shutdown()
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)
+
+    # ------------------------------------- incarnation fencing (r17)
+    def _on_fenced(self, msg: dict) -> None:
+        """The head declared this node dead while it was alive (we
+        were partitioned / stalled past the death timeout) and has
+        re-placed everything we owed it. Our in-flight work, parked
+        completions, and buffered releases now belong to a SUPERSEDED
+        incarnation — finishing or flushing any of it would double-
+        count against the re-placed winners (the head would fence the
+        frames anyway). Reset: kill the workers, clear every ledger,
+        re-register fresh."""
+        with self._reconnect_lock:
+            if self._fencing or self._stop.is_set():
+                return
+            self._fencing = True
+        sys.stderr.write(
+            f"ray_tpu node_agent {self.node_id}: FENCED by head "
+            f"(stale incarnation {self.incarnation}; current "
+            f"{msg.get('incarnation')}) — killing workers, clearing "
+            f"ledgers, re-registering fresh\n")
+        try:
+            self._fence_reset()
+        finally:
+            with self._reconnect_lock:
+                self._fencing = False
+
+    def _fence_reset(self) -> None:
+        # 1. workers + local scheduling state (the dispatch loop keeps
+        #    running; fresh workers spawn for post-rejoin work)
+        self.scheduler.reset_for_fence()
+        # 2. every agent-side ledger and replay ring: nothing from the
+        #    fenced incarnation may ever be (re)sent
+        with self._lease_lock:
+            self._lease_of.clear()
+            self._leases.clear()
+        with self._done_lock:
+            self._done_buf.clear()
+            self._done_sent.clear()
+        with self._decref_send_lock:
+            with self._decref_lock:
+                self._decref_buf.clear()
+                self._decref_sent.clear()
+                self._decref_seq = 0   # fresh register resets the
+                                       # head's watermark to match
+        with self._reconnect_lock:
+            self._pending_sends.clear()
+            self._pending_relays = []
+            self._reconnecting = False
+        self._head_lost_at = None
+        # 3. fresh connection + FRESH (non-rejoin) registration: the
+        #    old epoch's state is gone by design, so there is nothing
+        #    to replay — rejoin semantics would re-attach exactly the
+        #    zombie state the fence exists to discard
+        old = self.head
+        deadline = time.monotonic() + max(
+            10.0, _CFG.agent_reconnect_window_s)
+        conn = None
+        while not self._stop.is_set():
+            try:
+                conn = protocol.connect(
+                    self.head_addr, self._handle_head_msg,
+                    self._on_head_closed, name="head",
+                    poller=self._poller)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    self.shutdown()
+                    return
+                self._stop.wait(0.3)
+        if conn is None:
+            return
+        self.head = conn               # swap BEFORE closing the old
+        try:
+            old.close()
+        except Exception:
+            pass
+        try:
+            rep = conn.request(
+                {"type": protocol.NODE_REGISTER,
+                 "resources": self._resources, "labels": self._labels,
+                 "node_id": self.node_id,
+                 "advertise_addr": self.advertise_addr,
+                 "max_workers": self._max_workers}, timeout=30.0)
+            if rep.get("node_id") != self.node_id:
+                raise RuntimeError("re-register refused")
+            self.incarnation = int(rep.get("incarnation") or 0)
+        except BaseException:
+            # register failed (head flapping): close the fresh conn —
+            # its on_close fires the ordinary reconnect machinery,
+            # which rejoins against our (now empty) state
+            try:
+                conn.close()
+            except Exception:
+                pass
+            return
+        # 4. re-advertise object copies that survived the fence (real
+        #    bytes in our store; the death recovery purged their
+        #    locations) so getters and lineage stop regenerating them
+        for oid, nbytes in self.store.held_objects():
+            self.send_event("object_at", object_id=oid, nbytes=nbytes,
+                            addref=False)
+        sys.stderr.write(
+            f"ray_tpu node_agent {self.node_id}: re-registered fresh "
+            f"as incarnation {self.incarnation}\n")
 
     # ------------------------------------------ delegated leases (r10)
     def _on_lease_batch(self, msg: dict) -> None:
@@ -1193,16 +1331,23 @@ class NodeAgent:
         # immediately route the next task here)
         is_plain = not (msg.get("is_actor_create")
                         or msg.get("is_actor_task"))
+        fin_spec = None
         if msg.get("is_actor_create"):
             self.scheduler.actor_ready(worker_id)
         elif msg.get("is_actor_task"):
             pass                       # actor keeps its resources
         else:
-            self.scheduler.task_finished(worker_id, msg.get("task_id"))
+            fin_spec = self.scheduler.task_finished(
+                worker_id, msg.get("task_id"))
         ctrl = {k: v for k, v in msg.items()
                 if k not in ("results", "rid", "type")}
         entry = {"worker_id": worker_id, "inline": inline,
                  "located": located, **ctrl}
+        if fin_spec is not None:
+            # r17: echo the attempt this node executed — the head
+            # drops terminal entries whose attempt trails the live
+            # spec (first-terminal-wins across re-placements)
+            entry["attempt"] = int(getattr(fin_spec, "attempt", 0))
         # consume the lease UNCONDITIONALLY for plain tasks — even
         # when the batch path below is momentarily off (e.g. a fresh
         # head reconnect whose wire version is still unobserved), the
@@ -1324,6 +1469,10 @@ class NodeAgent:
             rep = {}
         locs = list(rep.get("locations") or ())
         random.shuffle(locs)
+        # r17: suspect holders last (stable sort keeps the shuffle's
+        # load spread within each group) — a gray-failing node must
+        # not be the source a transfer gambles its deadline on
+        locs.sort(key=lambda l: bool(l.get("suspect")))
         for loc in locs:
             nid = loc.get("node_id")
             if nid == self.node_id or nid in seen:

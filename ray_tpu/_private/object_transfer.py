@@ -95,6 +95,9 @@ OBJECT_PLANE_STATS = {
     "pulls_failed": 0,
     "pull_bytes": 0,
     "pull_dedup_hits": 0,     # pulls that joined an in-flight transfer
+    "pull_suspect_deferred": 0,  # holders deferred to the rotation
+                              #   tail because their node is SUSPECT
+                              #   (r17 gray-failure deprioritization)
     "chunk_retries": 0,       # chunk-level session re-opens
     "serves_started": 0,      # pull sessions opened by remote pullers
     "serves_completed": 0,

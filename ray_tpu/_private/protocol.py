@@ -166,6 +166,16 @@ NODE_DECREF_DELTA = "node_decref_delta"  # agent -> head (r16; wire
                                        #   replays dedup (the r15
                                        #   done-batch discipline
                                        #   extended to decrefs)
+NODE_FENCED = "node_fenced"            # head -> agent (r17): a state-
+                                       #   bearing frame arrived from a
+                                       #   STALE node incarnation (the
+                                       #   node was declared dead while
+                                       #   still alive — partition/
+                                       #   stall zombie). The frame was
+                                       #   dropped; the agent must kill
+                                       #   its workers, clear its
+                                       #   scheduler/lease ledgers, and
+                                       #   re-register fresh.
 
 
 class ConnectionClosed(Exception):
@@ -177,6 +187,277 @@ class FrameTooLarge(ConnectionClosed):
     (or hostile) stream. The connection dies before the reader
     attempts a multi-GB allocation; existing ConnectionClosed handling
     covers recovery."""
+
+
+# ---- protocol-level network fault injection (r17) ----
+# One process-wide ChaosNet, constructed lazily ONLY when
+# RAY_TPU_CHAOS=1 — with chaos off the module global stays None and
+# the hot-path hooks cost a single global load + None check, with
+# byte-identical wire behavior. Both engines pass through the hook
+# points: every decoded inbound frame funnels through
+# Connection._handle_frame and every outbound write through
+# Connection._emit_locked, regardless of native/python pump.
+_CHAOS_NET: Optional["ChaosNet"] = None
+
+
+def chaos_net() -> Optional["ChaosNet"]:
+    """The process chaos controller, created on first call when
+    RAY_TPU_CHAOS=1 (None otherwise). Once created it persists for
+    the process; tests clear its rules rather than destroy it."""
+    global _CHAOS_NET
+    if _CHAOS_NET is None:
+        from ray_tpu._private.config import CONFIG
+        if not CONFIG.chaos:
+            return None
+        _CHAOS_NET = ChaosNet(CONFIG.chaos_seed)
+    return _CHAOS_NET
+
+
+class ChaosNet:
+    """Deterministic protocol-level fault injection between this
+    process and named peers (tests/chaos.py drives it).
+
+    Rules are keyed by peer id — matched against a connection's
+    ``meta["node_id"]`` (set at NODE_REGISTER), ``meta["chaos_peer"]``
+    (explicit test tag), its ``name``, or the wildcard ``"*"`` — and
+    carry a mode:
+
+    - ``partition``: TCP-faithful link partition. Frames are PARKED
+      (not lost — a partition makes TCP traffic late, not gone:
+      retransmission delivers it after heal), inbound on a relay
+      queue, outbound in a per-connection buffer flushed FIFO-ahead
+      of the first post-heal write. ``Connection.close()`` on a
+      matching connection is DEFERRED: a partitioned link delivers
+      no FIN either, so the head declaring the node dead must not
+      tear the stream down — after heal the zombie's frames arrive
+      on the SAME connection under a stale incarnation, which is
+      exactly the split-brain the fencing layer exists to stop. A
+      blip shorter than the death timeout instead delivers
+      everything late and loses nothing.
+    - ``blackhole``: every matching frame vanishes permanently (a
+      lossy/asymmetric link, stronger than any real partition).
+    - ``drop``: each frame dropped with probability ``p`` from the
+      seeded RNG (RAY_TPU_CHAOS_SEED — failing runs replay).
+    - ``delay``: inbound frames relay ``delay_s`` late (per-arrival
+      FIFO); outbound writes sleep in the emitter (a slow link with
+      real backpressure).
+    """
+
+    _PARK_CAP = 100_000            # frames parked per direction/conn
+
+    def __init__(self, seed: int = 0):
+        import random as _random
+        self._rnd = _random.Random(seed)
+        self._lock = threading.Lock()
+        self._rules: dict[str, dict] = {}
+        self.active = False          # fast-path gate: False == no rules
+        self.stats = {"dropped_in": 0, "dropped_out": 0, "delayed": 0,
+                      "parked_in": 0, "parked_out": 0,
+                      "deferred_closes": 0}
+        # delay-mode relay: (release_t, conn, frame) in arrival order
+        self._delayq: list = []
+        # partition-mode parking: id(conn) -> (conn, [frames])
+        self._parked_in: dict[int, tuple] = {}
+        self._parked_out: dict[int, tuple] = {}
+        self._cv = threading.Condition(self._lock)
+        self._relay_thread: Optional[threading.Thread] = None
+        self._deferred_close: list = []
+
+    # ---- rule management (tests) ----
+    def set_rule(self, peer: str, mode: str, direction: str = "both",
+                 p: float = 1.0, delay_s: float = 0.0) -> None:
+        assert mode in ("partition", "blackhole", "drop", "delay"), mode
+        assert direction in ("in", "out", "both"), direction
+        with self._lock:
+            self._rules[peer] = {"mode": mode, "dir": direction,
+                                 "p": float(p), "delay_s": float(delay_s)}
+            self.active = True
+
+    def clear(self, peer: Optional[str] = None) -> None:
+        """Heal: drop one rule (or all). Parked partition traffic
+        drains — the relay thread replays inbound frames FIFO and
+        outbound buffers flush ahead of the next write (nudged here so
+        an idle direction still delivers). Deferred closes are simply
+        forgotten: the link is healthy again and the connection keeps
+        serving; if its owner really wanted it gone, the peer's own
+        close (or fencing) finishes the job."""
+        with self._lock:
+            if peer is None:
+                self._rules.clear()
+            else:
+                self._rules.pop(peer, None)
+            self.active = bool(self._rules)
+            if not self.active:
+                self._deferred_close.clear()
+            self._ensure_relay_locked()
+            self._cv.notify_all()
+            flush = [conn for _cid, (conn, frames)
+                     in self._parked_out.items() if frames]
+        for conn in flush:
+            threading.Thread(target=conn._chaos_flush,
+                             name="ray-tpu-chaos-flush",
+                             daemon=True).start()
+
+    def _rule_for(self, conn: "Connection") -> Optional[dict]:
+        rules = self._rules
+        meta = conn.meta
+        for key in (meta.get("node_id"), meta.get("chaos_peer"),
+                    conn.name, "*"):
+            if key is not None:
+                r = rules.get(key)
+                if r is not None:
+                    return r
+        return None
+
+    def _parks(self, conn: "Connection", direction: str) -> bool:
+        rule = self._rule_for(conn)
+        return (rule is not None and rule["mode"] == "partition"
+                and rule["dir"] in (direction, "both"))
+
+    def _ensure_relay_locked(self) -> None:
+        if self._relay_thread is None:
+            self._relay_thread = threading.Thread(
+                target=self._relay_loop, name="ray-tpu-chaos-relay",
+                daemon=True)
+            self._relay_thread.start()
+
+    # ---- inbound hook ----
+    def on_frame_in(self, conn: "Connection", data: bytes) -> bool:
+        """True = the frame was consumed (parked/dropped/delayed);
+        False = deliver normally. Loss rules (blackhole/drop) are
+        evaluated BEFORE the heal-drain FIFO park: a rule installed
+        while a previous partition's backlog is still draining must
+        discard fresh frames, not smuggle them through the queue."""
+        rule = self._rule_for(conn)
+        applies = rule is not None and rule["dir"] in ("in", "both")
+        mode = rule["mode"] if applies else None
+        with self._lock:
+            entry = self._parked_in.get(id(conn))
+            if mode == "partition":
+                if entry is None:
+                    entry = self._parked_in[id(conn)] = (conn, [])
+                if len(entry[1]) < self._PARK_CAP:
+                    entry[1].append(data)
+                    self.stats["parked_in"] += 1
+                else:
+                    self.stats["dropped_in"] += 1
+                self._ensure_relay_locked()
+                return True
+            if mode == "blackhole" or (
+                    mode == "drop"
+                    and self._rnd.random() < rule["p"]):
+                self.stats["dropped_in"] += 1
+                return True
+            if entry is not None:
+                # heal flush still draining: keep FIFO — this frame
+                # queues behind the parked backlog. The entry persists
+                # (possibly empty) until the relay thread observes it
+                # drained AFTER its last delivery completed, so a
+                # fresh frame can never overtake an in-flight parked
+                # one (seq-watermarked deltas would drop the late
+                # frame as a replay otherwise).
+                entry[1].append(data)
+                self._ensure_relay_locked()
+                self._cv.notify_all()
+                return True
+            if mode == "delay":
+                self._delayq.append(
+                    (time.monotonic() + rule["delay_s"], conn, data))
+                self.stats["delayed"] += 1
+                self._ensure_relay_locked()
+                self._cv.notify_all()
+                return True
+        return False
+
+    # ---- outbound hook (caller holds conn._send_lock) ----
+    def filter_out(self, conn: "Connection", frames: list) -> list:
+        with self._lock:
+            entry = self._parked_out.get(id(conn))
+            parks = self._parks(conn, "out")
+            if parks:
+                if entry is None:
+                    entry = self._parked_out[id(conn)] = (conn, [])
+                room = self._PARK_CAP - len(entry[1])
+                entry[1].extend(frames[:room])
+                self.stats["parked_out"] += min(len(frames), room)
+                self.stats["dropped_out"] += max(0,
+                                                 len(frames) - room)
+                return []
+            prefix = []
+            if entry is not None:
+                # healed: parked frames flush FIRST (the caller holds
+                # the send lock, so FIFO with this write is exact)
+                prefix = entry[1][:]
+                del self._parked_out[id(conn)]
+        rule = self._rule_for(conn)
+        if rule is None or rule["dir"] == "in":
+            return prefix + frames
+        mode = rule["mode"]
+        if mode == "blackhole":
+            self.stats["dropped_out"] += len(frames)
+            return prefix
+        if mode == "drop":
+            kept = []
+            with self._lock:
+                for f in frames:
+                    if self._rnd.random() < rule["p"]:
+                        self.stats["dropped_out"] += 1
+                    else:
+                        kept.append(f)
+            return prefix + kept
+        if mode == "delay":
+            time.sleep(rule["delay_s"])  # slow link: real backpressure
+        return prefix + frames
+
+    def has_parked_out(self, conn: "Connection") -> bool:
+        entry = self._parked_out.get(id(conn))
+        return entry is not None and bool(entry[1])
+
+    def defer_close(self, conn: "Connection") -> bool:
+        """True when `conn` sits behind an active both-direction
+        partition/blackhole: the close is swallowed (recorded) — a
+        partitioned link delivers no FIN, so the stream must survive
+        for the post-heal fencing exchange."""
+        rule = self._rule_for(conn)
+        if rule is None or rule["mode"] not in ("partition",
+                                                "blackhole") \
+                or rule["dir"] != "both":
+            return False
+        with self._lock:
+            self._deferred_close.append(conn)
+        self.stats["deferred_closes"] += 1
+        return True
+
+    # ---- relay thread: delayed frames + healed partition backlogs ----
+    def _relay_loop(self) -> None:
+        while True:
+            item = None
+            with self._lock:
+                # healed partitions first: replay parked inbound FIFO
+                for cid, (conn, frames) in list(self._parked_in.items()):
+                    if self._parks(conn, "in"):
+                        continue             # still partitioned
+                    if frames:
+                        item = (conn, frames.pop(0))
+                        break
+                    del self._parked_in[cid]
+                if item is None and self._delayq:
+                    release_t, conn, data = self._delayq[0]
+                    wait = release_t - time.monotonic()
+                    if wait <= 0:
+                        self._delayq.pop(0)
+                        item = (conn, data)
+                    else:
+                        self._cv.wait(min(wait, 0.2))
+                        continue
+                if item is None:
+                    self._cv.wait(0.2)
+                    continue
+            conn, data = item
+            try:
+                conn._handle_frame(data, _chaos_checked=True)
+            except Exception:
+                pass                     # chaos must not kill the relay
 
 
 def _auth_token() -> Optional[bytes]:
@@ -473,6 +754,11 @@ class Connection:
         released, and a Python-plane frame's pickled body goes from
         the pickler to the kernel with zero copies; the fallback joins
         and sendall()s. Caller holds _send_lock."""
+        ch = _CHAOS_NET
+        if ch is not None and (ch.active or ch.has_parked_out(self)):
+            frames = ch.filter_out(self, frames)
+            if not frames:
+                return               # swallowed/parked: sender unaware
         if not self._peer_speaks_trace():
             # old-wire peer: strip trace context rather than spend
             # bytes it will skip (copies, not mutation — callers may
@@ -586,8 +872,14 @@ class Connection:
             remaining -= len(chunk)
         return b"".join(chunks)
 
-    def _handle_frame(self, data: bytes) -> None:
+    def _handle_frame(self, data: bytes,
+                      _chaos_checked: bool = False) -> None:
         """Decode one framed body and dispatch its message(s)."""
+        ch = _CHAOS_NET
+        if ch is not None and not _chaos_checked and (
+                ch.active or ch._parked_in):
+            if ch.on_frame_in(self, data):
+                return               # parked / dropped / delayed
         msg, version = loads_ex(data)
         self.peer_wire_version = version
         WIRE_STATS["rx_frames"] += 1
@@ -783,11 +1075,23 @@ class Connection:
             raise ConnectionClosed("peer closed")
         return frames
 
+    def _chaos_flush(self) -> None:
+        """Emit frames a healed chaos partition parked for this
+        connection (filter_out prepends them to an empty write)."""
+        try:
+            with self._send_lock:
+                self._emit_locked([])
+        except ConnectionClosed:
+            pass
+
     @property
     def closed(self) -> bool:
         return self._closed.is_set()
 
     def close(self) -> None:
+        ch = _CHAOS_NET
+        if ch is not None and ch.active and ch.defer_close(self):
+            return                  # partitioned link: no FIN either
         self._closed.set()
         self._lazy_wake.set()       # release the coalescing flusher
         try:

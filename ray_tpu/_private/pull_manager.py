@@ -90,9 +90,16 @@ class PullManager:
                  on_source_failed: Optional[Callable] = None,
                  on_partial: Optional[Callable] = None,
                  on_partial_failed: Optional[Callable] = None,
+                 deprioritize_fn: Optional[Callable[[Any], bool]] = None,
                  name: str = ""):
         self._store = store
         self._sources_fn = sources_fn
+        # r17 suspicion: `deprioritize_fn(source_id)` -> True moves a
+        # holder to the END of the rotation (tried only after every
+        # healthy holder failed). The head backs it with the cluster's
+        # SUSPECT flag so a gray-failing node stops being the first
+        # source a transfer gambles its deadline on.
+        self._deprioritize = deprioritize_fn
         self._on_complete = on_complete
         self._on_source_failed = on_source_failed
         # cut-through hooks (r12): `on_partial(object_id, nbytes)`
@@ -183,7 +190,7 @@ class PullManager:
             stored = self._store.get_stored(object_id, timeout=0)
             if stored is not None:      # landed while we queued
                 return stored
-            for source_id, conn in self._sources_fn(object_id, prefer):
+            for source_id, conn in self._iter_sources(object_id, prefer):
                 if conn is None or getattr(conn, "closed", False):
                     continue
                 remaining = (None if deadline is None
@@ -250,6 +257,27 @@ class PullManager:
                         self._on_partial_failed(object_id)
                     except Exception:
                         pass
+
+    def _iter_sources(self, object_id: str, prefer: Optional[dict]):
+        """The caller's source rotation with suspect holders deferred
+        to the tail (r17): lazily forwarded when no deprioritize hook
+        is installed, so the agent-side lazy peer dials keep their
+        one-dial-per-yield behavior."""
+        if self._deprioritize is None:
+            yield from self._sources_fn(object_id, prefer)
+            return
+        deferred = []
+        for src in self._sources_fn(object_id, prefer):
+            try:
+                suspect = bool(self._deprioritize(src[0]))
+            except Exception:
+                suspect = False
+            if suspect:
+                OBJECT_PLANE_STATS["pull_suspect_deferred"] += 1
+                deferred.append(src)
+            else:
+                yield src
+        yield from deferred
 
     def inflight(self) -> int:
         with self._lock:

@@ -62,6 +62,9 @@ class RemoteNodeHandle:
         # move via the trace_dump pull) — surfaced by trace_stats
         self.trace_watermark = 0
         self._dead = False
+        # r17: the incarnation minted at this registration (set by
+        # cluster.add_remote_node); surfaced by liveness_stats.
+        self.incarnation = 0
         # Drain state (r14): head-side routing flag — the agent itself
         # keeps running so in-flight work finishes and completions
         # flow; reclaim of its queued backlog goes through the r10
@@ -506,14 +509,22 @@ class RemoteNodeHandle:
     def start(self) -> None:                     # NodeRecord protocol
         pass
 
-    def drain_for_death(self):
+    def drain_for_death(self, close_conn: bool = True):
         """(queued specs, running TaskSpecs, actor ids) from the mirror.
 
         Delegated tasks (leased or still parked in the lease buffer)
         sit in the mirror with dispatched=False, so they all come back
         as "queued" and re-place through cluster.submit exactly once —
         the agent's workers died with it, so no completion can race a
-        resubmission into a double execution."""
+        resubmission into a double execution.
+
+        ``close_conn=False`` (r17, heartbeat-timeout deaths): the
+        control connection is left OPEN. A node declared dead by
+        staleness may be a partitioned zombie whose workers are still
+        running — its post-heal frames must arrive (and be fenced by
+        their stale incarnation, triggering the agent's reset +
+        re-register) rather than vanish into a closed socket. The fd
+        is released later by the agent's own close or process exit."""
         self._lease_flusher.stop()       # dead-before-wake, race-free
         with self._lease_lock:
             self._lease_buf.clear()
@@ -528,10 +539,11 @@ class RemoteNodeHandle:
                    if dispatched and isinstance(s, TaskSpec)]
         actor_ids = [s.actor_id for s, dispatched in work
                      if dispatched and isinstance(s, ActorSpec)]
-        try:
-            self.conn.close()
-        except Exception:
-            pass
+        if close_conn:
+            try:
+                self.conn.close()
+            except Exception:
+                pass
         return queued, running, actor_ids
 
     def die_silently(self) -> None:

@@ -32,7 +32,8 @@ from ray_tpu._private.controller import (ALIVE, DEAD, PENDING, RESTARTING,
 from ray_tpu._private.object_store import LocalStore, StoredObject, deserialize
 from ray_tpu._private.refs import ObjectRef
 from ray_tpu._private.scheduler import Scheduler
-from ray_tpu._private.specs import ActorSpec, ActorTaskSpec, TaskSpec
+from ray_tpu._private.specs import (ActorSpec, ActorTaskSpec, TaskSpec,
+                                    bump_attempt)
 from ray_tpu.exceptions import (ActorDiedError, ActorError, GetTimeoutError,
                                 TaskCancelledError, TaskError,
                                 WorkerDiedError)
@@ -118,6 +119,16 @@ class Runtime(_context.BaseContext):
         # entries + replayed frames dropped by the seq watermark
         self._decref_delta_stats = {"frames": 0, "entries": 0,
                                     "deduped_frames": 0}
+        # r17 membership fencing: frames dropped because their
+        # connection's incarnation trails the node table (zombie after
+        # a partition/stall) + terminal entries dropped because their
+        # attempt counter trails the live spec (first-terminal-wins).
+        self._fence_stats = {"fenced_frames": 0, "fence_notices": 0,
+                             "stale_attempt_drops": 0}
+        # reader threads are per-connection with RAY_TPU_EPOLL=0, so
+        # these read-modify-writes need the same discipline as the
+        # cluster's liveness counters
+        self._fence_lock = threading.Lock()
         # serializes snapshot publication: the periodic loop, manual
         # snapshot_now calls, and WAL compaction share one tmp/.prev
         # rotation chain — concurrent writers would rename each
@@ -176,6 +187,8 @@ class Runtime(_context.BaseContext):
             self.store, sources_fn=self._head_pull_sources,
             on_source_failed=lambda oid, nid:
                 self.controller.remove_location(oid, nid),
+            # r17: suspect holders go to the end of the rotation
+            deprioritize_fn=self.cluster.is_suspect,
             # cut-through (r12): the head mid-pull serves landed chunk
             # ranges too — register/retract it as a partial holder so
             # a broadcast rooted elsewhere can relay through it
@@ -410,6 +423,7 @@ class Runtime(_context.BaseContext):
                 tid, getattr(spec, "name", ""), "RESUBMITTED",
                 error="head restart")
             try:
+                bump_attempt(spec)
                 self.cluster.submit(spec)
                 resubmitted += 1
             except Exception:
@@ -509,6 +523,7 @@ class Runtime(_context.BaseContext):
                 spec.task_id, spec.name, "RESUBMITTED",
                 error=f"lease lost in head restart ({node_id})")
             try:
+                bump_attempt(spec)
                 self.cluster.submit(spec)
             except Exception:
                 log.exception("lease-resync resubmit failed")
@@ -598,6 +613,7 @@ class Runtime(_context.BaseContext):
             return
         if spec.retries_used < spec.max_retries:
             spec.retries_used += 1
+            bump_attempt(spec)
             self.controller.record_task_event(
                 spec.task_id, spec.name, "RETRYING")
             self.cluster.submit(spec)
@@ -727,8 +743,58 @@ class Runtime(_context.BaseContext):
                     pass
         threading.Thread(target=_run, name=name, daemon=True).start()
 
+    # ---- incarnation fencing (r17) ----
+    # State-bearing frame types an agent emits: every one of these is
+    # admission-checked against the node incarnation table before it
+    # can touch head state. Request/reply relays (SUBMIT/WAIT/KV) are
+    # deliberately NOT fenced — their effects are idempotent or
+    # re-issued by the re-placed winner, and swallowing their replies
+    # would hang workers the fence reset is about to kill anyway.
+    _FENCED_TYPES = frozenset((
+        protocol.NODE_HEARTBEAT, protocol.NODE_EVENT,
+        protocol.NODE_TASK_DONE, protocol.NODE_TASK_DONE_BATCH,
+        protocol.NODE_DECREF_DELTA, protocol.OBJECT_ADDED,
+        protocol.OBJECT_REMOVED, protocol.DECREF,
+        protocol.DECREF_BATCH, protocol.ADDREF))
+
+    def _admit_node_frame(self, conn: protocol.Connection,
+                          msg: dict) -> bool:
+        """False = the frame came from a STALE incarnation of its node
+        (declared dead while still alive): drop it — none of its
+        completions, refcount releases, or location claims may land —
+        and answer NODE_FENCED once per connection, telling the zombie
+        to kill its workers, clear its ledgers, and re-register."""
+        inc = conn.meta.get("incarnation")
+        if inc is None:
+            return True          # local worker conn / pre-r17 agent
+        nid = conn.meta.get("node_id") or msg.get("node_id")
+        cur = self.controller.node_incarnation(nid)
+        if cur is None or inc == cur:
+            return True
+        with self._fence_lock:
+            self._fence_stats["fenced_frames"] += 1
+        if not conn.meta.get("fence_notified"):
+            conn.meta["fence_notified"] = True
+            with self._fence_lock:
+                self._fence_stats["fence_notices"] += 1
+            self.cluster.bump_liveness("fenced")
+            self.controller.publish_node_event(
+                nid, "FENCED",
+                cause=f"stale incarnation {inc} < {cur}")
+            log.warning("fencing node %s: frame from stale "
+                        "incarnation %s (current %s)", nid, inc, cur)
+            try:
+                conn.send({"type": protocol.NODE_FENCED,
+                           "node_id": nid, "incarnation": cur})
+            except protocol.ConnectionClosed:
+                pass
+        return False
+
     def _handle_msg(self, conn: protocol.Connection, msg: dict) -> None:
         mtype = msg["type"]
+        if (mtype in self._FENCED_TYPES
+                and not self._admit_node_frame(conn, msg)):
+            return
         if mtype == protocol.REGISTER:
             sched = self._scheduler_for_worker(msg["worker_id"])
             if sched is not None:
@@ -863,6 +929,11 @@ class Runtime(_context.BaseContext):
                 advertise_addr=tuple(msg["advertise_addr"]),
                 node_id=msg.get("node_id"))
             conn.meta["node_id"] = rec.node_id
+            # r17: this connection speaks for the incarnation minted
+            # at THIS registration — frames from any older connection
+            # of the same node are fenced from here on
+            conn.meta["incarnation"] = rec.scheduler.incarnation
+            conn.meta.pop("fence_notified", None)
             if msg.get("rejoin"):
                 self._process_rejoin(rec, msg)
             else:
@@ -870,7 +941,8 @@ class Runtime(_context.BaseContext):
                 # its decref-delta seq counter: drop the watermark or
                 # its first frames would be deduped as replays
                 self.controller.reset_decref_seq(rec.node_id)
-            conn.reply(msg, node_id=rec.node_id)
+            conn.reply(msg, node_id=rec.node_id,
+                       incarnation=rec.scheduler.incarnation)
         elif mtype == protocol.NODE_HEARTBEAT:
             nid = msg["node_id"]
             self.cluster.heartbeat(nid)
@@ -1021,6 +1093,7 @@ class Runtime(_context.BaseContext):
                         getattr(mirror, "_spill_count", 0) + 1
                 except AttributeError:
                     pass
+                bump_attempt(mirror)
                 self.cluster.submit(mirror)
         elif kind == "unplaceable":
             if proxy is not None:
@@ -1092,6 +1165,39 @@ class Runtime(_context.BaseContext):
 
     def _apply_node_done(self, node_id: str, proxy, msg: dict,
                          replayed: bool = False) -> None:
+        # r17 first-terminal-wins: a completion whose attempt counter
+        # trails the live spec executed a SUPERSEDED placement (the
+        # task was re-placed after a death declaration / reclaim) —
+        # drop the whole entry before any seal/directory/unpin runs,
+        # or the loser's results and refcount releases would land on
+        # top of the winner's. A task that is no longer LIVE already
+        # saw its first terminal (winner applied, or cancelled/failed):
+        # any later attempt-carrying entry is a loser or a duplicate —
+        # drop it too, or its re-seal would refresh nested-ref
+        # containment with the loser's fresh inner ids and decref the
+        # winner's (premature free).
+        att = msg.get("attempt")
+        if (att is not None and not msg.get("is_actor_create")
+                and not msg.get("is_actor_task")):
+            task_id_ = msg.get("task_id")
+            live = self.controller.live_task(task_id_)
+            if live is None:
+                if replayed and self._ha is not None:
+                    # r15 accounting: a replayed entry whose task is
+                    # already terminal is a dedup, same as the
+                    # empty-mirror-pop path it used to take
+                    self._ha.note_replayed_completion(task_id_,
+                                                      deduped=True)
+                else:
+                    with self._fence_lock:
+                        self._fence_stats["stale_attempt_drops"] += 1
+                if proxy is not None:
+                    proxy.on_finished(task_id_)   # mirror hygiene
+                return
+            if getattr(live, "attempt", 0) > att:
+                with self._fence_lock:
+                    self._fence_stats["stale_attempt_drops"] += 1
+                return
         for stored in msg.get("inline", []):
             self._seal_contained(stored.object_id, stored.contained_ids)
             self.store.put_stored(stored)
@@ -1194,7 +1300,12 @@ class Runtime(_context.BaseContext):
                     if rec else None)
             if addr is not None:
                 locs.append({"host": addr[0], "port": int(addr[1]),
-                             "node_id": nid})
+                             "node_id": nid,
+                             # r17: pullers deprioritize suspect
+                             # holders (gray failure in progress) —
+                             # the flag is the contract; the agent
+                             # shuffles and re-orders locally
+                             "suspect": rec.suspect})
         conn.reply(msg, locations=locs,
                    head_has=self.store.contains(oid),
                    nbytes=self.controller.directory.nbytes(oid))
@@ -1582,6 +1693,20 @@ class Runtime(_context.BaseContext):
         m.decref_delta.set_many(
             [({"counter": "head_" + k}, float(v))
              for k, v in self._decref_delta_stats.items()])
+        # r17 membership plane: per-node liveness (one-hot by state) +
+        # last-heartbeat age, plus fence/suspicion transition counters
+        lv = self.cluster.liveness_stats()
+        m.node_liveness.set_many(
+            [({"node": row["node_id"], "state": row["state"]}, 1.0)
+             for row in lv["nodes"]])
+        m.node_heartbeat_age.set_many(
+            [({"node": row["node_id"]},
+              float(row["last_heartbeat_age_s"]))
+             for row in lv["nodes"]])
+        m.membership.set_many(
+            [({"counter": k}, float(v))
+             for k, v in {**lv["counters"],
+                          **self._fence_stats}.items()])
 
     def _trace_stats(self) -> dict:
         rec = _tp.recorder()
@@ -1639,6 +1764,7 @@ class Runtime(_context.BaseContext):
                 continue
             spec.lineage_resubmits = n + 1
             resubmitted.add(spec.task_id)
+            bump_attempt(spec)
             # back on the live books: the regenerating execution must
             # survive a head restart too
             self.controller.task_submitted(spec)
@@ -2092,6 +2218,15 @@ class Runtime(_context.BaseContext):
             # r16 striped-table + decref-delta observability
             return {"shards": self.controller.shard_stats(),
                     "decref_delta": dict(self._decref_delta_stats)}
+        if op == "liveness_stats":
+            # r17 membership observability: per-node liveness state +
+            # heartbeat age, incarnation table, fence/suspicion
+            # counters
+            return {
+                **self.cluster.liveness_stats(),
+                "incarnations": self.controller.incarnations(),
+                "fence": dict(self._fence_stats),
+            }
         if op == "head_ha_stats":
             # r15 head-HA observability: WAL bytes/records/fsync
             # latencies, snapshot age, recovery + replay-dedup counts
@@ -2132,7 +2267,10 @@ class Runtime(_context.BaseContext):
         _mp.set_sampler("head", None)
         # each step is independent: a wedged component must not block
         # the ones after it (especially the final shm sweep)
-        for step in ((lambda: (self._ha.close()
+        for step in ((lambda: (protocol._CHAOS_NET.clear()
+                               if protocol._CHAOS_NET is not None
+                               else None)),
+                     (lambda: (self._ha.close()
                                if self._ha is not None else None)),
                      self.cluster.shutdown, self.waiters.shutdown,
                      self.controller.pubsub.close,

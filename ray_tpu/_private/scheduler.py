@@ -1496,6 +1496,52 @@ class Scheduler:
                 except Exception:
                     pass
 
+    def reset_for_fence(self) -> None:
+        """Fenced-node reset (r17): the head declared this node dead
+        while it was still alive (partition / stalled link / long
+        pause) and has re-placed everything it owed — finishing the
+        local work would double-execute it. SIGKILL every worker, drop
+        the queue and every ledger, restore full availability. Unlike
+        ``die_silently`` the dispatch loop keeps running: the agent
+        re-registers fresh and earns NEW work on clean workers."""
+        with self._cv:
+            workers = list(self._workers.values())
+            self._workers.clear()
+            self._spawning = 0
+            self._pending.clear()
+            self._queued_at.clear()
+            self._pending_demand.clear()
+            self._bundles.clear()
+            self.avail = dict(self.total)
+            self._cv.notify_all()
+        doomed_oids: list = []
+        for rec in workers:
+            for task in rec.tasks.values():
+                doomed_oids.extend(getattr(task, "return_ids", ()))
+            if rec.conn is not None:
+                # detach so per-worker lost callbacks don't fire and
+                # re-report tasks the head already re-placed: this
+                # reset IS the recovery
+                rec.conn.meta.pop("worker_id", None)
+                try:
+                    rec.conn.close()
+                except Exception:
+                    pass
+            if rec.proc is not None:
+                try:
+                    rec.proc.kill()
+                except Exception:
+                    pass
+        # killed workers may have sealed result shm without delivering
+        # TASK_DONE — reap locally (the same hygiene the worker-lost
+        # path applies; shm outlives processes until reboot otherwise)
+        from ray_tpu._private.object_store import reap_object_segments
+        for oid in doomed_oids:
+            try:
+                reap_object_segments(oid)
+            except Exception:
+                pass
+
     def drain_for_death(self):
         """Collect (queued specs, running tasks, actor ids on this node)
         and tear everything down. Called by the cluster after the node is

@@ -112,6 +112,13 @@ class TaskSpec:
     # the pickled spec so scheduler/worker spans stitch cross-process.
     trace_id: int = 0
     parent_span: int = 0
+    # Partition-tolerant membership (r17): bumped at every re-place
+    # (retry, node-death resubmit, lease reclaim, lineage/head-restart
+    # resubmission). Completion entries echo the attempt they executed;
+    # the head drops terminal events for stale attempts (first-
+    # terminal-wins), so a fenced zombie's TASK_DONE can never race
+    # the re-placed winner into a double count.
+    attempt: int = 0
 
     def __getstate__(self):
         # The metrics plane's head-side submit stamp (_submit_mono) is
@@ -166,6 +173,17 @@ class ActorTaskSpec:
     # same contract as TaskSpec: the head-side e2e submit stamp never
     # ships in pickled copies
     __getstate__ = TaskSpec.__getstate__
+
+
+def bump_attempt(spec: Any) -> None:
+    """Advance a spec's re-place attempt counter (r17 membership):
+    call at EVERY site that hands an already-submitted spec back to
+    ``cluster.submit``. Safe on pre-r17 pickled specs (the attribute
+    is created) and on frozen/odd spec objects (best effort)."""
+    try:
+        spec.attempt = int(getattr(spec, "attempt", 0)) + 1
+    except Exception:
+        pass
 
 
 def pickle_callable(fn: Any) -> tuple[str, bytes]:

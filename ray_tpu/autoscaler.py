@@ -283,10 +283,16 @@ class Autoscaler:
         # effective availability before counting a shape as unmet.
         alive_nodes = self._cluster.alive_nodes()
         # draining nodes can't absorb spillback (routing skips them):
-        # their capacity must not mask demand for replacement hosts
+        # their capacity must not mask demand for replacement hosts.
+        # SUSPECT nodes (r17 gray failure in progress) are excluded
+        # for the same reason — routing skips them, so counting their
+        # capacity would hide real demand exactly when a node is
+        # flaking; the two-consecutive-sweep stability window below
+        # keeps a sub-second blip from launching hosts.
         sim_avail = {n.node_id: dict(n.scheduler.effective_avail())
                      for n in alive_nodes
-                     if not getattr(n, "draining", False)}
+                     if not getattr(n, "draining", False)
+                     and not getattr(n, "suspect", False)}
         hb_unmet: List[Dict[str, float]] = []
         for node in alive_nodes:
             for shape in node.scheduler.pending_shapes():
@@ -551,6 +557,12 @@ class Autoscaler:
                 continue
             if self._is_draining(nid):
                 continue            # the drain sweep owns its release
+            if getattr(node, "suspect", False):
+                # r17: a suspect node's is_idle() view is stale by
+                # definition — never retire a host mid-gray-failure
+                # (if it is truly dead the death path reclaims it)
+                self._idle_since.pop(nid, None)
+                continue
             if not node.scheduler.is_idle():
                 self._idle_since.pop(nid, None)
                 idle_map[nid] = False

@@ -464,6 +464,68 @@ def test_elastic_drain_before_kill_flushes_and_acks(head1, tmp_path):
 
 # ------------------------------------------------- multi-process chaos
 @pytest.mark.slow
+def test_elastic_chaos_partition_mid_fit_e2e(fast_heartbeat, tmp_path):
+    """r17 gate: PARTITION (not kill) a trainer node mid-fit() past the
+    death timeout, then heal. The elastic reshape must run exactly as
+    for a death (shrink + checkpoint restore), the healed zombie must
+    be FENCED (its frames arrive under a stale incarnation, its
+    workers die, it re-registers fresh) and the group must grow back —
+    with the (step, loss) curve byte-equal to an uninterrupted run."""
+    from ray_tpu.cluster_utils import NodeAgentProcess
+    prev = os.environ.get("RAY_TPU_CHAOS")
+    os.environ["RAY_TPU_CHAOS"] = "1"
+    CONFIG.reload()
+    rt = _fresh(1)
+    agents = [NodeAgentProcess(num_cpus=1) for _ in range(3)]
+    try:
+        assert chaos.wait_for(
+            lambda: len(rt.cluster.alive_nodes()) >= 4, 60)
+        steps = 14
+        ckpt_dir = os.path.join(str(tmp_path), "p17", "checkpoints")
+        victim = agents[0].node_id
+
+        def partition_then_heal():
+            chaos.partition(rt, victim)
+            # heal once the death was declared and the shrink is
+            # underway: the zombie's parked frames replay, get
+            # fenced, and the fresh re-register grows the group back
+            chaos.when(
+                lambda: not rt.cluster.get_node(victim).alive,
+                lambda: chaos.after(1.0, chaos.heal, rt, victim))
+
+        chaos.when(lambda: len(os.listdir(ckpt_dir)) >= 2,
+                   partition_then_heal)
+        result = _trainer(tmp_path, "p17", workers=4, min_workers=2,
+                          steps=steps, step_time=0.25).fit()
+        assert result.error is None
+        _assert_exact_steps(result, steps)
+        el = result.artifacts["elastic"]
+        assert el["reshapes"] >= 2 and el["restores"] >= 1
+        assert el["final_world_size"] == 4      # grew back post-fence
+        # the zombie was fenced, not silently re-adopted
+        assert rt._fence_stats["fence_notices"] >= 1
+        assert rt.controller.node_incarnation(victim) >= 3
+        # loss continuity vs an uninterrupted single-worker run
+        baseline = _trainer(tmp_path, "p17_base", workers=1,
+                            steps=steps, step_time=0.0).fit()
+        assert ([(m["step"], m["loss"]) for m in result.metrics_history]
+                == [(m["step"], m["loss"])
+                    for m in baseline.metrics_history])
+    finally:
+        chaos.heal()
+        for a in agents:
+            a.terminate()
+        for a in agents:
+            a.wait(5)
+        ray_tpu.shutdown()
+        if prev is None:
+            os.environ.pop("RAY_TPU_CHAOS", None)
+        else:
+            os.environ["RAY_TPU_CHAOS"] = prev
+        CONFIG.reload()
+
+
+@pytest.mark.slow
 def test_elastic_chaos_agent_kill_e2e(fast_heartbeat, tmp_path):
     """The full story on REAL node-agent subprocesses: SIGKILL an agent
     mid-epoch (unannounced), fit() shrinks + auto-restores with the

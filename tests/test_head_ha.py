@@ -401,8 +401,12 @@ def test_lease_ledger_resync_replaces_only_lost_tasks(ha_runtime):
 
 
 # -------------------------------------------- in-process restart e2e
+@pytest.mark.slow    # ~6s (r17 tier-1 budget): its tier-1 sibling
+                     # test_head_restart_in_process_completes_under_
+                     # original_ids covers the restart+resubmit path
+                     # end-to-end (and further asserts completion)
 def test_head_restart_in_process_resubmits_unfinished(tmp_path):
-    """Tier-1 sibling of the SIGKILL chaos gate: a head shut down with
+    """Sibling of the SIGKILL chaos gate: a head shut down with
     tasks still queued (its workers die with it) rehydrates from
     snapshot+WAL on restart and re-places every unfinished task — the
     results land under the ORIGINAL return ids."""

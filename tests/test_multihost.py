@@ -209,6 +209,10 @@ def test_agent_death_task_retry_and_lineage(head):
     assert arr[0] == 7.0 and arr.shape == (200_000,)
 
 
+@pytest.mark.slow    # ~2.5s (r17 tier-1 budget): tier-1 siblings —
+                     # test_agents_register_and_run_tasks covers the
+                     # remote-agent task path, tests/test_train.py
+                     # covers the JaxTrainer itself in-process
 def test_jax_trainer_on_remote_agent(head):
     """JaxTrainer whose workers live on a remote node agent (the
     judge's done-criterion for the multi-host runtime)."""

@@ -1,0 +1,179 @@
+"""Seeded chaos soak: the r17 kill / partition / blip scenario matrix
+with per-scenario exactly-once accounting.
+
+Each scenario joins a fresh node-agent subprocess under a unique
+resource tag, drives a drain of N tasks pinned to it, injects its
+fault mid-drain, and then audits the head's books:
+
+- every ref resolves to the expected value (zero lost),
+- at most one terminal task event per task id (zero double-counted),
+- no task left on the live-task table,
+- scenario-specific liveness assertions (a blip must trigger ZERO
+  recoveries; a partition must end in a fence + fresh re-register).
+
+Runnable standalone::
+
+    python tools/chaos_soak.py --scenarios kill,partition,blip \
+        --seeds 1,2,3 --tasks 500
+
+and as one slow-marked pytest entry
+(tests/test_membership.py::test_chaos_soak_matrix).
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:          # standalone: python tools/chaos_soak.py
+    sys.path.insert(0, _REPO)
+
+SCENARIOS = ("kill", "partition", "blip")
+
+
+def _wait(pred, timeout=30.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if pred():
+                return True
+        except Exception:
+            pass
+        time.sleep(step)
+    return False
+
+
+def _join_agent(rt, agents, resources):
+    from ray_tpu.cluster_utils import NodeAgentProcess
+    known = {n.node_id for n in rt.cluster.alive_nodes()}
+    agents.append(NodeAgentProcess(num_cpus=4, resources=resources))
+    assert _wait(lambda: len(rt.cluster.alive_nodes()) > len(known), 30), \
+        "agent failed to register"
+    return [n.node_id for n in rt.cluster.alive_nodes()
+            if n.node_id not in known][0]
+
+
+def run_scenario(rt, agents, scenario: str, seed: int = 0,
+                 tasks: int = 500) -> dict:
+    """One scenario against a LIVE head runtime (the caller owns its
+    lifecycle); returns an accounting report with ``ok``."""
+    import ray_tpu
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..", "tests"))
+    import chaos
+
+    tag = f"soak_{scenario}_{seed}"
+    nid = _join_agent(rt, agents, {tag: 1e9})
+    inc0 = rt.controller.node_incarnation(nid)
+    deaths0 = rt.cluster.liveness_counters["deaths"]
+
+    @ray_tpu.remote(resources={tag: 1.0})
+    def f(x):
+        return x * 7
+
+    t0 = time.time()
+    refs = [f.remote(i) for i in range(tasks)]
+    task_ids = {r.object_id.split("r", 1)[0] for r in refs}
+    _wait(lambda: len(set(rt.controller.live_task_ids()) & task_ids)
+          <= max(0, tasks - tasks // 5), 60)
+
+    if scenario == "kill":
+        chaos.kill_agent(agents[-1])
+        assert _wait(lambda: not rt.cluster.get_node(nid).alive, 20), \
+            "killed agent not declared dead"
+        # replacement capacity under the same tag absorbs the re-place
+        _join_agent(rt, agents, {tag: 1e9})
+    elif scenario == "partition":
+        chaos.partition(rt, nid)
+        assert _wait(lambda: not rt.cluster.get_node(nid).alive, 20), \
+            "partitioned agent not declared dead"
+        time.sleep(0.3)
+        chaos.heal(rt, nid)
+        assert _wait(lambda: rt.cluster.get_node(nid).alive, 30), \
+            "fenced agent did not re-register"
+    elif scenario == "blip":
+        from ray_tpu._private.config import CONFIG
+        chaos.partition(rt, nid)
+        time.sleep(max(0.05, CONFIG.suspect_s * 0.4))
+        chaos.heal(rt, nid)
+    else:
+        raise ValueError(f"unknown scenario {scenario!r}")
+
+    vals = ray_tpu.get(refs, timeout=180)
+    lost = sum(1 for i, v in enumerate(vals) if v != i * 7)
+    term = collections.Counter()
+    for ev in rt.controller.list_task_events(1 << 20):
+        if (ev["task_id"] in task_ids
+                and ev["state"] in ("FINISHED", "FAILED", "CANCELLED")):
+            term[ev["task_id"]] += 1
+    dups = sum(1 for c in term.values() if c > 1)
+    leaked = len(set(rt.controller.live_task_ids()) & task_ids)
+    report = {
+        "scenario": scenario, "seed": seed, "tasks": tasks,
+        "wall_s": round(time.time() - t0, 2),
+        "lost": lost, "double_counted": dups,
+        "terminal_seen": len(term), "live_leaked": leaked,
+        "fence": dict(rt._fence_stats),
+        "liveness": dict(rt.cluster.liveness_counters),
+    }
+    ok = lost == 0 and dups == 0 and leaked == 0
+    if scenario == "blip":
+        # a sub-suspect blip must be free: no death, no new epoch
+        ok = ok and rt.cluster.liveness_counters["deaths"] == deaths0
+        ok = ok and rt.controller.node_incarnation(nid) == inc0
+    elif scenario == "partition":
+        ok = ok and rt.controller.node_incarnation(nid) > inc0
+        ok = ok and rt._fence_stats["fence_notices"] >= 1
+    report["ok"] = ok
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="chaos_soak")
+    p.add_argument("--scenarios", default=",".join(SCENARIOS))
+    p.add_argument("--seeds", default="0")
+    p.add_argument("--tasks", type=int, default=500)
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("RAY_TPU_CHAOS", "1")
+    os.environ.setdefault("RAY_TPU_HEARTBEAT_TIMEOUT_S", "1.0")
+    os.environ.setdefault("RAY_TPU_SUSPECT_S", "0.7")
+    os.environ.setdefault("RAY_TPU_TASK_EVENT_HISTORY", "200000")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ray_tpu._private.config import CONFIG
+    CONFIG.reload()
+
+    import ray_tpu
+    failures = 0
+    for seed in (int(s) for s in args.seeds.split(",") if s):
+        os.environ["RAY_TPU_CHAOS_SEED"] = str(seed)
+        CONFIG.reload()
+        rt = ray_tpu.init(num_cpus=1, resources={"head": 4.0})
+        agents: list = []
+        try:
+            for scenario in args.scenarios.split(","):
+                rep = run_scenario(rt, agents, scenario.strip(),
+                                   seed=seed, tasks=args.tasks)
+                flag = "OK " if rep["ok"] else "FAIL"
+                print(f"[{flag}] {rep}")
+                if not rep["ok"]:
+                    failures += 1
+        finally:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "..", "tests"))
+            import chaos
+            chaos.heal()
+            for a in agents:
+                a.terminate()
+            for a in agents:
+                a.wait(5)
+            ray_tpu.shutdown()
+    print(f"chaos soak: {failures} failing scenario(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

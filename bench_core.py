@@ -33,6 +33,24 @@ def timed(fn, n: int, *, unit: str = "ops") -> dict:
             "per_second": round(n / dt, 1), "unit": unit}
 
 
+def timed_each(fn_once, n: int, *, unit: str = "ops") -> dict:
+    """Per-iteration latency capture (r18 satellite): sync round-trip
+    scenarios report p50/p99 ms next to the throughput median, so a
+    latency regression can't hide behind an aggregate rate."""
+    lats = []
+    t_all = time.perf_counter()
+    for i in range(n):
+        t0 = time.perf_counter()
+        fn_once(i)
+        lats.append(time.perf_counter() - t0)
+    dt = time.perf_counter() - t_all
+    lats.sort()
+    return {"n": n, "seconds": round(dt, 4),
+            "per_second": round(n / dt, 1), "unit": unit,
+            "p50_ms": round(lats[n // 2] * 1e3, 3),
+            "p99_ms": round(lats[min(n - 1, int(n * 0.99))] * 1e3, 3)}
+
+
 def _ab_pair(results: dict, key_a: str, run_a, key_b: str, run_b,
              reps: int = None) -> tuple[dict, dict]:
     """Order-bias-corrected A/B scenario pair.
@@ -177,6 +195,105 @@ def _delegated_drain(n_tasks: int, delegate: bool) -> dict:
         import ray_tpu as _rt
         _rt.shutdown()
         os.environ.pop("RAY_TPU_DELEGATE", None)
+        CONFIG.reload()
+
+
+def _direct_actor_bench(n_calls: int, direct: bool) -> dict:
+    """Direct actor call plane A/B (r18): a 0-CPU head, one agent
+    hosting the target actor, one agent hosting a WORKER-RESIDENT
+    caller — the serving/RL shape where per-request actor-call latency
+    binds. Head-routed (RAY_TPU_DIRECT_ACTOR=0) each sync call costs
+    four head-relayed hops (SUBMIT_ACTOR_TASK relay in,
+    NODE_SEND_ACTOR_TASK out, NODE_TASK_DONE back, GET_OBJECT resolve
+    back out). Direct: the caller resolves the endpoint once, streams
+    ACTOR_TASK_DIRECT peer-to-peer, and the reply lands inline —
+    head_frames_per_call counts the head's actor-plane involvement
+    (head-routed sends + head-processed dones + resolves + mirror
+    deltas; counters, not timers) and must read ~0 on the direct
+    arm."""
+    import ray_tpu
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.cluster_utils import NodeAgentProcess
+    os.environ["RAY_TPU_DIRECT_ACTOR"] = "1" if direct else "0"
+    CONFIG.reload()
+    agents = []
+    try:
+        rt = ray_tpu.init(num_cpus=0)
+        # custom resources pin target and caller to DIFFERENT agents:
+        # a 0-CPU actor would otherwise place on the 0-CPU head and
+        # measure the in-process path instead of the wire
+        agents = [NodeAgentProcess(num_cpus=4,
+                                   resources={"bench_actor": 10.0}),
+                  NodeAgentProcess(num_cpus=4,
+                                   resources={"bench_caller": 10.0})]
+        deadline = time.time() + 60
+        while (time.time() < deadline
+               and len(rt.cluster.alive_nodes()) < 3):
+            time.sleep(0.1)
+
+        @ray_tpu.remote(resources={"bench_actor": 1.0})
+        class Ping:
+            def ping(self):
+                return None
+
+        @ray_tpu.remote(resources={"bench_caller": 1.0})
+        class Caller:
+            def drive(self, h, n):
+                import time as _t
+                lats = []
+                t_all = _t.perf_counter()
+                for _ in range(n):
+                    t0 = _t.perf_counter()
+                    ray_tpu.get(h.ping.remote())
+                    lats.append(_t.perf_counter() - t0)
+                dt = _t.perf_counter() - t_all
+                lats.sort()
+                return dt, lats[n // 2], lats[min(n - 1,
+                                                  int(n * 0.99))]
+
+        a = Ping.remote()
+        c = Caller.remote()
+        ray_tpu.get(a.ping.remote(), timeout=120)        # ALIVE
+        ray_tpu.get(c.drive.remote(a, 20), timeout=120)  # warm path
+        # steady state: heartbeats have carried the target worker's
+        # direct port and the caller's provisional (agent-hosted)
+        # endpoint is eligible for its worker-socket upgrade
+        time.sleep(1.5)
+        ray_tpu.get(c.drive.remote(a, 5), timeout=120)
+        keys = ("head_routed_sends", "head_actor_dones", "resolves",
+                "delta_frames", "inline_bytes")
+        s0 = {k: rt._direct_stats[k] for k in keys}
+        direct0 = sum(
+            (getattr(n.scheduler, "direct_stats", None)
+             or {}).get("served", 0)
+            for n in rt.cluster.alive_nodes())
+        dt, p50, p99 = ray_tpu.get(c.drive.remote(a, n_calls),
+                                   timeout=600)
+        d = {k: rt._direct_stats[k] - s0[k] for k in keys}
+        time.sleep(1.2)          # host serve counters ride heartbeats
+        served = sum(
+            (getattr(n.scheduler, "direct_stats", None)
+             or {}).get("served", 0)
+            for n in rt.cluster.alive_nodes()) - direct0
+        return {
+            "n": n_calls, "seconds": round(dt, 4),
+            "per_second": round(n_calls / dt, 1), "unit": "calls",
+            "p50_ms": round(p50 * 1e3, 3),
+            "p99_ms": round(p99 * 1e3, 3),
+            "head_frames_per_call": round(
+                (d["head_routed_sends"] + d["head_actor_dones"]
+                 + d["resolves"] + d["delta_frames"]) / n_calls, 3),
+            "direct_served": served,
+            "inline_reply_bytes": d["inline_bytes"],
+        }
+    finally:
+        for ag in agents:
+            ag.terminate()
+        for ag in agents:
+            ag.wait(10)
+        import ray_tpu as _rt
+        _rt.shutdown()
+        os.environ.pop("RAY_TPU_DIRECT_ACTOR", None)
         CONFIG.reload()
 
 
@@ -676,6 +793,19 @@ def main(as_json: bool = False) -> dict:
         _d["delegate_speedup"] = round(
             _d["per_second"] / _c["per_second"], 2)
 
+    # ------ direct vs head-routed actor calls: agent-hosted (r18)
+    # Fresh head+agent pair per run; order alternates. Acceptance:
+    # direct >= 2x head-routed sync throughput AND
+    # head_frames_per_call <= 0.1 on the direct arm.
+    _h, _dd = _ab_pair(
+        results, "actor_sync_head",
+        lambda: _direct_actor_bench(400, direct=False),
+        "actor_sync_direct",
+        lambda: _direct_actor_bench(400, direct=True))
+    if _h["per_second"]:
+        _dd["direct_speedup"] = round(
+            _dd["per_second"] / _h["per_second"], 2)
+
     # --------------------- 100k-task drain: sustained head envelope
     # (r10 acceptance scenario; r16 acceptance metric — the scale at
     # which per-task head cost used to GROW with the in-flight
@@ -779,8 +909,11 @@ def main(as_json: bool = False) -> dict:
 
     ray_tpu.get([nop.remote() for _ in range(10)])        # warm pool
     N = 200
-    results["tasks_sync_per_s"] = timed(
-        lambda: [ray_tpu.get(nop.remote()) for _ in range(N)], N)
+    # sync scenarios carry p50/p99 latency readouts (r18 satellite):
+    # the r17 machine block read 209/s here with no way to tell a
+    # uniform slowdown from a p99 tail — now both are visible
+    results["tasks_sync_per_s"] = timed_each(
+        lambda i: ray_tpu.get(nop.remote()), N)
     results["tasks_batch_per_s"] = timed(
         lambda: ray_tpu.get([nop.remote() for _ in range(N)]), N)
 
@@ -792,8 +925,8 @@ def main(as_json: bool = False) -> dict:
 
     a = A.remote()
     ray_tpu.get(a.ping.remote())
-    results["actor_calls_sync_per_s"] = timed(
-        lambda: [ray_tpu.get(a.ping.remote()) for _ in range(N)], N)
+    results["actor_calls_sync_per_s"] = timed_each(
+        lambda i: ray_tpu.get(a.ping.remote()), N)
     results["actor_calls_async_per_s"] = timed(
         lambda: ray_tpu.get([a.ping.remote() for _ in range(N)]), N)
     ray_tpu.kill(a)          # scenario actors must not skew later ones

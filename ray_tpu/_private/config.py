@@ -477,6 +477,46 @@ _define("trace_sample", 64,
         "wire bytes (exactly like RAY_TPU_TRACE=0). Nested submissions "
         "inside a sampled trace inherit it. 1 traces every task; 0 "
         "reverts to the pre-r16 always-trace behavior.")
+_define("direct_actor", True,
+        "Direct actor call plane (r18): callers resolve an actor's "
+        "endpoint once (ACTOR_RESOLVE), dial the hosting node's "
+        "listener, and stream calls over that one connection with "
+        "replies returning inline — the head drops out of the steady-"
+        "state path (it stays the lifecycle owner via the caller's "
+        "coalesced ACTOR_INFLIGHT_DELTA mirror). Requires the peers "
+        "to speak wire MINOR >= 8; stale endpoints NACK with a "
+        "redirect-to-head fallback. 0 restores the fully head-routed "
+        "actor path (byte-identical to r17).")
+_define("direct_actor_worker", True,
+        "Serve direct actor calls from the hosting WORKER's own "
+        "socket (each worker opens a tiny listener and reports its "
+        "port at REGISTER): caller -> worker -> caller, two legs "
+        "total. 0 restores agent-hosted direct serving (caller -> "
+        "agent -> worker -> agent -> caller), which also remains the "
+        "automatic fallback while a worker's port is not yet known "
+        "head-side (heartbeat lag) or its listener failed to bind.")
+_define("direct_actor_stall_s", 10.0,
+        "How long a get() on a direct-call reply future waits before "
+        "falling back to the normal head-routed GET path. Covers the "
+        "silent-partition case: the hosting node vanished without a "
+        "FIN, the head declares it dead and errors the mirrored "
+        "in-flight calls, and the fallback get resolves that error "
+        "instead of hanging on the dead connection. Must comfortably "
+        "exceed heartbeat_timeout_s.")
+_define("direct_actor_delta_delay_ms", 25.0,
+        "Collect-then-flush window for a remote caller's "
+        "ACTOR_INFLIGHT_DELTA buffer (the decref-delta discipline): "
+        "the first parked add/done opens a window of this width; "
+        "everything arriving inside it rides one frame to the head. "
+        "Wide by design — nothing in the delta is latency-critical "
+        "(the caller holds a call-lifetime borrow on arg refs, so "
+        "the head-side pin is belt-and-braces, and dones only "
+        "release pins), and a sync caller at ~1k calls/s amortizes "
+        "to well under 0.1 head frames per call.")
+_define("direct_actor_delta_max", 64,
+        "Buffered ACTOR_INFLIGHT_DELTA entries that force an "
+        "immediate flush (bounds frame size and how much mirror "
+        "state a caller crash can lose).")
 _define("scheduler_locality", True,
         "Locality-aware node selection: prefer placing a task on a "
         "feasible node already holding the most argument bytes "

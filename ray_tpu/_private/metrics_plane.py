@@ -165,6 +165,13 @@ class _RuntimeMetrics:
             "entries/releases coalesced (plus buffered + forwarded "
             "fallbacks); head-side frames/entries applied and "
             "replayed frames deduped", ("counter",))
+        self.direct_actor = g(
+            "ray_tpu_direct_actor",
+            "Direct actor call plane counters (r18): caller-side "
+            "direct calls/replies/inline bytes/fallbacks/redirects/"
+            "resolves, host-side served/nacks/served bytes, and the "
+            "head's head-routed-send + mirror-delta counts",
+            ("party", "counter"))
         self.node_liveness = g(
             "ray_tpu_node_liveness",
             "Per-node liveness (r17): 1 for the node's current state "

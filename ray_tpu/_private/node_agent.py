@@ -37,6 +37,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
+from ray_tpu._private import direct_actor as _da
 from ray_tpu._private import metrics_plane as _mp
 from ray_tpu._private import protocol
 from ray_tpu._private import tracing_plane as _tp
@@ -225,6 +226,15 @@ class NodeAgent:
             self._flush_decref_buf,
             lambda: _CFG.decref_delta_delay_ms,
             "rtpu-agent-decref-flush")
+        # ---- direct actor call plane (r18): host side ----
+        # Calls a remote caller dialed onto this node's listener,
+        # forwarded to the actor's worker and awaiting its TASK_DONE;
+        # the reply returns inline on the caller's connection, the
+        # head never sees a frame. Worker death NACKs every pending
+        # entry (redirect-to-head, started=True).
+        self._direct_pending = _da.PendingDirectCalls()
+        self._direct_stats = {"served": 0, "nacks": 0,
+                              "served_bytes": 0}
         # ---- N10 heartbeat delta-sync ----
         self._hb_seq = 0
         self._hb_last_norm: Optional[dict] = None
@@ -538,6 +548,11 @@ class NodeAgent:
         pm = self._pull_mgr.stats()
         m.pull_inflight.set(pm["inflight"])
         m.pull_inflight_bytes.set(pm["inflight_bytes"])
+        m.direct_actor.set_many(
+            [({"party": "agent", "counter": k}, float(v))
+             for k, v in self._direct_stats.items()]
+            + [({"party": "agent", "counter": "pending"},
+                float(len(self._direct_pending)))])
 
     def shutdown(self) -> None:
         if self._stop.is_set():
@@ -641,6 +656,8 @@ class NodeAgent:
             "trace_watermark": _tp.recorder().watermark(),
             # delegated-lease accounting (r10)
             "delegate": delegate,
+            # direct actor plane host counters (r18)
+            "direct": dict(self._direct_stats),
             **self.scheduler.heartbeat_snapshot(),
         }
         head = self.head
@@ -1196,6 +1213,13 @@ class NodeAgent:
         if wid is None or self._stop.is_set():
             return
         tasks, actor_id = self.scheduler.on_worker_lost(wid)
+        # r18: every direct call pending on the dead worker NACKs
+        # redirect-to-head with started=True (ambiguous — the head's
+        # retry budget decides requeue vs ActorDiedError)
+        for _tid, dconn, rid in \
+                self._direct_pending.pop_worker(wid):
+            self._direct_stats["nacks"] += 1
+            _da.nack(dconn, rid, "worker_died", True)
         if tasks:
             # the dead worker may have sealed result shm on THIS host
             # without delivering TASK_DONE — reap locally (the head's
@@ -1222,6 +1246,10 @@ class NodeAgent:
             # surfaced via workers_snapshot rows in heartbeats
             conn.meta["wire_native"] = bool(
                 msg.get("wire_native", False))
+            # r18 worker-direct serving port — rides the heartbeat's
+            # worker rows so the head can resolve this worker as an
+            # actor endpoint
+            conn.meta["direct_port"] = msg.get("direct_port")
         elif mtype == protocol.TASK_DONE:
             self._on_task_done(conn, msg)
         elif mtype == protocol.GET_OBJECT:
@@ -1238,9 +1266,17 @@ class NodeAgent:
             self._pull_server.handle_pull(conn, msg)
         elif mtype == protocol.PULL_CHUNK:
             self._pull_server.handle_chunk(conn, msg)
+        elif mtype == protocol.ACTOR_TASK_DIRECT:
+            self._on_actor_task_direct(conn, msg)
+        elif mtype == protocol.ACTOR_INFLIGHT_DELTA:
+            # a local caller's coalesced direct-call mirror: straight
+            # through to the head (the add entries carry pins — they
+            # must not wait out another batching window here)
+            self._send_to_head(dict(msg))
         elif mtype in (protocol.WAIT, protocol.SUBMIT,
                        protocol.SUBMIT_ACTOR, protocol.SUBMIT_ACTOR_TASK,
-                       protocol.KV_OP, protocol.STATE_OP):
+                       protocol.KV_OP, protocol.STATE_OP,
+                       protocol.ACTOR_RESOLVE):
             self._relay_to_head(conn, msg)
         elif mtype == protocol.ADDREF:
             # addrefs go straight through: delaying a release is
@@ -1299,6 +1335,81 @@ class NodeAgent:
 
         fut.add_done_callback(on_reply)
 
+    # ------------------------------- direct actor call hosting (r18)
+    def _on_actor_task_direct(self, conn: protocol.Connection,
+                              msg: dict) -> None:
+        """A caller dialed this node directly for an actor hosted
+        here. Validate the endpoint is still current — the actor's
+        worker alive and bound, this node's incarnation unchanged
+        (fences callers holding a pre-fence endpoint), and the head
+        reachable (a head-disconnected host may be a partitioned
+        zombie whose actor the head is about to restart elsewhere:
+        new calls must go back through the head) — then forward to
+        the worker and remember the caller for the inline reply."""
+        spec = msg["spec"]
+        wid = msg.get("worker_id", "")
+        with self._reconnect_lock:
+            disconnected = self._reconnecting or self._fencing
+        reason = None
+        if (not _CFG.direct_actor or self._stop.is_set()
+                or disconnected):
+            reason = "host_head_disconnected"
+        elif (msg.get("node_incarnation") is not None
+              and msg["node_incarnation"] != self.incarnation):
+            reason = "stale_incarnation"
+        elif self.scheduler.worker_for_actor(
+                msg.get("actor_id", "")) != wid:
+            reason = "stale_endpoint"
+        if reason is None:
+            self._direct_pending.add(spec.task_id, conn,
+                                     msg.get("rid"), wid)
+            if self.scheduler.send_actor_task(wid, spec):
+                self._direct_stats["served"] += 1
+                return
+            self._direct_pending.pop(spec.task_id)
+            reason = "send_failed"
+        self._direct_stats["nacks"] += 1
+        _da.nack(conn, msg.get("rid"), reason, False)
+
+    def _reply_direct_done(self, ent: tuple, msg: dict) -> None:
+        """Answer a pending direct call from its worker's TASK_DONE.
+        Small results ride the reply inline and the caller owns
+        landing them (the driver seals into the head store in-process;
+        a worker caller ships them head-ward on its coalesced delta) —
+        this node keeps nothing. Large results seal HERE and the
+        reply's `located` entries are the directory hints the caller
+        registers with the head, so the existing pull path serves any
+        getter."""
+        conn, rid, _wid = ent
+        inline, located = [], []
+        for stored in msg.get("results", ()):
+            if (stored.nbytes <= _CFG.remote_inline_max_bytes
+                    or stored.is_error):
+                m = materialize(stored)
+                inline.append(m)
+                self._direct_stats["served_bytes"] += m.nbytes
+                for name in stored.shm_names:
+                    unlink_segment(name)
+            else:
+                self.store.put_stored(stored)
+                located.append((stored.object_id, stored.nbytes,
+                                self.node_id,
+                                list(stored.contained_ids)))
+        try:
+            conn.reply({"rid": rid}, inline=inline, located=located,
+                       error=bool(msg.get("error")),
+                       error_repr=msg.get("error_repr"))
+        except protocol.ConnectionClosed:
+            # caller died mid-call: its delta can never land these
+            # results head-ward — seal the materialized copies locally
+            # and register locations so a third-party holder of the
+            # return ref still resolves (head-routed parity)
+            for m in inline:
+                self.store.put_stored(m)
+                self.send_event("object_at", object_id=m.object_id,
+                                nbytes=m.nbytes, addref=False,
+                                contained=list(m.contained_ids))
+
     # -------------------------------------------------- task completion
     def _on_task_done(self, conn: protocol.Connection, msg: dict) -> None:
         with self._done_lock:
@@ -1313,6 +1424,27 @@ class NodeAgent:
     def _on_task_done_inner(self, conn: protocol.Connection,
                             msg: dict) -> None:
         worker_id = conn.meta.get("worker_id", "")
+        if msg.get("is_actor_task"):
+            if msg.get("direct_located"):
+                # r18 worker-direct large results: the worker already
+                # answered its caller inline; these byte carriers just
+                # need the node store + a directory hint — no done
+                # routing, no scheduler bookkeeping
+                for stored in msg.get("results", ()):
+                    self.store.put_stored(stored)
+                    self.send_event(
+                        "object_at", object_id=stored.object_id,
+                        nbytes=stored.nbytes, addref=False,
+                        contained=list(stored.contained_ids))
+                return
+            # r18 direct plane: this completion belongs to a caller
+            # dialed onto our listener — answer it inline on that
+            # connection; the head hears nothing (the caller's
+            # coalesced delta is its mirror).
+            ent = self._direct_pending.pop(msg.get("task_id") or "")
+            if ent is not None:
+                self._reply_direct_done(ent, msg)
+                return
         results: list[StoredObject] = msg.get("results", [])
         inline: list[StoredObject] = []
         located: list[tuple[str, int]] = []

@@ -35,6 +35,7 @@ from ray_tpu._private.wire import (BATCH_MIN_MINOR, BATCH_TYPE,
                                    CHANNEL_MIN_MINOR,
                                    DECREF_DELTA_MIN_MINOR,
                                    DELEGATE_MIN_MINOR,
+                                   DIRECT_ACTOR_MIN_MINOR,
                                    MANIFEST_MIN_MINOR, METRICS_MIN_MINOR,
                                    RAW_KEY, TRACE_KEY, TRACE_MIN_MINOR,
                                    WIRE_MAJOR, WireVersionError, dumps,
@@ -166,6 +167,37 @@ NODE_DECREF_DELTA = "node_decref_delta"  # agent -> head (r16; wire
                                        #   replays dedup (the r15
                                        #   done-batch discipline
                                        #   extended to decrefs)
+# ---- direct actor call plane (r18; wire MINOR >= 8, negotiated by
+# observation like BatchFrame). The head stops being a per-call party:
+# a caller resolves the actor's endpoint ONCE, dials the hosting
+# node's listener, streams calls over that one connection (per-handle
+# submission order rides the stream), and replies return inline on the
+# same connection. The head stays the owner of actor lifecycle via the
+# caller's coalesced inflight mirror. ----
+ACTOR_RESOLVE = "actor_resolve"        # caller -> head (reply: endpoint
+                                       #   host/port + worker_id +
+                                       #   restart epoch + node
+                                       #   incarnation, or direct=False
+                                       #   / state=dead|pending)
+ACTOR_TASK_DIRECT = "actor_task_direct"  # caller -> hosting agent/head
+                                       #   listener (reply: inline
+                                       #   results / located hints, or
+                                       #   redirect=True NACK with
+                                       #   started flag — stale
+                                       #   endpoint, fenced node,
+                                       #   head-disconnected host)
+ACTOR_INFLIGHT_DELTA = "actor_inflight_delta"  # remote caller -> head:
+                                       #   coalesced mirror of direct
+                                       #   in-flight calls (adds carry
+                                       #   the spec so death/restart
+                                       #   still produces
+                                       #   ActorDiedError/requeue;
+                                       #   dones carry located results
+                                       #   + containment and release
+                                       #   pins; fail/requeue entries
+                                       #   route NACKed calls back
+                                       #   through the head's retry
+                                       #   machinery)
 NODE_FENCED = "node_fenced"            # head -> agent (r17): a state-
                                        #   bearing frame arrived from a
                                        #   STALE node incarnation (the
@@ -733,6 +765,16 @@ class Connection:
         v = self.peer_wire_version
         return (v // 100 == WIRE_MAJOR
                 and v % 100 >= DECREF_DELTA_MIN_MINOR)
+
+    def peer_speaks_direct_actor(self) -> bool:
+        """Whether the peer speaks the r18 direct actor call plane
+        (MINOR >= 8): answers ACTOR_RESOLVE, hosts ACTOR_TASK_DIRECT,
+        applies ACTOR_INFLIGHT_DELTA. Unknown (0) counts as NO — an
+        old peer drops the unknown types without replying and the
+        caller's future would burn its stall budget."""
+        v = self.peer_wire_version
+        return (v // 100 == WIRE_MAJOR
+                and v % 100 >= DIRECT_ACTOR_MIN_MINOR)
 
     def _peer_speaks_trace(self) -> bool:
         """Whether trace context may ride this connection's envelopes.

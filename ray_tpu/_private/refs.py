@@ -62,6 +62,20 @@ _flush_wake = threading.Event()
 _flusher_started = False
 _flusher_lock = threading.Lock()
 
+# Release hooks (r18): caches keyed by object id — the direct actor
+# plane's inline-reply result cache — register here so a ref's release
+# also drops the cached value. Invoked on the flusher thread with each
+# drained id batch BEFORE the owner-side decref, so a hook never sees
+# an id whose owner-side count it could revive.
+_release_hooks: list = []
+
+
+def register_release_hook(fn) -> None:
+    """Register fn(object_ids) to run for every flushed decref batch.
+    Process-lifetime registration (callers are per-process singletons
+    like the direct actor caller's inline-result cache)."""
+    _release_hooks.append(fn)
+
 
 def _ensure_flusher() -> None:
     global _flusher_started
@@ -111,6 +125,11 @@ def _flush_loop() -> None:
                 batch.append(_deferred.popleft())
             except IndexError:
                 break
+        for hook in _release_hooks:
+            try:
+                hook(batch)
+            except Exception:
+                pass
         try:
             ctx.decref_batch(batch)
         except Exception:

@@ -92,6 +92,9 @@ class RemoteNodeHandle:
         self._tasks_leased = 0
         # agent-reported delegate counters (ride heartbeats)
         self.delegate_stats: dict = {}
+        # agent-reported direct-actor host counters (r18, heartbeat-
+        # carried): served / nacks / served_bytes
+        self.direct_stats: dict = {}
         # ---- N10 heartbeat delta-sync ----
         self._hb_seq = -1
         self._hb_last_resync = 0.0
@@ -132,6 +135,8 @@ class RemoteNodeHandle:
                 self.trace_watermark = int(msg["trace_watermark"])
             if "delegate" in msg:
                 self.delegate_stats = dict(msg["delegate"])
+            if "direct" in msg:
+                self.direct_stats = dict(msg["direct"])
             op = dict(msg.get("object_plane", {}))
             if op:
                 # serves_per_object rides heartbeats only when it
@@ -151,6 +156,16 @@ class RemoteNodeHandle:
         """Worker table rows as of the last heartbeat."""
         with self._lock:
             return list(getattr(self, "_last_workers", []))
+
+    def direct_port_of(self, worker_id: str):
+        """The worker's r18 direct-serving port as of the last
+        heartbeat (None until a beat carries the worker's row —
+        callers fall back to agent-hosted direct serving meanwhile)."""
+        with self._lock:
+            for row in getattr(self, "_last_workers", ()):
+                if row.get("worker_id") == worker_id:
+                    return row.get("direct_port")
+        return None
 
     # ------------------------------------------- scheduler duck-typing
     @staticmethod

@@ -69,6 +69,29 @@ class _ActorState:
     def __init__(self):
         self.queued: list[ActorTaskSpec] = []
         self.inflight: dict[str, ActorTaskSpec] = {}
+        # r18 direct call plane: specs REMOTE callers mirrored via
+        # ACTOR_INFLIGHT_DELTA while their direct calls are in flight
+        # (the driver's own direct calls sit in `inflight` like any
+        # other — its mirror is in-process). Death/restart recovery
+        # claims both tables.
+        self.direct_inflight: dict[str, ActorTaskSpec] = {}
+        # claim epoch (r18 satellite): bumped by every recovery /
+        # unplaceable sweep that claims the inflight table. A send
+        # that fails AFTER such a sweep must NOT pop/requeue — the
+        # sweep already owns the spec (it may have been requeued and
+        # re-sent), and popping here silently dropped the call.
+        self.epoch = 0
+        # sticky head-routed fallback (r18): set on any direct-path
+        # failure; cleared once every book is empty (all prior calls
+        # terminal), so a fresh direct call can never overtake an
+        # older fallback call still queued at the head.
+        self.fallback = False
+        # per-actor submission-order stamp: every requeue path inserts
+        # by it, so a recovery sweep claiming in-flight calls can
+        # never prepend them AHEAD of earlier calls a direct-path NACK
+        # already requeued (mixed-source queues broke the old
+        # "inflight always precedes queued" prepend invariant).
+        self.next_order = 0
         self.lock = threading.Lock()
 
 
@@ -119,6 +142,34 @@ class Runtime(_context.BaseContext):
         # entries + replayed frames dropped by the seq watermark
         self._decref_delta_stats = {"frames": 0, "entries": 0,
                                     "deduped_frames": 0}
+        # r18 direct actor call plane: head-side counters (driver-as-
+        # caller and head-as-host in one dict), the pending table for
+        # head-hosted actors' direct calls, the driver's dialed
+        # endpoint connections, and the count of head-routed actor
+        # frames (the load-independent "head frames per actor call"
+        # signal bench_core reads).
+        from ray_tpu._private import direct_actor as _da
+        self._direct_stats = _da.new_stats()
+        self._direct_stats.update(head_routed_sends=0,
+                                  head_actor_dones=0, delta_frames=0,
+                                  delta_adds=0, delta_dones=0,
+                                  send_race_kept=0)
+        self._direct_pending = _da.PendingDirectCalls()
+        self._direct_conns: dict[tuple, protocol.Connection] = {}
+        # per-actor endpoint the driver is currently streaming to:
+        # upgrades (agent-hosted -> worker socket once its port rides
+        # a heartbeat) only happen at quiet moments — two inbound
+        # channels to one worker could reorder a handle's calls
+        self._direct_actor_addr: dict[str, tuple] = {}
+        # head-as-host completions the worker's TASK_DONE answered
+        # BEFORE the caller's coalesced mirror add arrived (the 25 ms
+        # delta window vs ~1 ms execution): late adds for these ids
+        # must not pin args or park phantom in-flight entries, and
+        # their dones must not re-seal/re-record a terminal call
+        import collections as _collections
+        self._direct_done_ring: "_collections.OrderedDict" = \
+            _collections.OrderedDict()
+        self._direct_lock = threading.Lock()
         # r17 membership fencing: frames dropped because their
         # connection's incarnation trails the node table (zombie after
         # a partition/stall) + terminal entries dropped because their
@@ -595,6 +646,7 @@ class Runtime(_context.BaseContext):
         if sched is None:
             return
         tasks, actor_id = sched.on_worker_lost(wid)
+        self._drop_direct_calls_of_caller(wid)
         for task in tasks:
             self._recover_task(task)
         if actor_id is not None:
@@ -634,8 +686,13 @@ class Runtime(_context.BaseContext):
             return
         st = self._actor_state(actor_id)
         with st.lock:
-            inflight = list(st.inflight.values())
+            # claim epoch (r18): any in-flight send that fails after
+            # this sweep must not repop/requeue — we own every spec
+            st.epoch += 1
+            inflight = (list(st.inflight.values())
+                        + list(st.direct_inflight.values()))
             st.inflight.clear()
+            st.direct_inflight.clear()
         can_restart = (rec.spec.max_restarts < 0
                        or rec.num_restarts < rec.spec.max_restarts)
         if can_restart:
@@ -651,7 +708,13 @@ class Runtime(_context.BaseContext):
                         ActorError(actor_id, "actor restarting; task lost"),
                         task_name=t.name))
             with st.lock:
-                st.queued[:0] = retried
+                # merge by submission stamp (r18): the queue may
+                # already hold EARLIER calls a direct-path NACK
+                # requeued — a blind prepend of the claimed in-flight
+                # set would put later calls ahead of them
+                st.queued = sorted(
+                    retried + st.queued,
+                    key=lambda s: getattr(s, "_order", 0))
             self.cluster.submit(rec.spec)
         else:
             self.controller.set_actor_state(actor_id, DEAD,
@@ -682,9 +745,12 @@ class Runtime(_context.BaseContext):
                                             death_cause=reason)
             st = self._actor_state(spec.actor_id)
             with st.lock:
-                dead = st.queued + list(st.inflight.values())
+                st.epoch += 1
+                dead = (st.queued + list(st.inflight.values())
+                        + list(st.direct_inflight.values()))
                 st.queued = []
                 st.inflight.clear()
+                st.direct_inflight.clear()
             for t in dead:
                 self._store_error(t.return_ids, TaskError(
                     ActorDiedError(spec.actor_id, reason),
@@ -755,7 +821,10 @@ class Runtime(_context.BaseContext):
         protocol.NODE_TASK_DONE, protocol.NODE_TASK_DONE_BATCH,
         protocol.NODE_DECREF_DELTA, protocol.OBJECT_ADDED,
         protocol.OBJECT_REMOVED, protocol.DECREF,
-        protocol.DECREF_BATCH, protocol.ADDREF))
+        protocol.DECREF_BATCH, protocol.ADDREF,
+        # r18: a zombie agent's relayed direct-call mirror deltas
+        # must not pin refs or park phantom in-flight entries
+        protocol.ACTOR_INFLIGHT_DELTA))
 
     def _admit_node_frame(self, conn: protocol.Connection,
                           msg: dict) -> bool:
@@ -803,6 +872,8 @@ class Runtime(_context.BaseContext):
                 # surfaced via workers_snapshot / list_workers
                 conn.meta["wire_native"] = bool(
                     msg.get("wire_native", False))
+                # r18 worker-direct serving port (None: no listener)
+                conn.meta["direct_port"] = msg.get("direct_port")
             else:
                 conn.close()              # worker from a dead/old node
         elif mtype == protocol.TASK_DONE:
@@ -835,8 +906,16 @@ class Runtime(_context.BaseContext):
             self.create_actor_from_spec(aspec)
             conn.reply(msg, ok=True)
         elif mtype == protocol.SUBMIT_ACTOR_TASK:
-            self.submit_actor_task_spec(msg["actor_id"], msg["spec"])
+            self.submit_actor_task_spec(msg["actor_id"], msg["spec"],
+                                        register_borrows=False)
             conn.reply(msg, ok=True)
+        elif mtype == protocol.ACTOR_RESOLVE:
+            conn.reply(msg,
+                       **self._resolve_actor_endpoint(msg["actor_id"]))
+        elif mtype == protocol.ACTOR_TASK_DIRECT:
+            self._on_actor_task_direct(conn, msg)
+        elif mtype == protocol.ACTOR_INFLIGHT_DELTA:
+            self._on_actor_inflight_delta(conn, msg)
         elif mtype == protocol.KV_OP:
             conn.reply(msg, value=self._kv_dispatch(msg))
         elif mtype == protocol.DECREF:
@@ -990,6 +1069,13 @@ class Runtime(_context.BaseContext):
             # be evicted here, or they accumulate until shutdown.
             if self.controller.unreferenced(stored.object_id):
                 self._delete_everywhere(stored.object_id)
+        if msg.get("direct_located"):
+            # r18 worker-direct large results from a HEAD-LOCAL
+            # worker: sealed into the head store by the loop above
+            # (the owner-side copy every getter resolves against) —
+            # the worker already answered its caller inline, so no
+            # done routing happens here
+            return
         worker_id = conn.meta.get("worker_id", "")
         wsched = self._sched_for_conn(conn)
         if msg.get("is_actor_create"):
@@ -1018,6 +1104,31 @@ class Runtime(_context.BaseContext):
             return
         task_id = msg["task_id"]
         if msg.get("is_actor_task"):
+            # r18 head-as-host: this completion belongs to a remote
+            # caller's direct call — answer it inline on the dialed
+            # connection (results are already sealed above, the head
+            # store IS the owner-side copy) and clear any mirror entry
+            # the caller's delta already parked.
+            ent = self._direct_pending.pop(task_id)
+            if ent is not None:
+                with self._direct_lock:
+                    ring = self._direct_done_ring
+                    ring[task_id] = None
+                    while len(ring) > 4096:
+                        ring.popitem(last=False)
+                self._reply_direct_done(ent, msg, results)
+                st = self._actor_states.get(msg.get("actor_id", ""))
+                if st is not None:
+                    with st.lock:
+                        spec = st.direct_inflight.pop(task_id, None)
+                    if spec is not None:
+                        self._unpin(spec.pinned_refs)
+                state = "FAILED" if msg.get("error") else "FINISHED"
+                self.controller.record_task_event(
+                    task_id, msg.get("name", ""), state,
+                    worker_id=worker_id)
+                return
+            self._direct_stats["head_actor_dones"] += 1
             st = self._actor_states.get(msg.get("actor_id", ""))
             if st is not None:
                 with st.lock:
@@ -1065,6 +1176,7 @@ class Runtime(_context.BaseContext):
         elif kind == "worker_lost":
             if proxy is not None:
                 proxy.on_worker_lost(msg["worker_id"])
+            self._drop_direct_calls_of_caller(msg["worker_id"])
             for task in msg.get("tasks", ()):
                 if proxy is not None:
                     proxy.on_finished(task.task_id)
@@ -1121,7 +1233,7 @@ class Runtime(_context.BaseContext):
             st = self._actor_state(msg["actor_id"])
             with st.lock:
                 if st.inflight.pop(spec.task_id, None) is not None:
-                    st.queued.append(spec)
+                    self._requeue_in_order(st, spec)
 
     def _on_node_task_done(self, conn: protocol.Connection,
                            msg: dict) -> None:
@@ -1238,6 +1350,7 @@ class Runtime(_context.BaseContext):
             return
         task_id = msg["task_id"]
         if msg.get("is_actor_task"):
+            self._direct_stats["head_actor_dones"] += 1
             st = self._actor_states.get(msg.get("actor_id", ""))
             if st is not None:
                 with st.lock:
@@ -1693,6 +1806,16 @@ class Runtime(_context.BaseContext):
         m.decref_delta.set_many(
             [({"counter": "head_" + k}, float(v))
              for k, v in self._decref_delta_stats.items()])
+        # r18 direct actor plane: head-process caller/host counters
+        # plus each agent's heartbeat-carried host counters
+        rows = [({"party": "head", "counter": k}, float(v))
+                for k, v in self._direct_stats.items()]
+        for n in self.cluster.alive_nodes():
+            for k, v in (getattr(n.scheduler, "direct_stats", None)
+                         or {}).items():
+                rows.append(({"party": "node:" + n.node_id,
+                              "counter": k}, float(v)))
+        m.direct_actor.set_many(rows)
         # r17 membership plane: per-node liveness (one-hot by state) +
         # last-heartbeat age, plus fence/suspicion transition counters
         lv = self.cluster.liveness_stats()
@@ -1998,7 +2121,16 @@ class Runtime(_context.BaseContext):
     create_actor = create_actor_from_spec
 
     def submit_actor_task_spec(self, actor_id: str,
-                               spec: ActorTaskSpec) -> list[str]:
+                               spec: ActorTaskSpec,
+                               register_borrows: bool = True
+                               ) -> list[str]:
+        # register_borrows: the driver-as-caller registers its return-
+        # id borrows here (in-process, free). Wire-relayed submissions
+        # pass False — their caller already addref'd eagerly on the
+        # head-routed path.
+        if register_borrows:
+            for oid in spec.return_ids:
+                self.controller.addref(oid)
         _mp.submit_stamp(spec)
         tr = self._stamp_trace(spec)
         try:
@@ -2024,22 +2156,57 @@ class Runtime(_context.BaseContext):
                                    f"{rec.death_cause}"),
                     task_name=spec.name))
                 return spec.return_ids
-            if rec.state != ALIVE or rec.worker_id is None:
+            # queued-not-empty implies an ordering predecessor (an
+            # undeliverable requeue or a direct-path fallback) still
+            # waiting: append BEHIND it even while ALIVE, or this call
+            # would overtake it (per-handle submission order)
+            self._stamp_order(st, spec)
+            if (rec.state != ALIVE or rec.worker_id is None
+                    or st.queued):
+                was_alive = rec.state == ALIVE and st.queued
                 st.queued.append(spec)
-                return spec.return_ids
-            st.inflight[spec.task_id] = spec
-            target = rec.worker_id
+                if not was_alive:
+                    return spec.return_ids
+            else:
+                # sticky direct fallback clears once every book is
+                # empty: all prior calls reached a terminal state, so
+                # a fresh direct call cannot overtake anything
+                if (st.fallback and not st.inflight
+                        and not st.direct_inflight):
+                    st.fallback = False
+                spec._route = "direct"   # tentative: the routability
+                                         # probe must not see this
+                                         # spec as a head predecessor
+                st.inflight[spec.task_id] = spec
+                claim = st.epoch
+                target = rec.worker_id
+                use_direct = (not st.fallback
+                              and self._direct_routable(rec, st))
+                was_alive = False
+        if was_alive:                   # appended behind the queue
+            self._flush_actor_queue(actor_id)
+            return spec.return_ids
+        if use_direct and self._try_direct_actor_call(rec, st, spec):
+            return spec.return_ids
+        spec._route = "head"
         if not self._send_actor_task(target, spec):
             with st.lock:
-                # Requeue only if a concurrent _recover_actor didn't already
-                # claim it from inflight (else it would run twice).
-                if st.inflight.pop(spec.task_id, None) is not None:
-                    st.queued.append(spec)
+                # Requeue only if a concurrent _recover_actor didn't
+                # already claim it (epoch check): recovery may have
+                # requeued AND re-sent this spec already — a blind pop
+                # here silently dropped the call (r18 satellite fix).
+                if st.epoch != claim:
+                    self._direct_stats["send_race_kept"] += 1
+                elif st.inflight.pop(spec.task_id, None) is not None:
+                    self._requeue_in_order(st, spec)
         return spec.return_ids
 
     submit_actor_task = submit_actor_task_spec
 
     def _send_actor_task(self, worker_id: str, spec: ActorTaskSpec) -> bool:
+        # load-independent signal for bench_core: every head-routed
+        # actor-task send counts (direct-path calls never come here)
+        self._direct_stats["head_routed_sends"] += 1
         sched = self._scheduler_for_worker(worker_id)
         if sched is None:
             return False
@@ -2056,12 +2223,451 @@ class Runtime(_context.BaseContext):
                     return
                 spec = st.queued.pop(0)
                 st.inflight[spec.task_id] = spec
+                claim = st.epoch
                 target = rec.worker_id
+            spec._route = "head"
             if not self._send_actor_task(target, spec):
                 with st.lock:
-                    st.inflight.pop(spec.task_id, None)
-                    st.queued.insert(0, spec)
+                    # same claim discipline as the submit path: a
+                    # recovery sweep between the send failure and this
+                    # repop already owns the spec
+                    if st.epoch != claim:
+                        self._direct_stats["send_race_kept"] += 1
+                    elif st.inflight.pop(spec.task_id,
+                                         None) is not None:
+                        self._requeue_in_order(st, spec)
                 return
+
+    # ---- per-handle ordering helpers (r18) ----
+    @staticmethod
+    def _stamp_order(st, spec) -> None:
+        """Assign the actor's next submission-order stamp (caller
+        holds st.lock). Idempotent: a re-placed spec keeps its
+        original position."""
+        if getattr(spec, "_order", None) is None:
+            spec._order = st.next_order
+            st.next_order += 1
+
+    @staticmethod
+    def _requeue_in_order(st, spec) -> None:
+        """Insert a re-placed spec into st.queued by its submission
+        stamp (caller holds st.lock): requeues arrive from multiple
+        sources (direct NACK fallbacks, undeliverable events, recovery
+        sweeps) whose processing order is not submission order."""
+        import bisect
+        keys = [getattr(s, "_order", 0) for s in st.queued]
+        i = bisect.bisect(keys, getattr(spec, "_order", 0))
+        st.queued.insert(i, spec)
+
+    # ---- direct actor call plane (r18) ----
+    def _direct_routable(self, rec, st) -> bool:
+        """Whether the driver may dial this actor's host directly:
+        config on, the actor lives on a REMOTE healthy node whose
+        agent speaks wire MINOR >= 8, and every in-flight call for the
+        handle is itself direct (a head-routed call still in transit
+        must not be overtaken). Caller holds st.lock."""
+        from ray_tpu._private.config import CONFIG as _C
+        if not _C.direct_actor:
+            return False
+        if rec.node_id in (None, self.head_node_id):
+            return False          # head-local: already zero-hop here
+        node = self.cluster.get_node(rec.node_id)
+        if node is None or not node.alive or node.suspect:
+            return False
+        handle = node.scheduler
+        conn = getattr(handle, "conn", None)
+        if (conn is None or getattr(handle, "draining", False)
+                or not conn.peer_speaks_direct_actor()):
+            return False
+        return all(getattr(s, "_route", "") == "direct"
+                   for s in st.inflight.values())
+
+    def _direct_conn(self, addr: tuple) -> Optional[protocol.Connection]:
+        from ray_tpu._private import direct_actor as _da
+        return _da.dial_cached(self._direct_conns, self._direct_lock,
+                               addr, poller=self._poller)
+
+    def _try_direct_actor_call(self, rec, st, spec) -> bool:
+        """Driver-as-caller: stream the call straight to the hosting
+        agent's listener; the reply (inline results / located hints)
+        lands on the dialed connection and seals into the head store
+        in-process — zero head control-plane frames in steady state.
+        The spec is already claimed in st.inflight; False falls back
+        to the head-routed send."""
+        node = self.cluster.get_node(rec.node_id)
+        handle = node.scheduler if node else None
+        addr = getattr(handle, "advertise_addr", None)
+        if not addr:
+            return False
+        # worker-direct when the worker's listener is known (heartbeat
+        # rows); agent-hosted otherwise — same preference as resolve,
+        # but never switch endpoints while other calls are in flight
+        wport = handle.direct_port_of(rec.worker_id)
+        want = (addr[0], int(wport or addr[1]))
+        with self._direct_lock:
+            prev = self._direct_actor_addr.get(spec.actor_id)
+        if prev is not None and prev != want:
+            with st.lock:
+                if len(st.inflight) > 1:      # beyond this spec
+                    want = prev               # quiet moments only
+        with self._direct_lock:
+            self._direct_actor_addr[spec.actor_id] = want
+        conn = self._direct_conn(want)
+        if conn is not None:
+            # chaos rules match by peer node id: a partition of the
+            # node must park this plane's frames too
+            conn.meta.setdefault("chaos_peer", rec.node_id)
+        if conn is None:
+            return False
+        spec._route = "direct"
+        msg = {"type": protocol.ACTOR_TASK_DIRECT, "spec": spec,
+               "actor_id": spec.actor_id, "worker_id": rec.worker_id,
+               "epoch": rec.num_restarts,
+               "node_incarnation": handle.incarnation}
+        if _tp.enabled() and getattr(spec, "trace_id", 0):
+            sid = _tp.new_id()
+            t0 = _tp.now()
+            _tp.record("direct", "send", t0, t0, spec.trace_id, sid,
+                       getattr(spec, "parent_span", 0),
+                       {"node": rec.node_id})
+            spec.parent_span = sid
+            msg["_trace"] = (spec.trace_id, sid)
+        try:
+            fut = conn.request_async(msg)
+        except protocol.ConnectionClosed:
+            spec._route = "head"
+            return False
+        self._direct_stats["direct_calls"] += 1
+        node_id = rec.node_id
+        fut.add_done_callback(
+            lambda f: self._on_direct_reply(node_id, st, spec, f))
+        return True
+
+    def _on_direct_reply(self, node_id: str, st, spec, fut) -> None:
+        try:
+            rep = fut.result(timeout=0)
+        except BaseException:
+            self._direct_fail(st, spec, started=True)
+            return
+        if rep.get("redirect"):
+            self._direct_fail(st, spec,
+                              started=bool(rep.get("started")))
+            return
+        with st.lock:
+            if st.inflight.pop(spec.task_id, None) is None:
+                # recovery (node death / restart) already claimed this
+                # call and re-placed or errored it: first terminal
+                # wins — the late reply is dropped whole, results and
+                # all, exactly like a stale-attempt NODE_TASK_DONE
+                self._direct_stats["stale_replies"] += 1
+                return
+        self._direct_stats["direct_replies"] += 1
+        error = bool(rep.get("error"))
+        for stored in rep.get("inline", ()):
+            self._seal_contained(stored.object_id, stored.contained_ids)
+            self.store.put_stored(stored)
+            self._direct_stats["inline_bytes"] += stored.nbytes
+            if self.controller.unreferenced(stored.object_id):
+                self._delete_everywhere(stored.object_id)
+        for oid, nbytes, host_nid, contained in rep.get("located", ()):
+            self._seal_contained(oid, contained)
+            self.controller.add_location(oid, host_nid or node_id,
+                                         nbytes)
+            self.waiters.notify(oid)
+        self._unpin(spec.pinned_refs)
+        _mp.observe_task_done(spec, node_id)
+        if _tp.enabled() and getattr(spec, "trace_id", 0):
+            t1 = _tp.now()
+            _tp.record("direct", "reply:" + (spec.name or ""), t1, t1,
+                       spec.trace_id, _tp.new_id(),
+                       getattr(spec, "parent_span", 0))
+        self.controller.record_task_event(
+            spec.task_id, spec.name, "FAILED" if error else "FINISHED")
+
+    def _direct_fail(self, st, spec, started: bool) -> None:
+        """A direct call NACKed (stale endpoint, fenced/disconnected
+        host) or its connection died. Flip the actor to sticky head-
+        routed fallback and route THIS call through the head's own
+        semantics: a provably-undelivered call requeues free (the
+        actor_task_undeliverable rule); an ambiguous one charges the
+        retry budget (the worker-died-inflight rule). The budget is
+        GATED here but not consumed: the head-routed re-execution this
+        fallback hands the call to charges any subsequent loss through
+        its own machinery (undeliverable requeues free, worker-death
+        recovery charges) — consuming it here too double-charged one
+        worker death (NACK + recovery) and errored calls that still
+        had budget."""
+        self._direct_stats["redirects"] += 1
+        with st.lock:
+            st.fallback = True
+            if st.inflight.pop(spec.task_id, None) is None:
+                self._direct_stats["stale_replies"] += 1
+                return               # recovery already owns this call
+            retry = (not started
+                     or spec.retries_used < spec.max_retries)
+            if retry:
+                self._requeue_in_order(st, spec)
+        if not retry:
+            self._store_error(spec.return_ids, TaskError(
+                ActorError(spec.actor_id,
+                           "direct actor call failed (worker died or "
+                           "endpoint fenced); no retries left"),
+                task_name=spec.name))
+            self._unpin(spec.pinned_refs)
+            self.controller.record_task_event(
+                spec.task_id, spec.name, "FAILED",
+                error="direct call failed")
+            return
+        self._flush_actor_queue(spec.actor_id)
+
+    def _resolve_actor_endpoint(self, actor_id: str) -> dict:
+        """ACTOR_RESOLVE: the actor's direct endpoint for a remote
+        caller — hosting listener address, worker id, restart epoch,
+        node incarnation — or direct=False when the call must stay
+        head-routed (actor pending/queued, node suspect/draining/old-
+        wire, head bound to a wildcard address)."""
+        from ray_tpu._private.config import CONFIG as _C
+        self._direct_stats["resolves"] += 1
+        if not _C.direct_actor:
+            return {"direct": False, "state": "disabled"}
+        rec = self.controller.get_actor(actor_id)
+        if rec is None or rec.state == DEAD:
+            return {"direct": False, "state": "dead",
+                    "cause": (rec.death_cause if rec else
+                              "unknown actor")}
+        st = self._actor_states.get(actor_id)
+        if st is not None:
+            with st.lock:
+                if st.queued or any(
+                        getattr(s, "_route", "") != "direct"
+                        for s in st.inflight.values()):
+                    # a queued backlog or an in-flight head-routed
+                    # call owns the ordering: a direct call resolved
+                    # now could overtake it on the wire. Once both
+                    # books are clear of head-routed work, every
+                    # earlier call has EXECUTED at the host, so the
+                    # caller's stream cannot reorder against them.
+                    return {"direct": False, "state": "queued"}
+        if rec.state != ALIVE or rec.worker_id is None:
+            return {"direct": False, "state": "pending"}
+        if rec.node_id in (None, self.head_node_id):
+            host = self.address[0]
+            if host in ("0.0.0.0", "::", ""):
+                return {"direct": False, "state": "head_wildcard"}
+            # worker-direct when the local worker's listener is known;
+            # the head's own listener (head-as-host) otherwise
+            sched = self.scheduler
+            wport = (sched.direct_port_of(rec.worker_id)
+                     if sched is not None else None)
+            return {"direct": True, "host": host,
+                    "port": int(wport or self.address[1]),
+                    "worker_id": rec.worker_id,
+                    "node_id": self.head_node_id,
+                    "epoch": rec.num_restarts, "incarnation": None}
+        node = self.cluster.get_node(rec.node_id)
+        handle = node.scheduler if node else None
+        conn = getattr(handle, "conn", None)
+        if (node is None or not node.alive or node.suspect
+                or conn is None
+                or getattr(handle, "draining", False)
+                or not conn.peer_speaks_direct_actor()):
+            return {"direct": False, "state": "no_route"}
+        addr = handle.advertise_addr
+        # prefer the WORKER's own serving socket (caller -> worker ->
+        # caller, no agent hop); its port rides the agent's heartbeat
+        # worker rows — until a beat carries it, the agent listener
+        # hosts the calls (one extra local hop, still head-free)
+        wport = handle.direct_port_of(rec.worker_id)
+        return {"direct": True, "host": addr[0],
+                "port": int(wport or addr[1]),
+                "worker_id": rec.worker_id, "node_id": rec.node_id,
+                "epoch": rec.num_restarts,
+                "incarnation": handle.incarnation,
+                # agent-hosted because the worker's port hasn't ridden
+                # a heartbeat yet: the caller may re-resolve later (at
+                # a quiet moment) to upgrade to the worker's socket
+                "provisional": wport is None}
+
+    def _on_actor_task_direct(self, conn: protocol.Connection,
+                              msg: dict) -> None:
+        """Head-as-host: a remote caller direct-dialed the head for an
+        actor living on the head node. Validate the endpoint is still
+        current, forward over the worker's connection, and remember
+        the caller — the worker's TASK_DONE answers it inline."""
+        from ray_tpu._private import direct_actor as _da
+        from ray_tpu._private.config import CONFIG as _C
+        spec: ActorTaskSpec = msg["spec"]
+        actor_id = msg["actor_id"]
+        wid = msg["worker_id"]
+        rec = self.controller.get_actor(actor_id)
+        reason = None
+        if not _C.direct_actor:
+            reason = "disabled"
+        elif (rec is None or rec.state != ALIVE
+              or rec.worker_id != wid
+              or rec.node_id not in (None, self.head_node_id)
+              or int(msg.get("epoch", -1)) != rec.num_restarts):
+            reason = "stale_endpoint"
+        if reason is None:
+            self._direct_pending.add(spec.task_id, conn,
+                                     msg.get("rid"), wid)
+            if self._send_actor_task(wid, spec):
+                self._direct_stats["served"] += 1
+                return
+            self._direct_pending.pop(spec.task_id)
+            reason = "send_failed"
+        self._direct_stats["nacks"] += 1
+        _da.nack(conn, msg.get("rid"), reason, False)
+
+    def _reply_direct_done(self, ent: tuple, msg: dict,
+                           results: list) -> None:
+        """Head-as-host completion: results already sealed into the
+        head store (the owner-side copy every getter resolves
+        against); answer the dialed caller with inline copies of the
+        small ones. Large results stay head-resident — the caller's
+        get() falls through to the ordinary pull path."""
+        from ray_tpu._private.config import CONFIG as _C
+        from ray_tpu._private.object_transfer import materialize
+        conn, rid, _wid = ent
+        inline = []
+        for stored in results:
+            if (stored.nbytes <= _C.remote_inline_max_bytes
+                    or stored.is_error):
+                m = materialize(stored)
+                inline.append(m)
+                self._direct_stats["served_bytes"] += m.nbytes
+        try:
+            conn.reply({"rid": rid}, inline=inline, located=[],
+                       error=bool(msg.get("error")),
+                       error_repr=msg.get("error_repr"))
+        except protocol.ConnectionClosed:
+            pass          # caller died; the store keeps the results
+
+    def _on_actor_inflight_delta(self, conn: protocol.Connection,
+                                 msg: dict) -> None:
+        """Coalesced direct-call mirror from a remote caller (the r16
+        decref-delta pattern). Adds park the spec (and pin its args)
+        so actor death/restart still errors/requeues in-flight direct
+        calls; dones release pins and register holder-side result
+        locations; fail entries route NACKed calls through the head's
+        retry machinery. First terminal wins: a done/fail whose entry
+        was already claimed (recovery ran) is dropped whole."""
+        self._direct_stats["delta_frames"] += 1
+        caller = msg.get("caller")
+        for actor_id, spec in msg.get("adds", ()):
+            self._direct_stats["delta_adds"] += 1
+            with self._direct_lock:
+                if spec.task_id in self._direct_done_ring:
+                    # head-as-host already answered this call inline
+                    # (and recorded its terminal event) before the
+                    # caller's coalesced add arrived: a late add would
+                    # pin args forever and park a phantom entry the
+                    # next recovery sweep re-errors
+                    continue
+            rec = self.controller.get_actor(actor_id)
+            st = self._actor_state(actor_id)
+            with st.lock:
+                if rec is None or rec.state == DEAD:
+                    dead_cause = (rec.death_cause if rec
+                                  else "unknown actor")
+                else:
+                    spec._direct_caller = caller
+                    self._stamp_order(st, spec)
+                    st.direct_inflight[spec.task_id] = spec
+                    dead_cause = None
+            if dead_cause is not None:
+                # the caller's direct conn may be wedged on a dead
+                # host; its fallback get() resolves this error
+                self._store_error(spec.return_ids, TaskError(
+                    ActorDiedError(actor_id,
+                                   f"Actor {actor_id} is dead: "
+                                   f"{dead_cause}"),
+                    task_name=spec.name))
+                continue
+            for oid in spec.pinned_refs:
+                self.controller.pin(oid)
+        for ent in msg.get("dones", ()):
+            self._direct_stats["delta_dones"] += 1
+            self._apply_direct_done_entry(ent)
+
+    def _apply_direct_done_entry(self, ent: dict) -> None:
+        actor_id = ent["actor_id"]
+        task_id = ent["task_id"]
+        st = self._actor_states.get(actor_id)
+        if st is None:
+            return
+        with st.lock:
+            spec = st.direct_inflight.pop(task_id, None)
+        if spec is None:
+            with self._fence_lock:
+                self._fence_stats["stale_attempt_drops"] += 1
+            return                    # recovery already owned it
+        if ent.get("retract"):
+            # the caller's direct send never left its process: just
+            # undo the add's pins (the caller re-submits head-routed)
+            self._unpin(spec.pinned_refs)
+            return
+        if ent.get("failed"):
+            # budget gated, not consumed — the _direct_fail rule: the
+            # head-routed re-execution charges any subsequent loss
+            started = bool(ent.get("started"))
+            retry = (not started
+                     or spec.retries_used < spec.max_retries)
+            if retry:
+                with st.lock:
+                    self._requeue_in_order(st, spec)
+                self._flush_actor_queue(actor_id)
+            else:
+                self._store_error(spec.return_ids, TaskError(
+                    ActorError(actor_id,
+                               "direct actor call failed (worker "
+                               "died or endpoint fenced); no retries "
+                               "left"),
+                    task_name=spec.name))
+                self._unpin(spec.pinned_refs)
+                self.controller.record_task_event(
+                    task_id, spec.name, "FAILED",
+                    error="direct call failed")
+            return
+        for stored in ent.get("inline", ()):
+            # owner-side seal of the caller's inline-replied results:
+            # third parties resolve here exactly as on the head-routed
+            # path — the bytes just arrived coalesced instead of per
+            # call
+            self._seal_contained(stored.object_id, stored.contained_ids)
+            self.store.put_stored(stored)
+            if self.controller.unreferenced(stored.object_id):
+                self._delete_everywhere(stored.object_id)
+        for oid, nbytes, host_nid, contained in ent.get("located", ()):
+            self._seal_contained(oid, contained)
+            if host_nid:
+                self.controller.add_location(oid, host_nid, nbytes)
+            self.waiters.notify(oid)
+        self._unpin(spec.pinned_refs)
+        located = ent.get("located") or ()
+        _mp.observe_task_done(
+            spec, (located[0][2] if located and located[0][2]
+                   else self.head_node_id))
+        state = "FAILED" if ent.get("error") else "FINISHED"
+        self.controller.record_task_event(task_id, spec.name, state)
+
+    def _drop_direct_calls_of_caller(self, worker_id: str) -> None:
+        """A remote caller worker died: its mirrored direct calls can
+        never send their done entries — release their pins and drop
+        them (nobody is left to consume the results; the conservative
+        direction, like a SIGKILLed borrower's refs)."""
+        if not worker_id:
+            return
+        with self._actor_lock:
+            states = list(self._actor_states.values())
+        for st in states:
+            with st.lock:
+                dead = [t for t, s in st.direct_inflight.items()
+                        if getattr(s, "_direct_caller", None)
+                        == worker_id]
+                specs = [st.direct_inflight.pop(t) for t in dead]
+            for spec in specs:
+                self._unpin(spec.pinned_refs)
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
         rec = self.controller.get_actor(actor_id)
@@ -2227,6 +2833,19 @@ class Runtime(_context.BaseContext):
                 "incarnations": self.controller.incarnations(),
                 "fence": dict(self._fence_stats),
             }
+        if op == "direct_actor_stats":
+            # r18 direct actor plane observability: head-side caller/
+            # host counters, pending head-hosted direct calls, and
+            # each agent's heartbeat-carried host counters
+            return {
+                "head": dict(self._direct_stats),
+                "pending": len(self._direct_pending),
+                "nodes": {
+                    n.node_id: dict(getattr(n.scheduler,
+                                            "direct_stats", None)
+                                    or {})
+                    for n in self.cluster.alive_nodes()},
+            }
         if op == "head_ha_stats":
             # r15 head-HA observability: WAL bytes/records/fsync
             # latencies, snapshot age, recovery + replay-dedup counts
@@ -2272,6 +2891,7 @@ class Runtime(_context.BaseContext):
                                else None)),
                      (lambda: (self._ha.close()
                                if self._ha is not None else None)),
+                     self._close_direct_conns,
                      self.cluster.shutdown, self.waiters.shutdown,
                      self.controller.pubsub.close,
                      lambda: self._restore_pool.shutdown(wait=False),
@@ -2284,6 +2904,16 @@ class Runtime(_context.BaseContext):
                 step()
             except Exception:
                 log.exception("shutdown step failed")
+
+    def _close_direct_conns(self) -> None:
+        with self._direct_lock:
+            conns = list(self._direct_conns.values())
+            self._direct_conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
 
     def _sweep_orphan_segments(self) -> None:
         """Final backstop against shm leaks: every worker/agent this

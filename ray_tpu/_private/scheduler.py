@@ -463,7 +463,20 @@ class Scheduler:
                 # a mixed-mode fleet is a perf-debugging smell
                 "wire_native": (r.conn.meta.get("wire_native")
                                 if r.conn is not None else None),
+                # r18 worker-direct serving socket (None: no listener)
+                "direct_port": (r.conn.meta.get("direct_port")
+                                if r.conn is not None else None),
             } for r in self._workers.values()]
+
+    def direct_port_of(self, worker_id: str):
+        """The worker's r18 direct-serving port (None when it has no
+        listener or is gone) — resolve-time input for the direct
+        actor call plane."""
+        with self._lock:
+            rec = self._workers.get(worker_id)
+            if rec is None or rec.state == DEAD or rec.conn is None:
+                return None
+            return rec.conn.meta.get("direct_port")
 
     def worker_running_task(self, task_id: str):
         """(worker_id, spec) currently executing (or queued in) the
@@ -1148,7 +1161,8 @@ class Scheduler:
     _INLINE_SCAN_LIMIT = 64
 
     @staticmethod
-    def _send_dispatch_outbox(outbox: list) -> None:
+    def _send_dispatch_outbox(outbox: list,
+                              eager: bool = False) -> None:
         """Ship the sweep's accumulated (conn, msg) dispatches through
         each worker connection's coalescing queue: the flusher thread
         pays the encode+sendall (keeping it off the submitting/
@@ -1157,12 +1171,23 @@ class Scheduler:
         run BEFORE the scheduler lock is dropped: the steal-back path
         (worker_blocked) takes the lock and sends UNQUEUE_TASK eagerly,
         which flushes the queue first — a TASK parked here can never be
-        overtaken, but it must already BE in the queue by then."""
+        overtaken, but it must already BE in the queue by then.
+
+        ``eager`` (r18 sync-latency triage): a LONE dispatch with an
+        empty queue behind it is a sync round-trip, not a burst — the
+        coalescing window would charge it ~wire_batch_delay_ms of pure
+        latency for nothing (the submitting thread is about to block
+        in get() anyway), the same reasoning as the worker's lone-
+        completion eager TASK_DONE. Bursts keep the lazy path: under a
+        drain the queue is non-empty and the flusher amortizes."""
         if not outbox:
             return
         for conn, msg in outbox:
             try:
-                conn.send_lazy(msg)
+                if eager:
+                    conn.send(msg)
+                else:
+                    conn.send_lazy(msg)
             except protocol.ConnectionClosed:
                 pass      # worker-death recovery requeues its tasks
         outbox.clear()
@@ -1345,7 +1370,8 @@ class Scheduler:
                                                 charged, msg)
                 outbox.append((worker.conn, msg))
             dispatched += 1
-        self._send_dispatch_outbox(outbox)
+        self._send_dispatch_outbox(
+            outbox, eager=(len(outbox) == 1 and not self._pending))
         return dispatched > 0
 
     def _record_dispatch_spans(self, spec, worker: WorkerRec,

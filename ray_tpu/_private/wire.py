@@ -50,7 +50,7 @@ from ray_tpu import native as _native
 from ray_tpu._private import wire_pb2 as pb
 
 WIRE_MAJOR = 1
-WIRE_MINOR = 7          # 1: BatchFrame coalescing (negotiated by peers)
+WIRE_MINOR = 8          # 1: BatchFrame coalescing (negotiated by peers)
                         # 2: Envelope trace_id/parent_span (tracing
                         #    plane; old peers skip unknown fields)
                         # 3: delegated scheduling ops (NODE_LEASE_BATCH
@@ -66,6 +66,9 @@ WIRE_MINOR = 7          # 1: BatchFrame coalescing (negotiated by peers)
                         #    envelope change — CH_DATA reuses `raw`)
                         # 7: NODE_DECREF_DELTA coalesced refcount
                         #    deltas (r16; no envelope change)
+                        # 8: direct actor call plane (r18:
+                        #    ACTOR_RESOLVE / ACTOR_TASK_DIRECT /
+                        #    ACTOR_INFLIGHT_DELTA; no envelope change)
 WIRE_VERSION = WIRE_MAJOR * 100 + WIRE_MINOR
 
 # First MINOR that understands a type=="batch" Envelope carrying a
@@ -124,6 +127,16 @@ CHANNEL_MIN_MINOR = 6
 # DECREF_BATCH frames otherwise (negotiated by observation, the
 # BatchFrame discipline).
 DECREF_DELTA_MIN_MINOR = 7
+
+# First MINOR whose handlers speak the direct actor call plane (r18):
+# ACTOR_RESOLVE endpoint lookups, peer-dialed ACTOR_TASK_DIRECT
+# submissions with inline replies, and coalesced ACTOR_INFLIGHT_DELTA
+# mirror frames. An OLD peer would silently drop every one of them —
+# a resolve or direct call toward it would hang its caller's future
+# until the stall fallback — so callers go direct only toward peers
+# that demonstrated MINOR >= 8 and stay on the head-routed actor path
+# otherwise (negotiated by observation, the BatchFrame discipline).
+DIRECT_ACTOR_MIN_MINOR = 8
 
 # Message-dict carrier for the Envelope `raw` field. On encode the
 # value is a LIST of buffer objects (bytes/memoryview — mapped shm

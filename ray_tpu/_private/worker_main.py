@@ -16,6 +16,7 @@ import asyncio
 import inspect
 import os
 import pickle
+import socket
 import sys
 import threading
 import time
@@ -43,6 +44,27 @@ class WorkerContext(_context.BaseContext):
         self.conn = conn
         self.worker_id = worker_id
         self._sent_funcs: set[str] = set()
+        # r18 direct actor caller: created lazily on the first actor
+        # call once the peer has demonstrated wire MINOR >= 8 (the
+        # delta flusher thread shouldn't exist in workers that never
+        # call actors)
+        self._direct = None
+        self._direct_lock = threading.Lock()
+
+    def _direct_caller(self):
+        from ray_tpu._private.config import CONFIG
+        if not CONFIG.direct_actor or \
+                not self.conn.peer_speaks_direct_actor():
+            return None
+        with self._direct_lock:
+            if self._direct is None:
+                from ray_tpu._private import refs as _refs
+                from ray_tpu._private.direct_actor import (
+                    WorkerDirectCaller)
+                self._direct = WorkerDirectCaller(self)
+                # a released return ref drops its cached inline reply
+                _refs.register_release_hook(self._direct.release)
+            return self._direct
 
     # ---- object plane ----
     def put(self, value: Any) -> ObjectRef:
@@ -72,6 +94,22 @@ class WorkerContext(_context.BaseContext):
         return out
 
     def _get_one(self, oid: str, timeout):
+        # r18 direct plane: a return ref of a direct actor call
+        # resolves against the inline-reply cache (zero frames). When
+        # the reply is still in flight this waits on its future — with
+        # a stall fallback onto the normal head path, which is where a
+        # dead/partitioned host's calls resolve (the head errors its
+        # mirrored in-flight entries with ActorDiedError).
+        if self._direct is not None:
+            t0 = time.monotonic()
+            stored = self._direct.wait_inline(oid, timeout)
+            if stored is not None:
+                return deserialize(stored), stored
+            if timeout is not None:
+                # the head-routed fallback gets the REMAINING budget,
+                # not a fresh one — get(timeout=T) must bound at ~T
+                timeout = max(0.0, timeout
+                              - (time.monotonic() - t0))
         for attempt in (0, 1):
             # stamped: the serving side (head/agent) parents its pull
             # spans under this get's span — arg pulls join the timeline
@@ -155,6 +193,19 @@ class WorkerContext(_context.BaseContext):
         with _tp.span("submit", spec.name or spec.task_id) as tr:
             if tr is not None:
                 spec.trace_id, spec.parent_span = tr
+            # return-id borrows register eagerly ahead of the submit
+            # on BOTH routes (lazy ADDREFs coalesce with neighboring
+            # frames): the borrow must be structurally ordered before
+            # any decref this process later emits for the same ref
+            for oid in spec.return_ids:
+                self.addref(oid)
+            # r18: peer-to-peer fast path — resolve the actor's
+            # endpoint once, stream the call to its host, take the
+            # reply inline; falls back to the head-routed submit
+            # whenever the direct plane declines the call
+            d = self._direct_caller()
+            if d is not None and d.submit(actor_id, spec):
+                return spec.return_ids
             self.conn.request({"type": protocol.SUBMIT_ACTOR_TASK,
                                "actor_id": actor_id, "spec": spec})
         return spec.return_ids
@@ -313,6 +364,113 @@ class WorkerExecutor:
         # flight — a lone sync round-trip must not eat the ~1 ms
         # coalescing window
         self._inflight = 0
+        # r18 worker-direct serving: callers that dialed this worker's
+        # own listener, awaiting an inline reply (task_id -> (conn,
+        # rid)); the listener port rides the REGISTER frame so the
+        # head can resolve this worker as the actor's endpoint
+        self._direct_replies: dict[str, tuple] = {}
+        self._direct_lock = threading.Lock()
+        self._direct_listener = None
+        self._direct_port = None
+
+    # ---- direct actor call serving (r18) ----
+    def start_direct_server(self):
+        """Open this worker's direct-call listener (caller -> worker
+        -> caller, no agent hop); returns the port for the REGISTER
+        frame, or None (plane off / bind failed — callers fall back
+        to agent-hosted serving)."""
+        from ray_tpu._private.config import CONFIG
+        if not (CONFIG.direct_actor and CONFIG.direct_actor_worker):
+            return None
+        try:
+            lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            lsock.setsockopt(socket.SOL_SOCKET,
+                             socket.SO_REUSEADDR, 1)
+            lsock.bind(("0.0.0.0", 0))
+            lsock.listen(64)
+        except OSError:
+            return None
+        self._direct_listener = lsock
+        self._direct_port = lsock.getsockname()[1]
+        threading.Thread(target=self._direct_accept_loop,
+                         name="rtpu-worker-direct",
+                         daemon=True).start()
+        return self._direct_port
+
+    def _direct_accept_loop(self) -> None:
+        while not self.stop_event.is_set():
+            try:
+                sock, _ = self._direct_listener.accept()
+            except OSError:
+                return
+            conn = protocol.Connection(sock, self._handle_direct,
+                                       name="worker-direct",
+                                       server=True)
+            conn.start()
+
+    def _handle_direct(self, conn: protocol.Connection,
+                       msg: dict) -> None:
+        """Messages from direct-dialed callers. Validation IS the
+        fence: the worker id is unique per process, so a stale
+        endpoint (actor restarted -> new worker/new port) can never
+        validate here — it NACKs redirect-to-head."""
+        mtype = msg["type"]
+        if mtype == protocol.ACTOR_TASK_DIRECT:
+            from ray_tpu._private import direct_actor as _da
+            spec = msg["spec"]
+            aspec = self._actor_spec
+            if (msg.get("worker_id") != self.ctx.worker_id
+                    or self._actor is None or aspec is None
+                    or aspec.actor_id != msg.get("actor_id")):
+                _da.nack(conn, msg.get("rid"),
+                         "stale_worker_endpoint", False)
+                return
+            with self._direct_lock:
+                self._direct_replies[spec.task_id] = (conn,
+                                                      msg.get("rid"))
+            self._accept_actor_task(spec, msg)
+        elif mtype == protocol.PING:
+            conn.reply(msg, ok=True)
+
+    def _reply_direct(self, ent: tuple, task_id: str,
+                      stored_list: list, error: bool,
+                      extra: dict) -> None:
+        """Answer a direct caller inline. Small results (and errors)
+        ride the reply; large ones go to the node store via a
+        direct_located TASK_DONE so the ordinary directory + pull
+        path serves every getter — the reply itself stays small."""
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu._private.object_transfer import materialize
+        conn, rid = ent
+        inline, big = [], []
+        for s in stored_list:
+            if (s.nbytes <= CONFIG.remote_inline_max_bytes
+                    or s.is_error):
+                inline.append(materialize(s))
+                from ray_tpu._private.object_store import \
+                    unlink_segment
+                for name in s.shm_names:
+                    unlink_segment(name)
+            else:
+                big.append(s)
+        try:
+            conn.reply({"rid": rid}, inline=inline, located=[],
+                       error=error)
+        except protocol.ConnectionClosed:
+            # caller died mid-call: ship the small results through the
+            # node store too (the direct_located path below), so a
+            # third-party holder of the return ref still resolves
+            big = big + inline
+        if big:
+            try:
+                self.ctx.conn.send(
+                    {"type": protocol.TASK_DONE, "task_id": task_id,
+                     "results": big, "error": error,
+                     "is_actor_task": True, "direct_located": True,
+                     "actor_id": extra.get("actor_id"),
+                     "name": extra.get("name")})
+            except protocol.ConnectionClosed:
+                pass
 
     # ---- message entry (called on reader thread) ----
     def handle(self, conn: protocol.Connection, msg: dict) -> None:
@@ -332,18 +490,7 @@ class WorkerExecutor:
                     thread_name_prefix="rtpu-actor")
             self._pool.submit(self._create_actor, spec)
         elif mtype == protocol.ACTOR_TASK:
-            aspec: ActorTaskSpec = msg["spec"]
-            self._stamp_recv(aspec, msg)
-            with self._queue_lock:
-                self._inflight += 1
-            method = getattr(type(self._actor), aspec.method_name, None) \
-                if self._actor is not None else None
-            if method is not None and inspect.iscoroutinefunction(method):
-                self._ensure_loop()
-                asyncio.run_coroutine_threadsafe(
-                    self._run_actor_task_async(aspec), self._loop)
-            else:
-                self._pool.submit(self._run_actor_task, aspec)
+            self._accept_actor_task(msg["spec"], msg)
         elif mtype == protocol.CANCEL_TASK:
             self._cancel_running(msg["task_id"])
         elif mtype == protocol.UNQUEUE_TASK:
@@ -371,6 +518,24 @@ class WorkerExecutor:
             self.stop_event.set()
         elif mtype == protocol.PING:
             conn.reply(msg, ok=True)
+
+    def _accept_actor_task(self, aspec: ActorTaskSpec,
+                           msg: dict) -> None:
+        """Queue one actor call for execution — shared by the classic
+        pushed ACTOR_TASK and the r18 direct-dialed path (one entry
+        point keeps the per-handle FIFO/async dispatch identical on
+        both transports)."""
+        self._stamp_recv(aspec, msg)
+        with self._queue_lock:
+            self._inflight += 1
+        method = getattr(type(self._actor), aspec.method_name, None) \
+            if self._actor is not None else None
+        if method is not None and inspect.iscoroutinefunction(method):
+            self._ensure_loop()
+            asyncio.run_coroutine_threadsafe(
+                self._run_actor_task_async(aspec), self._loop)
+        else:
+            self._pool.submit(self._run_actor_task, aspec)
 
     @staticmethod
     def _stamp_recv(spec, msg: dict) -> None:
@@ -569,6 +734,16 @@ class WorkerExecutor:
         with self._queue_lock:
             self._inflight = max(0, self._inflight - 1)
             busy = self._inflight > 0
+        if extra.get("is_actor_task"):
+            # r18 worker-direct: this call's caller dialed us — the
+            # completion goes back inline on its connection, never
+            # through the agent/head
+            with self._direct_lock:
+                ent = self._direct_replies.pop(task_id, None)
+            if ent is not None:
+                self._reply_direct(ent, task_id, stored_list, error,
+                                   extra)
+                return
         msg = {"type": protocol.TASK_DONE, "task_id": task_id,
                "results": stored_list, "error": error, **extra}
         if busy:
@@ -782,13 +957,18 @@ def main() -> None:
     _context.set_ctx(ctx)
     executor = WorkerExecutor(ctx)
     executor_box["exec"] = executor
+    direct_port = executor.start_direct_server()
     from ray_tpu import native as _native
     conn.send({"type": protocol.REGISTER, "worker_id": args.worker_id,
                "pid": os.getpid(),
                # which wire engine this worker runs (native frame
                # pump/codec vs pure Python) — lets the head spot
                # mixed-mode fleets when debugging perf regressions
-               "wire_native": _native.frame_engine_enabled()})
+               "wire_native": _native.frame_engine_enabled(),
+               # r18: this worker's direct-call serving port (None
+               # when the plane is off) — resolves as the actor's
+               # endpoint once the head learns it
+               "direct_port": direct_port})
     executor.stop_event.wait()
     executor.flush_events()
     try:

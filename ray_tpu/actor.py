@@ -1,10 +1,27 @@
 """Actor API: ``@remote`` classes, handles, method proxies.
 
 Parity: reference python/ray/actor.py (ActorClass._remote, ActorHandle,
-ActorMethod). Ordering guarantee: calls submitted through one handle arrive
-in submission order over a single TCP stream and execute on a width-1 pool
-by default, matching the reference's sequential actor scheduling queue
-(src/ray/core_worker/transport/sequential_actor_submit_queue.cc).
+ActorMethod).
+
+Ordering guarantee (tested in tests/test_direct_actor.py): calls
+submitted through one handle execute in submission order. They arrive
+over a single TCP stream and execute on a width-1 pool by default,
+matching the reference's sequential actor scheduling queue
+(src/ray/core_worker/transport/sequential_actor_submit_queue.cc). The
+guarantee holds on BOTH transports and across transitions between
+them:
+
+- head-routed (classic): caller -> head -> hosting node -> worker,
+  one queue per actor head-side while it is pending/restarting;
+- direct (r18, ``RAY_TPU_DIRECT_ACTOR``): the caller resolves the
+  actor's endpoint once, caches it per process (survives handle
+  re-pickling — the cache keys on actor id, not handle identity), and
+  streams calls peer-to-peer to the hosting node, replies inline;
+- across an actor restart (``max_restarts>0``) and across a
+  direct->head fallback redirect: NACKed calls re-enter the head's
+  per-actor queue in submission order, and the handle stays
+  head-routed until every earlier call reached a terminal state, so
+  a later direct call can never overtake an earlier fallback call.
 """
 from __future__ import annotations
 
@@ -146,8 +163,10 @@ class ActorMethod:
             name=f"{self._handle._class_name}.{self._name}",
             pinned_refs=pinned,
         )
-        for oid in spec.return_ids:
-            ctx.addref(oid)
+        # return-id borrows are registered INSIDE submit_actor_task
+        # (r18): the head-routed paths addref eagerly exactly as
+        # before, while a direct call's borrows ride its coalesced
+        # ACTOR_INFLIGHT_DELTA add — no eager per-call head frame.
         ctx.submit_actor_task(self._handle._actor_id, spec)
         refs = [ObjectRef(oid) for oid in spec.return_ids]
         return refs[0] if num_returns == 1 else refs

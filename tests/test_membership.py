@@ -314,3 +314,9 @@ def test_chaos_soak_matrix(chaos_head):
         report = chaos_soak.run_scenario(rt, agents, scenario,
                                          seed=7, tasks=300)
         assert report["ok"], report
+    # r18 direct actor plane: kill / partition mid-direct-call stream
+    # (exactly-once-or-error, zero hangs, zombie endpoint fenced)
+    for scenario in ("actor_kill", "actor_partition"):
+        report = chaos_soak.run_actor_scenario(rt, agents, scenario,
+                                               seed=7, calls=150)
+        assert report["ok"], report

@@ -122,6 +122,11 @@ def test_job_completes_beyond_capacity(tmp_path):
     assert out.read_text() == "ok"
 
 
+@pytest.mark.slow    # ~9s (r18 tier-1 budget): the monitor-kill path
+                     # keeps tier-1 cover via
+                     # test_job_completes_beyond_capacity (spill/
+                     # admission under pressure) and the worker-death
+                     # retry machinery exercised across test_core_*
 def test_memory_monitor_kills_retriable_worker(ray_cluster):
     """Simulated node-memory pressure: the monitor kills the newest
     retriable task worker; the task retries and completes once pressure

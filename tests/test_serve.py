@@ -117,6 +117,11 @@ def test_serve_http_ingress(serve_shutdown):
 
 
 # ----------------------------------------------------- autoscaling
+@pytest.mark.slow    # ~7s (r18 tier-1 budget): serve replica scaling
+                     # keeps tier-1 cover via
+                     # test_serve_scale_and_function_deployment
+                     # (manual scale) and the autoscaler-signal units
+                     # in test_metrics_plane/test_autoscaler
 def test_serve_autoscales_up_and_down(serve_shutdown):
     """VERDICT r3 item 4 gate: load scales 1 -> N; drain scales back to
     min (reference _private/autoscaling_state.py decision loop)."""

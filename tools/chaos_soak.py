@@ -31,7 +31,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:          # standalone: python tools/chaos_soak.py
     sys.path.insert(0, _REPO)
 
-SCENARIOS = ("kill", "partition", "blip")
+SCENARIOS = ("kill", "partition", "blip", "actor_kill",
+             "actor_partition")
 
 
 def _wait(pred, timeout=30.0, step=0.05):
@@ -131,6 +132,81 @@ def run_scenario(rt, agents, scenario: str, seed: int = 0,
     return report
 
 
+def run_actor_scenario(rt, agents, scenario: str, seed: int = 0,
+                       calls: int = 200) -> dict:
+    """r18 direct actor plane gates: kill or partition the hosting
+    node MID-DIRECT-CALL stream. Every call must resolve exactly once
+    or error with ActorDiedError/ActorError — zero hangs — and a
+    partitioned (zombie) endpoint must be fenced: the node re-registers
+    under a fresh incarnation and the caller's stream lands on the
+    re-placed books, never double-resolving a call."""
+    import ray_tpu
+    from ray_tpu.exceptions import RayTpuError
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..", "tests"))
+    import chaos
+
+    kind = scenario.split("_", 1)[1]          # kill | partition
+    tag = f"soak_{scenario}_{seed}"
+    nid = _join_agent(rt, agents, {tag: 1e9})
+    inc0 = rt.controller.node_incarnation(nid)
+
+    @ray_tpu.remote(resources={tag: 1.0})
+    class T:
+        def bump(self, i):
+            return i * 3
+
+    t0 = time.time()
+    a = T.options(max_restarts=2, max_task_retries=1).remote()
+    assert ray_tpu.get(a.bump.remote(0), timeout=60) == 0
+    time.sleep(1.2)        # worker-direct endpoint reaches steady state
+    d0 = dict(rt._direct_stats)
+    refs = [a.bump.remote(i) for i in range(calls // 2)]
+    if kind == "kill":
+        rec = rt.controller.get_actor(a._actor_id)
+        if rec.worker_id is not None:
+            chaos.drop_worker(rt, nid, rec.worker_id)
+    else:
+        chaos.partition(rt, nid)
+        assert _wait(lambda: not rt.cluster.get_node(nid).alive, 20), \
+            "partitioned agent not declared dead"
+        time.sleep(0.3)
+        chaos.heal(rt, nid)
+        assert _wait(lambda: rt.cluster.get_node(nid).alive, 30), \
+            "fenced agent did not re-register"
+    refs += [a.bump.remote(i) for i in range(calls // 2, calls)]
+    values, errors, hangs = 0, 0, 0
+    wrong = 0
+    for i, r in enumerate(refs):
+        try:
+            v = ray_tpu.get(r, timeout=90)
+            values += 1
+            if v != i * 3:
+                wrong += 1
+        except RayTpuError:
+            errors += 1
+        except Exception:
+            hangs += 1              # GetTimeoutError = a hung call
+    d1 = rt._direct_stats
+    report = {
+        "scenario": scenario, "seed": seed, "calls": calls,
+        "wall_s": round(time.time() - t0, 2),
+        "values": values, "errors": errors, "hangs": hangs,
+        "wrong": wrong,
+        "direct_calls": d1["direct_calls"] - d0["direct_calls"],
+        "redirects": d1["redirects"] - d0["redirects"],
+        "stale_replies": d1["stale_replies"] - d0["stale_replies"],
+    }
+    ok = (hangs == 0 and wrong == 0
+          and values + errors == calls
+          and report["direct_calls"] > 0)
+    if kind == "partition":
+        # zombie endpoint fenced: fresh incarnation after the heal
+        ok = ok and rt.controller.node_incarnation(nid) > inc0
+    report["ok"] = ok
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="chaos_soak")
     p.add_argument("--scenarios", default=",".join(SCENARIOS))
@@ -155,8 +231,13 @@ def main(argv=None) -> int:
         agents: list = []
         try:
             for scenario in args.scenarios.split(","):
-                rep = run_scenario(rt, agents, scenario.strip(),
-                                   seed=seed, tasks=args.tasks)
+                scenario = scenario.strip()
+                if scenario.startswith("actor_"):
+                    rep = run_actor_scenario(rt, agents, scenario,
+                                             seed=seed)
+                else:
+                    rep = run_scenario(rt, agents, scenario,
+                                       seed=seed, tasks=args.tasks)
                 flag = "OK " if rep["ok"] else "FAIL"
                 print(f"[{flag}] {rep}")
                 if not rep["ok"]:

@@ -52,6 +52,11 @@ LABELS = [
     ("drain_3k_wal", "3k drain, head WAL + group-commit fsync (r15)"),
     ("head_restart_recovery",
      "head SIGKILL mid-3k-delegated-drain: WAL recovery (r15)"),
+    ("actor_sync_head",
+     "sync actor calls, worker caller, head-routed "
+     "(RAY_TPU_DIRECT_ACTOR=0)"),
+    ("actor_sync_direct",
+     "sync actor calls, worker caller, direct plane (r18)"),
     ("tasks_sync_per_s", "tasks, sync round-trip"),
     ("tasks_batch_per_s", "tasks, batched"),
     ("actor_calls_sync_per_s", "actor calls, sync"),
@@ -111,6 +116,18 @@ def _fmt_result(rec: dict) -> str:
             # multiple of the same-session 5k-delegated floor
             out += (f" ({rec['vs_delegated_floor']}x the 5k-delegated "
                     f"head-CPU floor)")
+        if "p50_ms" in rec:
+            # r18 latency columns: sync scenarios carry per-call
+            # percentiles so a latency regression can't hide behind
+            # the throughput median
+            out += f" (p50 {rec['p50_ms']} ms / p99 {rec['p99_ms']} ms)"
+        if "direct_speedup" in rec:
+            out += f" (direct speedup {rec['direct_speedup']}x)"
+        if "head_frames_per_call" in rec:
+            # r18 acceptance counter: the head's actor-plane frames
+            # per steady-state call (~0 on the direct arm)
+            out += (f" (head frames/call "
+                    f"{rec['head_frames_per_call']})")
         if "overlap_speedup" in rec:
             out += f" (overlap speedup {rec['overlap_speedup']}x)"
         if "schedule_speedup" in rec:

@@ -517,6 +517,31 @@ _define("direct_actor_delta_max", 64,
         "Buffered ACTOR_INFLIGHT_DELTA entries that force an "
         "immediate flush (bounds frame size and how much mirror "
         "state a caller crash can lose).")
+_define("llm_stream", True,
+        "LLM serving token transport (serve/llm): 1 streams tokens "
+        "over a peer-dialed push connection to the engine replica "
+        "(r18-style direct plane — the head never sees a token "
+        "frame); 0 falls back to the polled next_tokens actor-call "
+        "path through the ordinary request plane.")
+_define("llm_page_size", 16,
+        "KV-cache page size in token positions. Every sequence's "
+        "cache occupancy is a whole number of pages; smaller pages "
+        "waste less on short tails but grow the page tables.")
+_define("llm_max_batch", 8,
+        "Continuous-batching decode width per engine replica: the "
+        "step loop decodes up to this many in-flight sequences per "
+        "iteration (the decode kernel is compiled once at this "
+        "padded width).")
+_define("llm_step_delay_s", 0.0,
+        "Debug/chaos pacing: sleep this long between engine "
+        "iterations. Stretches generations so fault-injection tests "
+        "can land a kill or partition mid-stream; keep 0 in "
+        "production.")
+_define("llm_stream_wait_s", 0.5,
+        "Polled token fallback (llm_stream=0): how long next_tokens "
+        "parks server-side waiting for fresh tokens before returning "
+        "an empty slice — converts client busy-polling into bounded "
+        "server-side waits.")
 _define("scheduler_locality", True,
         "Locality-aware node selection: prefer placing a task on a "
         "feasible node already holding the most argument bytes "

@@ -109,17 +109,24 @@ class PendingDirectCalls:
 
 
 def dial_cached(cache: dict, lock, addr: tuple,
-                poller=None) -> Optional[protocol.Connection]:
+                poller=None, handler=None,
+                on_close=None) -> Optional[protocol.Connection]:
     """Shared endpoint-connection cache (driver and worker callers):
     return the live cached connection for ``addr`` or dial a fresh
     one; a concurrent dial keeps the winner already in the cache and
-    closes the loser. None when the endpoint refuses."""
+    closes the loser. None when the endpoint refuses.
+
+    ``handler``/``on_close`` customize the dialed connection for
+    planes that receive server-PUSHED frames on it (the serve/llm
+    token stream) — the default drops unsolicited frames, which is
+    right for the call/reply direct plane."""
     with lock:
         c = cache.get(addr)
         if c is not None and not c.closed:
             return c
     try:
-        c = protocol.connect(addr, lambda conn, m: None,
+        c = protocol.connect(addr, handler or (lambda conn, m: None),
+                             on_close=on_close,
                              name=f"direct-{addr[0]}:{addr[1]}",
                              poller=poller)
     except OSError:

@@ -188,8 +188,53 @@ class _RuntimeMetrics:
             "drops", ("counter",))
 
 
+class _ServingMetrics:
+    """Serving-plane series (r19 LLM engine): registered lazily like
+    the runtime set, but only in processes that actually serve —
+    importing the engine in a process that never generates registers
+    nothing."""
+
+    def __init__(self):
+        from ray_tpu.util.metrics import (Counter, DEFAULT_REGISTRY,
+                                          Histogram)
+        reg = DEFAULT_REGISTRY
+        # Token-level latencies live well under the default 1 ms …
+        # 60 s task boundaries' useful range, so give TTFT/TPOT their
+        # own sub-millisecond-to-seconds ladder.
+        bounds = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                  0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0]
+        self.ttft = Histogram(
+            "ray_tpu_llm_ttft_s",
+            "LLM time-to-first-token: submit to first emitted token "
+            "(engine-side, includes queue wait + prefill)",
+            boundaries=bounds, registry=reg)
+        self.tpot = Histogram(
+            "ray_tpu_llm_tpot_s",
+            "LLM time-per-output-token: inter-token gap during decode",
+            boundaries=bounds, registry=reg)
+        self.tokens = Counter(
+            "ray_tpu_llm_tokens",
+            "LLM tokens emitted by this engine replica", registry=reg)
+
+
 _mx: Optional[_RuntimeMetrics] = None
 _mx_lock = threading.Lock()
+_sv: Optional[_ServingMetrics] = None
+
+
+def serving_metrics() -> Optional[dict]:
+    """TTFT/TPOT histograms + token counter for the LLM engine, or
+    None while the plane is disabled (callers skip their observes)."""
+    if not enabled():
+        return None
+    global _sv
+    m = _sv
+    if m is None:
+        with _mx_lock:
+            m = _sv
+            if m is None:
+                _sv = m = _ServingMetrics()
+    return {"ttft": m.ttft, "tpot": m.tpot, "tokens": m.tokens}
 
 
 def _metrics() -> _RuntimeMetrics:

@@ -8,4 +8,7 @@ and scanned (`lax.scan`) so compile time is O(1) in depth; remat is a
 config switch.
 """
 from ray_tpu.models.config import TransformerConfig  # noqa: F401
+from ray_tpu.models.decode import (cache_page_bytes,  # noqa: F401
+                                   decode_step,
+                                   init_paged_cache, prefill)
 from ray_tpu.models.transformer import Transformer  # noqa: F401

@@ -1,0 +1,210 @@
+"""Incremental decoding for the ray_tpu Transformer: paged KV cache.
+
+Serving needs two forwards the training graph never runs: a *prefill*
+that processes a whole prompt once while writing every layer's K/V
+into cache pages, and a *decode step* that advances a batch of
+sequences by one token each against their cached context. Both mirror
+`Transformer._layer` exactly (rms_norm / GQA / RoPE / SwiGLU on the
+same ops) so prefill+decode logits agree with `Transformer.apply` to
+float tolerance — tests pin that equivalence.
+
+The cache is paged (vLLM-style): per layer, `(num_pages, page_size,
+kv_heads, head_dim)` arrays, and a sequence owns an arbitrary set of
+pages listed in its page table. Paging is what makes continuous
+batching viable — a finished sequence returns its pages to the pool
+immediately instead of stranding a max-length slab.
+
+Layout note: pages are stacked on a leading layers axis, matching the
+stacked/scanned parameter layout. Prefill scans the layer body (one
+compile regardless of depth); the decode step unrolls a Python loop
+over layers — at serving depths that compile cost is paid once per
+(batch, pages) shape and the unrolled body lets XLA alias the per-layer
+cache updates in place.
+
+Out-of-range page writes use `num_pages` as the drop sentinel: scatter
+mode="drop" discards them, which is how padded prefill tails and
+inactive decode rows stay out of the cache without branching.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rope_cached, rope_cos_sin
+
+Params = Dict[str, Any]
+KVCache = Dict[str, jax.Array]
+
+
+def init_paged_cache(config, num_pages: int, page_size: int,
+                     dtype=None) -> KVCache:
+    """Zeroed paged cache: k/v each (layers, pages, page, kv, hd)."""
+    if config.moe_num_experts:
+        raise NotImplementedError(
+            "paged decoding supports dense FFN layers only")
+    dt = dtype or config.activation_dtype
+    shape = (config.n_layers, num_pages, page_size,
+             config.kv_heads, config.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def cache_page_bytes(config, page_size: int, tp_shards: int = 1,
+                     dtype=None) -> int:
+    """Bytes one page costs per shard (k+v, all layers). The engine
+    sizes its pool off this: kv heads split across tp shards, so a
+    bigger mesh affords more pages for the same per-chip budget."""
+    dt = jnp.dtype(dtype or config.activation_dtype)
+    kv_local = max(1, config.kv_heads // max(1, tp_shards))
+    return (2 * config.n_layers * page_size * kv_local
+            * config.head_dim * dt.itemsize)
+
+
+def _qkv(config, layer: Params, h):
+    ad = config.activation_dtype
+    b, s, _ = h.shape
+    hd = config.head_dim
+    q = (h @ layer["wq"].astype(ad)).reshape(b, s, config.n_heads, hd)
+    k = (h @ layer["wk"].astype(ad)).reshape(b, s, config.kv_heads, hd)
+    v = (h @ layer["wv"].astype(ad)).reshape(b, s, config.kv_heads, hd)
+    return q, k, v
+
+
+def _mlp(config, layer: Params, x):
+    ad = config.activation_dtype
+    h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    gate = jax.nn.silu(h @ layer["gate"].astype(ad))
+    up = h @ layer["up"].astype(ad)
+    return x + (gate * up) @ layer["down"].astype(ad)
+
+
+def prefill(model, params: Params, tokens: jax.Array, true_len,
+            page_table: jax.Array, cache: KVCache,
+            page_size: int) -> Tuple[jax.Array, KVCache]:
+    """Process one padded prompt, writing K/V into the cache pages.
+
+    tokens: (s_pad,) int32, garbage past true_len (the causal mask
+    keeps the tail from contaminating positions < true_len).
+    true_len: scalar int32, actual prompt length.
+    page_table: (max_pages,) int32 page ids; entries past the prompt's
+    pages may be anything (writes there are dropped).
+
+    Returns (last-position logits (vocab,) f32, updated cache).
+    """
+    c = model.config
+    ad = c.activation_dtype
+    num_pages = cache["k"].shape[1]
+    s = tokens.shape[0]
+    toks = tokens[None]                                   # (1, s)
+    positions = jnp.arange(s)[None]
+    x = model._embed_lookup(params["embed"].astype(ad), toks)
+    rope = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    cos, sin = rope
+
+    def body(x, layer):
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q, k, v = _qkv(c, layer, h)
+        q = apply_rope_cached(q, cos, sin)
+        k = apply_rope_cached(k, cos, sin)
+        qt, kt, vt = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+        attn = flash_attention(qt, kt, vt, causal=True,
+                               block_q=c.attn_block_q,
+                               block_k=c.attn_block_k)
+        attn = attn.transpose(0, 2, 1, 3).reshape(
+            1, s, c.n_heads * c.head_dim)
+        x = x + attn @ layer["wo"].astype(ad)
+        x = _mlp(c, layer, x)
+        return x, (k[0], v[0])                     # (s, kv, hd) each
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    last = jnp.take(x[0], true_len - 1, axis=0)
+    logits = (last @ model._head(params).astype(ad)).astype(jnp.float32)
+
+    pos = jnp.arange(s)
+    page_ids = jnp.take(page_table, pos // page_size, mode="clip")
+    # positions past the prompt scatter to the drop sentinel
+    page_ids = jnp.where(pos < true_len, page_ids, num_pages)
+    slots = pos % page_size
+    cache = {
+        "k": cache["k"].at[:, page_ids, slots].set(
+            ks.astype(cache["k"].dtype), mode="drop"),
+        "v": cache["v"].at[:, page_ids, slots].set(
+            vs.astype(cache["v"].dtype), mode="drop"),
+    }
+    return logits, cache
+
+
+def decode_step(model, params: Params, cache: KVCache,
+                tokens: jax.Array, positions: jax.Array,
+                page_tables: jax.Array, active: jax.Array,
+                page_size: int) -> Tuple[jax.Array, KVCache]:
+    """Advance a padded batch by one token each.
+
+    tokens: (B,) int32 current input token per row.
+    positions: (B,) int32 absolute position the token occupies.
+    page_tables: (B, max_pages) int32, -1 for unassigned slots.
+    active: (B,) bool — inactive (padding) rows neither write cache
+    nor produce meaningful logits.
+
+    Returns (logits (B, vocab) f32, updated cache).
+    """
+    c = model.config
+    ad = c.activation_dtype
+    hd = c.head_dim
+    ck, cv = cache["k"], cache["v"]
+    num_pages = ck.shape[1]
+    B = tokens.shape[0]
+    max_pages = page_tables.shape[1]
+    span = max_pages * page_size
+
+    x = model._embed_lookup(params["embed"].astype(ad),
+                            tokens[:, None])               # (B, 1, e)
+    cos, sin = rope_cos_sin(positions[:, None], hd, c.rope_theta)
+
+    my_page = jnp.take_along_axis(
+        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    wr_page = jnp.where(active & (my_page >= 0), my_page, num_pages)
+    wr_slot = positions % page_size
+    # context mask: cache slot j is visible iff j <= position and its
+    # page is assigned (own-position k/v is written before the read)
+    flat = jnp.arange(span)
+    assigned = jnp.repeat(page_tables >= 0, page_size, axis=1)
+    mask = (flat[None, :] <= positions[:, None]) & assigned
+    gather_pt = jnp.clip(page_tables, 0, num_pages - 1)
+    groups = c.n_heads // c.kv_heads
+    scale = 1.0 / (hd ** 0.5)
+
+    layers = params["layers"]
+    for i in range(c.n_layers):
+        layer = jax.tree_util.tree_map(lambda a: a[i], layers)
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q, k, v = _qkv(c, layer, h)                  # (B, 1, heads, hd)
+        q = apply_rope_cached(q, cos, sin)
+        k = apply_rope_cached(k, cos, sin)
+        ck = ck.at[i, wr_page, wr_slot].set(
+            k[:, 0].astype(ck.dtype), mode="drop")
+        cv = cv.at[i, wr_page, wr_slot].set(
+            v[:, 0].astype(cv.dtype), mode="drop")
+        keys = ck[i][gather_pt].reshape(B, span, c.kv_heads, hd)
+        vals = cv[i][gather_pt].reshape(B, span, c.kv_heads, hd)
+        qg = q[:, 0].reshape(B, c.kv_heads, groups, hd)
+        scores = jnp.einsum(
+            "bkgd,bskd->bkgs", qg.astype(jnp.float32),
+            keys.astype(jnp.float32)) * scale
+        scores = jnp.where(mask[:, None, None, :], scores,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bkgs,bskd->bkgd", probs,
+                         vals.astype(jnp.float32)).astype(ad)
+        out = out.reshape(B, 1, c.n_heads * hd)
+        x = x + out @ layer["wo"].astype(ad)
+        x = _mlp(c, layer, x)
+
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = (x[:, 0] @ model._head(params).astype(ad))
+    return logits.astype(jnp.float32), {"k": ck, "v": cv}

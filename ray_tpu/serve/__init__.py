@@ -55,6 +55,84 @@ def _resolve_bound(value, controller_name: str):
     return value
 
 
+class _StreamState:
+    """A parked generator with a producer thread filling a bounded
+    buffer. Decouples production from consumption so `next_chunk` can
+    return whatever is ready (possibly nothing) instead of blocking
+    the replica's request slot inside `next(gen)` until a full batch
+    materializes — the consumer decides how to pace a dry stream."""
+
+    _BUF_CAP = 256
+
+    def __init__(self, gen):
+        self._gen = gen
+        self._buf: List[Any] = []
+        self._cond = threading.Condition()
+        self._done = False
+        self._exc: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._produce,
+                                        daemon=True,
+                                        name="serve-stream-producer")
+        self._thread.start()
+
+    def _produce(self) -> None:
+        try:
+            for item in self._gen:
+                with self._cond:
+                    while (len(self._buf) >= self._BUF_CAP
+                           and not self._closed):
+                        self._cond.wait(0.1)
+                    if self._closed:
+                        return
+                    self._buf.append(item)
+                    self._cond.notify_all()
+        except BaseException as e:        # surfaced on next pull
+            with self._cond:
+                self._exc = e
+        finally:
+            with self._cond:
+                self._done = True
+                self._cond.notify_all()
+
+    def pull(self, n: int, wait_s: Optional[float]) -> List[Any]:
+        """Up to n buffered chunks. wait_s=None: legacy blocking pull
+        (park until n chunks or the generator ends); else wait at most
+        wait_s for the FIRST chunk and return what's there — an empty
+        list means "dry, poll again", never end-of-stream (the
+        sentinel says that)."""
+        with self._cond:
+            if wait_s is None:
+                while len(self._buf) < n and not self._done:
+                    self._cond.wait()
+            elif not self._buf and not self._done:
+                self._cond.wait(wait_s)
+            out = self._buf[:n]
+            del self._buf[:len(out)]
+            if self._exc is not None and not out and not self._buf:
+                exc, self._exc = self._exc, None
+                raise exc
+            if (self._done and self._exc is None and not self._buf
+                    and len(out) < n):
+                out.append(_STREAM_END)
+            self._cond.notify_all()
+            return out
+
+    @property
+    def finished(self) -> bool:
+        with self._cond:
+            return self._done and not self._buf
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        try:
+            self._gen.close()
+        except BaseException:
+            pass
+
+
 class _Replica:
     """Actor wrapping one instance of the user's deployment class.
 
@@ -105,7 +183,18 @@ class _Replica:
                 with self._lock:
                     self._sweep_streams()
                     ongoing = self._ongoing + len(self._streams)
-                controller.report_stats.remote(deployment, rid, ongoing)
+                # deployment-defined extras ride the existing report
+                # (r11 signal path): e.g. the LLM engine's queue-wait
+                # p95 reaches the autoscaler with zero extra RPCs
+                extra = None
+                hook = getattr(self._obj, "__serve_stats__", None)
+                if hook is not None:
+                    try:
+                        extra = hook()
+                    except BaseException:
+                        extra = None
+                controller.report_stats.remote(deployment, rid, ongoing,
+                                               extra)
             except BaseException:
                 controller = None
 
@@ -146,16 +235,20 @@ class _Replica:
                 sid = uuid.uuid4().hex[:12]
                 with self._lock:
                     self._sweep_streams()
-                    self._streams[sid] = (result, time.monotonic())
+                    self._streams[sid] = (_StreamState(result),
+                                          time.monotonic())
                 return ("__stream__", sid)
             return result
         finally:
             with self._lock:
                 self._ongoing -= 1
 
-    def next_chunk(self, sid: str, n: int = 1):
-        """Pull up to n chunks from a parked stream; the sentinel tuple
-        terminates (and retires) it."""
+    def next_chunk(self, sid: str, n: int = 1,
+                   wait_s: Optional[float] = None):
+        """Pull up to n buffered chunks from a parked stream; the
+        sentinel tuple terminates (and retires) it. With `wait_s`, a
+        dry stream returns [] after at most that long instead of
+        parking the request slot (the adaptive client backs off)."""
         with self._lock:
             entry = self._streams.get(sid)
         if entry is None:
@@ -163,23 +256,20 @@ class _Replica:
             # truncation indistinguishable from completion
             raise RuntimeError(
                 f"stream {sid!r} expired or unknown on this replica")
-        gen, _ = entry
-        out = []
-        for _i in range(n):
-            try:
-                out.append(next(gen))
-            except StopIteration:
-                out.append(_STREAM_END)
-                with self._lock:
-                    self._streams.pop(sid, None)
-                return out
-            except BaseException:
-                with self._lock:
-                    self._streams.pop(sid, None)
-                raise
+        state, _ = entry
+        try:
+            out = state.pull(n, wait_s)
+        except BaseException:
+            with self._lock:
+                self._streams.pop(sid, None)
+            raise
+        if out and isinstance(out[-1], tuple) and out[-1] == _STREAM_END:
+            with self._lock:
+                self._streams.pop(sid, None)
+            return out
         with self._lock:
             if sid in self._streams:
-                self._streams[sid] = (gen, time.monotonic())
+                self._streams[sid] = (state, time.monotonic())
         return out
 
     def _sweep_streams(self) -> None:     # caller holds _lock
@@ -187,7 +277,9 @@ class _Replica:
         dead = [s for s, (_, t) in self._streams.items()
                 if now - t > _STREAM_IDLE_TTL_S]
         for s in dead:
-            self._streams.pop(s, None)
+            entry = self._streams.pop(s, None)
+            if entry is not None:
+                entry[0].close()
 
 
 @dataclasses.dataclass
@@ -201,6 +293,11 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 2.0
     downscale_delay_s: float = 10.0
+    # queue-latency scale-up (r11 signal): when any replica reports a
+    # queue_wait_p95 (via __serve_stats__) above this, desire one more
+    # replica than we have, regardless of the ongoing-count ratio.
+    # 0 disables.
+    target_queue_latency_s: float = 0.0
 
     def clamp(self, n: int) -> int:
         return max(self.min_replicas, min(self.max_replicas, n))
@@ -243,6 +340,8 @@ class ServeController:
         self._replicas: Dict[str, List[Any]] = {}
         # (name, replica_id) -> (ongoing, reported_monotonic)
         self._reports: Dict[tuple, tuple] = {}
+        # (name, replica_id) -> deployment-defined extra stats dict
+        self._extra_reports: Dict[tuple, dict] = {}
         # downscale victims draining in-flight requests:
         # name -> [(replica_id, handle, deadline_monotonic), ...]
         self._draining: Dict[str, List[Any]] = {}
@@ -273,11 +372,14 @@ class ServeController:
         self._reconcile_once()
 
     def report_stats(self, name: str, replica_id: str,
-                     ongoing: int) -> None:
-        """Replica-pushed ongoing count; doubles as liveness."""
+                     ongoing: int, extra: Optional[dict] = None) -> None:
+        """Replica-pushed ongoing count; doubles as liveness. `extra`
+        carries deployment-defined signals (queue_wait_p95, ...)."""
         with self._lock:
             self._reports[(name, replica_id)] = (int(ongoing),
                                                  time.monotonic())
+            if extra:
+                self._extra_reports[(name, replica_id)] = dict(extra)
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
@@ -287,6 +389,9 @@ class ServeController:
                          in self._draining.pop(name, [])]
             for key in [k for k in self._reports if k[0] == name]:
                 self._reports.pop(key, None)
+            for key in [k for k in self._extra_reports
+                        if k[0] == name]:
+                self._extra_reports.pop(key, None)
         for _rid, r, _t in replicas:
             try:
                 ray_tpu.kill(r)
@@ -442,6 +547,7 @@ class ServeController:
                         pass
                     with self._lock:
                         self._reports.pop((name, rid), None)
+                        self._extra_reports.pop((name, rid), None)
             with self._lock:
                 self._last_ongoing[name] = ongoing
             target = self._autoscale(name, info, len(live), ongoing)
@@ -513,6 +619,7 @@ class ServeController:
                     pass
                 with self._lock:
                     self._reports.pop((name, rid), None)
+                    self._extra_reports.pop((name, rid), None)
             else:
                 keep.append((rid, victim, deadline))
         with self._lock:
@@ -534,6 +641,17 @@ class ServeController:
             desired = ac.clamp(
                 math.ceil(ongoing / max(ac.target_ongoing_requests,
                                         1e-9)))
+            if ac.target_queue_latency_s > 0:
+                # r11 latency signal: queue_wait_p95 pushed by the
+                # replicas' __serve_stats__ hook. Latency over target
+                # means the ongoing-count ratio is lying (requests are
+                # cheap to hold but slow to admit — LLM engines), so
+                # desire one more replica than we have.
+                qlat = max((float(e.get("queue_wait_p95", 0.0) or 0.0)
+                            for k, e in self._extra_reports.items()
+                            if k[0] == name), default=0.0)
+                if qlat > ac.target_queue_latency_s:
+                    desired = max(desired, ac.clamp(current + 1))
             now = time.monotonic()
             if desired == target:
                 self._scale_intent.pop(name, None)
@@ -673,7 +791,15 @@ class DeploymentHandle:
                chunk_batch: int = 4, **kwargs):
         """Call a generator deployment method; yields its chunks as they
         are produced (reference streaming DeploymentResponseGenerator).
-        All pulls pin the replica that holds the generator state."""
+        All pulls pin the replica that holds the generator state.
+
+        Pull pacing is adaptive, not a fixed `chunk_batch` spin: each
+        pull asks for the current batch and parks server-side up to a
+        short wait. A full batch doubles the next ask (a fast producer
+        gets fewer round-trips); a dry pull backs off exponentially
+        (capped at 0.25 s) so a slow producer isn't hammered with empty
+        RPCs — and the first chunk still arrives the moment it exists,
+        never held for a full batch."""
         ref, replica = self._route(method_name, args, kwargs,
                                    wants_stream=True)
         first = ray_tpu.get(ref)
@@ -684,10 +810,19 @@ class DeploymentHandle:
             return
         sid = first[1]
         finished = False
+        batch = max(1, int(chunk_batch))
+        backoff = 0.0
         try:
             while True:
                 chunks = ray_tpu.get(
-                    replica.next_chunk.remote(sid, chunk_batch))
+                    replica.next_chunk.remote(sid, batch, wait_s=0.05))
+                if not chunks:
+                    backoff = min(0.25, (backoff or 0.01) * 2)
+                    time.sleep(backoff)
+                    continue
+                backoff = 0.0
+                if len(chunks) >= batch:
+                    batch = min(batch * 2, 64)
                 for c in chunks:
                     if isinstance(c, tuple) and c == _STREAM_END:
                         finished = True

@@ -149,6 +149,8 @@ def test_tracing_profile_and_annotate(tmp_path):
     assert found, f"no trace files under {logdir}"
 
 
+@pytest.mark.slow        # ~13s learning soak; BC clone gate keeps
+                         # offline training in tier-1
 def test_marwil_beats_noisy_dataset(tmp_path):
     """MARWIL's advantage weighting upweights the expert's actions in a
     MIXED dataset (50% random actions) where plain BC would clone the
@@ -199,6 +201,8 @@ def test_cql_learns_from_offline_data(tmp_path):
     assert ev["episode_return_mean"] >= 100, ev
 
 
+@pytest.mark.slow        # ~29s jit parity; the non-jit GAE path
+                         # stays in tier-1
 def test_learner_connector_gae_matches_in_jit(ray_cluster):
     """GAE as a learner connector (reference rllib/connectors/learner/
     general_advantage_estimation.py) produces the same learning signal

@@ -36,6 +36,9 @@ def test_forward_shapes_and_loss():
     assert abs(float(loss) - np.log(cfg.vocab_size)) < 1.0
 
 
+@pytest.mark.slow        # ~19s compile-bound; the dp/tp grad-step
+                         # and MoE capacity gates keep mesh-sharded
+                         # training in tier-1 (870s budget)
 def test_sharded_train_step_runs_and_matches_single():
     cfg = tiny()
     mesh = prepare_mesh(dp=2, fsdp=2, tp=2)
@@ -53,6 +56,8 @@ def test_sharded_train_step_runs_and_matches_single():
                                rtol=1e-4)
 
 
+@pytest.mark.slow        # ~27s end-to-end learning gate; forward
+                         # parity + loss shape stay in tier-1
 def test_grad_step_decreases_loss():
     cfg = tiny()
     mesh = prepare_mesh(dp=4, tp=2)
@@ -151,6 +156,8 @@ def test_presets_importable():
 
 
 # ------------------------------------------------------------------ moe
+@pytest.mark.slow        # ~27s compile-bound; MoE tier-1 coverage
+                         # rides test_moe_capacity_drops_tokens
 def test_moe_identical_experts_equals_dense():
     """With every expert initialised to the dense FFN weights and
     renormalised top-k routing, the MoE block IS the dense block
@@ -188,6 +195,8 @@ def test_moe_identical_experts_equals_dense():
                                atol=1e-5)
 
 
+@pytest.mark.slow        # ~47s ep-mesh parity sweep, the heaviest
+                         # passing tier-1 test in the suite
 def test_moe_ep_mesh_invariance_and_router_grads():
     """The same MoE model on an (dp,ep,tp) mesh must match single-device
     outputs; router gets gradient signal through the load-balance loss

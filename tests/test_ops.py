@@ -274,6 +274,8 @@ def test_ring_sharded_gqa_nondivisible_tp(h, kvh):
                                atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow        # ~15s; the grad-matches-autodiff twin
+                         # keeps cross-entropy in tier-1
 def test_softmax_cross_entropy():
     logits = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 32))
     labels = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 32)

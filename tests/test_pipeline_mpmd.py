@@ -181,6 +181,7 @@ def test_partition_layers_remainder_to_last_stage():
         split_stages({"w": jnp.zeros((7, 3))}, 2)
 
 
+@pytest.mark.slow        # ~19s compile-bound parity
 def test_spmd_pipeline_uneven_layer_fn_parity():
     """pipeline_apply/pipeline_grads_1f1b accept L % S != 0 via the
     masked per-layer path: outputs, loss AND grads match the sequential
@@ -314,6 +315,8 @@ def _sequential_sgd(params, stage_fn, loss_fn, X, T, M, lr):
     return losses, p
 
 
+@pytest.mark.slow        # ~29s; the dag-stage-death and channel
+                         # tests keep MPMD wiring in tier-1
 def test_mpmd_pipeline_2stage_1f1b_parity(ray_cluster):
     """Fast tier-1 e2e: JaxTrainer pipeline_stages=2 over shm channels
     matches the sequential full-stack trajectory — losses AND final
@@ -344,6 +347,7 @@ def test_mpmd_pipeline_2stage_1f1b_parity(ray_cluster):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow        # ~21s schedule parity sweep
 def test_mpmd_gpipe_schedule_parity_in_threads():
     """GPipe fallback schedule, hermetic: the stage loops run in two
     THREADS of this process over shm ring channels (no actor spawns —

@@ -265,6 +265,7 @@ def _toy_batch(T=16, N=8, D=4, A=2, seed=0):
     }
 
 
+@pytest.mark.slow        # ~26s dp-mesh parity, compile-bound
 def test_learner_dp_mesh_parity_with_single_device():
     """num_devices=2 shards the env axis over a dp mesh; XLA's psum must
     reproduce the single-device update exactly (the real version of the
@@ -452,6 +453,8 @@ def test_env_runner_continuous_pendulum():
     runner.stop()
 
 
+@pytest.mark.slow        # ~17s learning soak; the discrete PPO
+                         # update gate stays in tier-1
 def test_ppo_learner_continuous_update_improves():
     """PPO update on a continuous-action batch improves its objective
     (mirrors the discrete fixed-batch test)."""
@@ -524,6 +527,8 @@ def test_dqn_cartpole_learning_gate(fresh_cluster):
 
 
 # --------------------------------------------------------------- SAC
+@pytest.mark.slow        # ~31s; DQN/IMPALA update gates keep the
+                         # learner-update path in tier-1
 def test_sac_update_moves_critic_and_alpha():
     """One SAC update step: critic loss finite, alpha autotunes, target
     nets move by polyak tau toward the online critics."""
@@ -786,6 +791,7 @@ def test_c51_distributional_dqn_learning_gate(fresh_cluster):
     assert late > early + 8, (early, late)
 
 
+@pytest.mark.slow        # ~30s exploration soak
 def test_noisy_net_exploration_and_updates(fresh_cluster):
     """NoisyNet: factorized parameter noise IS the exploration —
     different noise samples give different greedy actions with no

@@ -32,7 +32,8 @@ if _REPO not in sys.path:          # standalone: python tools/chaos_soak.py
     sys.path.insert(0, _REPO)
 
 SCENARIOS = ("kill", "partition", "blip", "actor_kill",
-             "actor_partition")
+             "actor_partition", "llm_replica_kill",
+             "llm_replica_partition")
 
 
 def _wait(pred, timeout=30.0, step=0.05):
@@ -207,6 +208,144 @@ def run_actor_scenario(rt, agents, scenario: str, seed: int = 0,
     return report
 
 
+def run_llm_scenario(rt, agents, scenario: str, seed: int = 0,
+                     requests: int = 6, max_tokens: int = 32) -> dict:
+    """r19 LLM serving gates: kill or partition a replica group
+    MID-GENERATION with concurrent streams in flight. Every accepted
+    request must complete on a survivor or error exactly once — and
+    because decode is greedy-deterministic, a completed stream must
+    equal the tokens an undisturbed engine emits for the same prompt:
+    any duplicated, lost, or interleaved zombie token breaks equality.
+    """
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve as _serve
+    from ray_tpu.serve import llm
+    from ray_tpu.serve.llm.stream import STREAM_STATS
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..", "tests"))
+    import chaos
+
+    kind = scenario.split("_")[-1]            # kill | partition
+    tag = f"soak_{scenario}_{seed}"
+    # controller pinned to the head BEFORE agents join: the chaos
+    # target must never host the serve control plane
+    ray_tpu.remote(max_concurrency=16, resources={"head": 0.01})(
+        _serve.ServeController).options(
+            name=_serve._CONTROLLER_NAME, get_if_exists=True).remote()
+    # pace the step loop so generations outlive fault detection (the
+    # agents — and their workers — inherit this env at spawn)
+    os.environ["RAY_TPU_LLM_STEP_DELAY_S"] = "0.08"
+    # one replica per agent: each agent carries exactly one tag slot
+    nids = [_join_agent(rt, agents, {tag: 1.0}) for _ in range(2)]
+    inc0 = {n: rt.controller.node_incarnation(n) for n in nids}
+
+    t0 = time.time()
+    handle = llm.serve_llm(
+        name=f"llm_{scenario}_{seed}", model="tiny", num_replicas=2,
+        num_pages=64, page_size=8, max_batch=8,
+        ray_actor_options={"resources": {tag: 1.0}})
+    # wait for both replicas to land on DISTINCT agents: the fault
+    # must leave a live survivor, or failover has nowhere to go
+    def _spread():
+        reps = ray_tpu.get(
+            handle._controller.get_replicas.remote(handle._name))
+        recs = [rt.controller.get_actor(r._actor_id) for r in reps]
+        nodes = {rec.node_id for rec in recs if rec is not None}
+        return len(recs) == 2 and len(nodes) == 2
+    assert _wait(_spread, 60), "replicas did not spread across agents"
+    prompts = [[seed % 251 + 1, i + 1, 2 * i + 3, 7]
+               for i in range(requests)]
+    # undisturbed reference streams, one per prompt, BEFORE the fault
+    refs = {i: handle.generate(p, max_tokens=max_tokens,
+                               timeout_s=60).tokens()
+            for i, p in enumerate(prompts)}
+
+    z0 = STREAM_STATS["zombie_dropped"]
+    streams = [handle.generate(p, max_tokens=max_tokens, timeout_s=8)
+               for p in prompts]
+    # let every stream produce at least one token so the fault lands
+    # mid-generation, not pre-admission
+    for s in streams:
+        next(iter(s))
+    victim_aid = streams[0]._replica._actor_id
+    rec = rt.controller.get_actor(victim_aid)
+    victim_nid = rec.node_id
+    if kind == "kill":
+        chaos.drop_worker(rt, victim_nid, rec.worker_id)
+    else:
+        # the token stream is a peer-dialed socket, not the head<->
+        # agent wire: tag it with the victim node so the protocol-
+        # level partition parks its frames too (a real partition cuts
+        # the whole node, not just the control plane)
+        from ray_tpu.serve.llm.stream import stream_client
+        sc = stream_client()
+        for s in streams:
+            ad = getattr(s, "_stream_addr", None)
+            if ad is not None:
+                conn = sc._conns.get((ad[0], int(ad[1])))
+                if conn is not None:
+                    rc = rt.controller.get_actor(s._replica._actor_id)
+                    conn.meta["chaos_peer"] = rc.node_id
+        chaos.partition(rt, victim_nid)
+        assert _wait(lambda: not rt.cluster.get_node(victim_nid).alive,
+                     20), "partitioned agent not declared dead"
+        time.sleep(0.3)
+        chaos.heal(rt, victim_nid)
+        assert _wait(lambda: rt.cluster.get_node(victim_nid).alive, 30), \
+            "fenced agent did not re-register"
+
+    done, errors, hangs, mismatches, failovers = 0, 0, 0, 0, 0
+    lock = threading.Lock()
+
+    def consume(i, s):
+        nonlocal done, errors, hangs, mismatches, failovers
+        try:
+            toks = s.tokens()
+            with lock:
+                done += 1
+                if list(toks) != list(refs[i]):
+                    mismatches += 1
+                if s._attempt > 0:
+                    failovers += 1
+        except RuntimeError:
+            with lock:
+                errors += 1
+        except BaseException:
+            with lock:
+                hangs += 1
+
+    threads = [threading.Thread(target=consume, args=(i, s))
+               for i, s in enumerate(streams)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    hangs += sum(1 for t in threads if t.is_alive())
+
+    report = {
+        "scenario": scenario, "seed": seed, "requests": requests,
+        "wall_s": round(time.time() - t0, 2),
+        "done": done, "errors": errors, "hangs": hangs,
+        "mismatches": mismatches, "failovers": failovers,
+        "zombie_dropped": STREAM_STATS["zombie_dropped"] - z0,
+    }
+    ok = (hangs == 0 and mismatches == 0
+          and done + errors == requests
+          and errors == 0            # a survivor existed: all complete
+          and failovers >= 1)        # the fault actually hit a stream
+    if kind == "partition":
+        ok = ok and rt.controller.node_incarnation(victim_nid) \
+            > inc0[victim_nid]
+    report["ok"] = ok
+    try:
+        _serve.shutdown()
+    except BaseException:
+        pass
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="chaos_soak")
     p.add_argument("--scenarios", default=",".join(SCENARIOS))
@@ -232,7 +371,10 @@ def main(argv=None) -> int:
         try:
             for scenario in args.scenarios.split(","):
                 scenario = scenario.strip()
-                if scenario.startswith("actor_"):
+                if scenario.startswith("llm_"):
+                    rep = run_llm_scenario(rt, agents, scenario,
+                                           seed=seed)
+                elif scenario.startswith("actor_"):
                     rep = run_actor_scenario(rt, agents, scenario,
                                              seed=seed)
                 else:

@@ -57,6 +57,12 @@ LABELS = [
      "(RAY_TPU_DIRECT_ACTOR=0)"),
     ("actor_sync_direct",
      "sync actor calls, worker caller, direct plane (r18)"),
+    ("serve_llm_polled",
+     "LLM serving open-loop, 2 replica groups, polled token plane "
+     "(RAY_TPU_LLM_STREAM=0)"),
+    ("serve_llm_stream",
+     "LLM serving open-loop, 2 replica groups, direct-stream tokens "
+     "(r19)"),
     ("tasks_sync_per_s", "tasks, sync round-trip"),
     ("tasks_batch_per_s", "tasks, batched"),
     ("actor_calls_sync_per_s", "actor calls, sync"),
@@ -116,6 +122,20 @@ def _fmt_result(rec: dict) -> str:
             # multiple of the same-session 5k-delegated floor
             out += (f" ({rec['vs_delegated_floor']}x the 5k-delegated "
                     f"head-CPU floor)")
+        if "ttft_p50_ms" in rec:
+            # r19 serving columns: time-to-first-token (admission +
+            # prefill) and time-per-output-token (decode cadence)
+            out += (f" (ttft p50/p99 {rec['ttft_p50_ms']}/"
+                    f"{rec['ttft_p99_ms']} ms, tpot p50/p99 "
+                    f"{rec['tpot_p50_ms']}/{rec['tpot_p99_ms']} ms)")
+        if "head_frames_per_token" in rec:
+            # r19 acceptance counter: head socket frames per generated
+            # token net of the stream plane's own (~0 on the direct-
+            # stream arm — tokens ride peer-dialed connections)
+            out += (f" (head frames/tok "
+                    f"{rec['head_frames_per_token']})")
+        if "stream_speedup" in rec:
+            out += f" (stream speedup {rec['stream_speedup']}x)"
         if "p50_ms" in rec:
             # r18 latency columns: sync scenarios carry per-call
             # percentiles so a latency regression can't hide behind
@@ -202,12 +222,23 @@ def _fmt_bubble(rec: dict) -> str:
     return "—"
 
 
-def render_block(results: dict) -> str:
+def render_block(results: dict, keep: dict = None) -> str:
+    """`keep` maps scenario label -> previously rendered row: a
+    partial run (e.g. ``bench_core.py --serve-llm``) refreshes only
+    its own rows and the rest of the table survives verbatim."""
+    keep = keep or {}
     known = [k for k, _ in LABELS]
-    rows = [(label, results[key]) for key, label in LABELS
-            if key in results]
+    rows = []
+    for key, label in LABELS:
+        if key in results:
+            rows.append((label, results[key]))
+        elif label in keep:
+            rows.append((label, keep[label]))
     rows += [(key, rec) for key, rec in results.items()
              if key not in known]
+    rows += [(label, row) for label, row in keep.items()
+             if label not in [lb for lb in (dict(LABELS).values())]
+             and label not in [r[0] for r in rows]]
     lines = [BEGIN,
              "### Latest `bench_core.py` run (machine-generated)",
              "",
@@ -216,6 +247,9 @@ def render_block(results: dict) -> str:
              "| copies/byte serve · land | bubble |",
              "|---|---|---|---|---|---|---|"]
     for label, rec in rows:
+        if isinstance(rec, str):          # retained pre-rendered row
+            lines.append(rec)
+            continue
         lines.append(f"| {label} | {_fmt_result(rec)} | "
                      f"{_fmt_frames(rec)} | {_fmt_trace(rec)} | "
                      f"{_fmt_metrics(rec)} | {_fmt_copies(rec)} | "
@@ -224,13 +258,31 @@ def render_block(results: dict) -> str:
     return "\n".join(lines)
 
 
+def _existing_rows(text: str) -> dict:
+    """Parse scenario rows out of the current machine block so a
+    partial refresh keeps them."""
+    if BEGIN not in text or END not in text:
+        return {}
+    block = text.split(BEGIN, 1)[1].split(END, 1)[0]
+    rows = {}
+    for line in block.splitlines():
+        line = line.rstrip()
+        if not line.startswith("| ") or line.startswith("| Scenario"):
+            continue
+        if set(line) <= {"|", "-", " "}:
+            continue
+        label = line.split("|")[1].strip()
+        rows[label] = line
+    return rows
+
+
 def update_envelope(results: dict, path: str) -> None:
-    block = render_block(results)
     if os.path.exists(path):
         with open(path) as f:
             text = f.read()
     else:
         text = "# Scalability envelope\n"
+    block = render_block(results, keep=_existing_rows(text))
     if BEGIN in text and END in text:
         head, rest = text.split(BEGIN, 1)
         _, tail = rest.split(END, 1)

@@ -435,6 +435,125 @@ def _llm_serve_section(results: dict) -> None:
             _st["per_second"] / _pl["per_second"], 2)
 
 
+def _rl_bench(direct: bool, n_updates: int = 12) -> dict:
+    """Sebulba RL throughput (r20): 4 env-runner actors on one agent
+    act against 2 batched inference actors on another while the
+    driver learner consumes trajectory rings and publishes versioned
+    weights. per_second is aggregate environment steps/s consumed by
+    the learner; staleness p50/p95 is the policy-version lag of each
+    consumed shard (bounded by the ring depth by construction).
+
+    The A/B arm is the act() path: direct plane (env-runner workers
+    submit straight to the inference worker's socket) vs head-routed
+    (RAY_TPU_DIRECT_ACTOR=0: every act rides the head tables).
+    head_frames_per_call is the r18 actor-plane accounting —
+    head-routed sends + head-processed dones + endpoint resolves +
+    mirror delta frames, counters not timers — so the object-plane
+    weight-publish traffic (put + broadcast fanout) never bills the
+    act path; the direct arm must read ~0."""
+    import ray_tpu
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.cluster_utils import NodeAgentProcess
+    os.environ["RAY_TPU_DIRECT_ACTOR"] = "1" if direct else "0"
+    CONFIG.reload()
+    agents = []
+    tr = None
+    try:
+        rt = ray_tpu.init(num_cpus=0, resources={"head": 4.0})
+        from ray_tpu.rllib.sebulba import SebulbaConfig
+        agents = [NodeAgentProcess(num_cpus=4,
+                                   resources={"rl_infer": 10.0}),
+                  NodeAgentProcess(num_cpus=4,
+                                   resources={"rl_env": 10.0})]
+        deadline = time.time() + 60
+        while (time.time() < deadline
+               and len(rt.cluster.alive_nodes()) < 3):
+            time.sleep(0.1)
+        cfg = SebulbaConfig(
+            num_env_runners=4, num_inference_actors=2,
+            num_envs_per_runner=8, rollout_length=16,
+            inference_options={"num_cpus": 0,
+                               "resources": {"rl_infer": 1.0},
+                               "max_concurrency": 16},
+            runner_options={"num_cpus": 0,
+                            "resources": {"rl_env": 1.0}},
+            seed=0)
+        tr = cfg.build()
+        # warm: first shards pay env resets, actor spin-up, the
+        # env-runner workers' one-time endpoint resolves, and the
+        # adaptive mirror window's ramp to its steady-state width
+        for _ in range(8):
+            tr.learner.update_shard(tr._next_shard())
+            tr._publish()
+        keys = ("head_routed_sends", "head_actor_dones", "resolves",
+                "delta_frames")
+        i0 = sum(s["requests"] for s in ray_tpu.get(
+            [h.stats.remote() for h in tr._infer]))
+        s0 = {k: rt._direct_stats[k] for k in keys}
+        staleness = []
+        steps = 0
+        t0 = time.perf_counter()
+        for _ in range(n_updates):
+            shard = tr._next_shard()
+            m = tr.learner.update_shard(shard)
+            staleness.append(m["staleness"])
+            steps += int(shard["steps"])
+            tr._publish()
+        wall = time.perf_counter() - t0
+        d = {k: rt._direct_stats[k] - s0[k] for k in keys}
+        i1 = sum(s["requests"] for s in ray_tpu.get(
+            [h.stats.remote() for h in tr._infer]))
+        calls = max(1, i1 - i0)
+        head_frames = (d["head_routed_sends"] + d["head_actor_dones"]
+                       + d["resolves"] + d["delta_frames"])
+        staleness.sort()
+
+        def _pct(q):
+            return staleness[min(len(staleness) - 1,
+                                 int(len(staleness) * q))]
+
+        return {
+            "n": steps, "seconds": round(wall, 4),
+            "per_second": round(steps / wall, 1), "unit": "env-steps",
+            "updates": n_updates,
+            "infer_calls": calls,
+            "staleness_p50": _pct(0.50),
+            "staleness_p95": _pct(0.95),
+            "staleness_max": staleness[-1],
+            "seq_gaps": tr.learner.seq_gaps,
+            "head_frames_per_call": round(head_frames / calls, 3),
+            "head_frame_mix": d,
+        }
+    finally:
+        if tr is not None:
+            try:
+                tr.stop()
+            except BaseException:
+                pass
+        for ag in agents:
+            ag.terminate()
+        for ag in agents:
+            ag.wait(10)
+        import ray_tpu as _rt
+        _rt.shutdown()
+        os.environ.pop("RAY_TPU_DIRECT_ACTOR", None)
+        CONFIG.reload()
+
+
+def _rl_section(results: dict) -> None:
+    """Sebulba act-path A/B (r20). Acceptance: the direct arm's
+    head_frames_per_call reads ~0 (<= 0.1) while the head-routed arm
+    pays full actor-call frame costs, at no env-steps/s loss."""
+    _hd, _dr = _ab_pair(
+        results, "rl_sebulba_head",
+        lambda: _rl_bench(direct=False),
+        "rl_sebulba_direct",
+        lambda: _rl_bench(direct=True))
+    if _hd["per_second"]:
+        _dr["direct_speedup"] = round(
+            _dr["per_second"] / _hd["per_second"], 2)
+
+
 def _codec_bench() -> dict:
     """Codec-only cost: encode+decode µs for the hot frame shapes,
     native engine vs pure-Python protobuf (RAY_TPU_WIRE_NATIVE=0 —
@@ -1324,8 +1443,26 @@ def llm_main(as_json: bool = False) -> dict:
     return results
 
 
+def rl_main(as_json: bool = False) -> dict:
+    """Just the r20 Sebulba A/B — re-measures the RL act path in
+    isolation (the full suite takes tens of minutes)."""
+    results: dict = {}
+    _rl_section(results)
+    if as_json:
+        print(json.dumps(results))
+    else:
+        for name, r in results.items():
+            print(f"{name:24s} {r['per_second']:>10} {r['unit']}/s "
+                  f"(staleness p50/p95 {r['staleness_p50']}/"
+                  f"{r['staleness_p95']}, head frames/call "
+                  f"{r['head_frames_per_call']})")
+    return results
+
+
 if __name__ == "__main__":
     if "--serve-llm" in sys.argv:
         llm_main(as_json="--json" in sys.argv)
+    elif "--rl" in sys.argv:
+        rl_main(as_json="--json" in sys.argv)
     else:
         main(as_json="--json" in sys.argv)

@@ -517,6 +517,15 @@ _define("direct_actor_delta_max", 64,
         "Buffered ACTOR_INFLIGHT_DELTA entries that force an "
         "immediate flush (bounds frame size and how much mirror "
         "state a caller crash can lose).")
+_define("direct_actor_delta_delay_max_ms", 250.0,
+        "Ceiling for the ADAPTIVE delta window (r20): a caller whose "
+        "delta frames flush near-empty (a sparse caller, e.g. an RL "
+        "env-runner pacing tens of act()/s against env stepping) "
+        "doubles its collect window per flush up to this cap, so "
+        "mirror frames amortize by call count instead of by wall "
+        "clock; a near-full frame snaps the window back to "
+        "direct_actor_delta_delay_ms. Bounds both mirror lag and "
+        "crash-loss scope for slow callers.")
 _define("llm_stream", True,
         "LLM serving token transport (serve/llm): 1 streams tokens "
         "over a peer-dialed push connection to the engine replica "
@@ -542,6 +551,33 @@ _define("llm_stream_wait_s", 0.5,
         "parks server-side waiting for fresh tokens before returning "
         "an empty slice — converts client busy-polling into bounded "
         "server-side waits.")
+_define("rl_ring_depth", 2,
+        "Sebulba RL trajectory rings (rllib/sebulba): wire-channel "
+        "ring depth between each env-runner and the learner. The "
+        "depth is simultaneously the queue bound and the policy-"
+        "staleness bound — a runner blocks writing shard seq when "
+        "the learner has not acked seq - depth, so no consumed shard "
+        "can be more than depth+2 policy versions behind (producing "
+        "+ in-ring + consuming) per runner at publish interval 1.")
+_define("rl_infer_max_batch", 64,
+        "Sebulba inference actors: admission cap — at most this many "
+        "parked act() requests are coalesced into one stacked "
+        "forward pass per admission iteration.")
+_define("rl_infer_wait_ms", 2.0,
+        "Sebulba inference actors: admission window — after the "
+        "first act() request arrives, the step loop waits this long "
+        "for more callers to park before launching the batched "
+        "forward. 0 disables coalescing (one forward per wakeup).")
+_define("rl_step_delay_s", 0.0,
+        "Debug/chaos pacing: sleep this long per Sebulba inference "
+        "forward pass. Stretches rollouts so fault-injection tests "
+        "can land a kill or partition mid-stream; keep 0 in "
+        "production.")
+_define("rl_publish_interval", 1,
+        "Sebulba learner: publish refreshed weights to inference "
+        "actors every N updates (ray_tpu.put once + broadcast-tree "
+        "fanout + versioned set_weights). Larger values trade "
+        "staleness for publish bandwidth.")
 _define("scheduler_locality", True,
         "Locality-aware node selection: prefer placing a task on a "
         "feasible node already holding the most argument bytes "

@@ -48,6 +48,7 @@ transport is the existing control wire and the registry is our own.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 from collections import deque
@@ -186,6 +187,20 @@ class _RuntimeMetrics:
             "recovered/deaths/fenced node transitions, fenced frames "
             "dropped, fence notices sent, stale-attempt terminal "
             "drops", ("counter",))
+        self.channel = g(
+            "ray_tpu_channel",
+            "Wire-channel ring telemetry (r13/r20): tx/rx frame and "
+            "logical read/write counts, writer_block_ms (time writers "
+            "spent waiting on reader acks — ring pressure), "
+            "reader_wait_ms, plus live ring occupancy; the staleness "
+            "signal the Sebulba RL subsystem tunes against",
+            ("counter",))
+        self.rl = g(
+            "ray_tpu_rl",
+            "Sebulba RL counters (r20): env steps, trajectory shards "
+            "written/consumed, inference requests/forwards/batched "
+            "obs, weight publishes, learner version, staleness, "
+            "failovers", ("counter",))
 
 
 class _ServingMetrics:
@@ -320,6 +335,24 @@ def _builtin_sampler() -> None:
                              for k, v in OBJECT_PLANE_STATS.items()])
     m.shm_pool.set_many([({"counter": k.replace("pool_", "")}, v)
                          for k, v in SEGMENT_POOL.stats().items()])
+    # Optional planes: mirror only in processes that imported them
+    # (sys.modules guard — a scrape must not trigger heavy imports).
+    wc = sys.modules.get("ray_tpu.experimental.wire_channel")
+    if wc is not None:
+        st = wc.CH_STATS
+        rows = [({"counter": k}, v) for k, v in st.items()
+                if not k.endswith("_ns")]
+        rows += [({"counter": "writer_block_ms"},
+                  st["writer_block_ns"] / 1e6),
+                 ({"counter": "reader_wait_ms"},
+                  st["reader_wait_ns"] / 1e6)]
+        rows += [({"counter": k}, v)
+                 for k, v in wc.ring_stats().items()]
+        m.channel.set_many(rows)
+    sb = sys.modules.get("ray_tpu.rllib.sebulba.stats")
+    if sb is not None:
+        m.rl.set_many([({"counter": k}, v)
+                       for k, v in sb.RL_STATS.items()])
 
 
 def run_samplers() -> None:

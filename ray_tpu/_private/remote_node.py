@@ -45,6 +45,15 @@ class RemoteNodeHandle:
         self.avail = dict(resources)
         self._pending_demand: dict[str, float] = {}
         self._pending_shapes: list[dict[str, float]] = []
+        # Optimistic demand claims (r20): avail/_pending_demand only
+        # refresh on heartbeats, so two back-to-back submits both read
+        # the pre-claim snapshot and the hybrid pack phase lands them
+        # on the SAME node — fatal for an ActorSpec, which (unlike a
+        # TaskSpec) can never spill off a full queue. Each enqueue
+        # claims its need here until the agent's own books catch up;
+        # entries expire after a few beat periods, so a delta beat
+        # that never re-sends an unchanged key can't leak a claim.
+        self._optimistic: dict[str, tuple[dict, float]] = {}
         self._idle = True
         self._lock = threading.Lock()
         # Mirror of work routed to this agent, keyed by task_id /
@@ -178,6 +187,14 @@ class RemoteNodeHandle:
             eff = dict(self.avail)
             for k, v in self._pending_demand.items():
                 eff[k] = eff.get(k, 0.0) - v
+            now = time.monotonic()
+            for key in list(self._optimistic):
+                need, deadline = self._optimistic[key]
+                if deadline < now or key not in self._work:
+                    del self._optimistic[key]
+                    continue
+                for k, v in need.items():
+                    eff[k] = eff.get(k, 0.0) - v
             return eff
 
     def pending_shapes(self) -> list[dict[str, float]]:
@@ -219,9 +236,16 @@ class RemoteNodeHandle:
         MINOR >= 3 (negotiated by observation, like BatchFrame)."""
         return bool(_CFG.delegate) and self.conn.peer_speaks_delegate()
 
+    _OPTIMISTIC_TTL_S = 2.0          # = 4 agent heartbeat periods
+
     def enqueue(self, spec) -> None:
+        key = self._key(spec)
+        need = self.need_of(spec)
         with self._lock:
-            self._work[self._key(spec)] = (spec, False)
+            self._work[key] = (spec, False)
+            if any(need.values()):
+                self._optimistic[key] = (
+                    need, time.monotonic() + self._OPTIMISTIC_TTL_S)
             if self._wal is not None and isinstance(spec, TaskSpec):
                 # the spec itself rides the task-submit record; this
                 # marks WHERE it was routed (actor routing is derived

@@ -89,9 +89,22 @@ class WorkerContext(_context.BaseContext):
         for oid in object_ids:
             value, stored = self._get_one(oid, timeout)
             if stored.is_error:
+                self._note_actor_death(value)
                 raise value
             out.append(value)
         return out
+
+    def _note_actor_death(self, err) -> None:
+        """An error about to surface to the caller: when it carries an
+        ActorDiedError, invalidate the direct caller's endpoint cache
+        for that actor so a restarted incarnation is re-resolved on
+        the next call rather than NACK-discovered."""
+        if self._direct is None:
+            return
+        from ray_tpu.exceptions import ActorDiedError
+        cause = getattr(err, "cause", err)
+        if isinstance(cause, ActorDiedError) and cause.actor_id:
+            self._direct.on_actor_died(cause.actor_id)
 
     def _get_one(self, oid: str, timeout):
         # r18 direct plane: a return ref of a direct actor call

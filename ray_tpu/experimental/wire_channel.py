@@ -61,11 +61,30 @@ CH_STATS = {
     "rx_raw": 0,
     "rx_blob": 0,
     "landed_bytes": 0,    # raw bytes landed via the one-memcpy path
+    "writes": 0,          # logical messages published (all readers)
+    "reads": 0,           # logical messages consumed + acked
+    "writer_block_ns": 0,  # time writers spent inside wait_writable
+    "reader_wait_ns": 0,   # time readers spent parked for a message
 }
 
 # name -> _WireChannelServer living in THIS process (the writer side).
 _SERVERS: Dict[str, "_WireChannelServer"] = {}
 _SERVERS_LOCK = threading.Lock()
+
+
+def ring_stats() -> Dict[str, int]:
+    """Occupancy across every channel server in THIS process — the
+    scrape-time companion to CH_STATS (the metrics plane mirrors both
+    as ray_tpu_channel gauges)."""
+    with _SERVERS_LOCK:
+        servers = list(_SERVERS.values())
+    occ = mx = 0
+    for srv in servers:
+        o = srv.occupancy()
+        occ += o
+        mx = max(mx, o)
+    return {"rings": len(servers), "occupancy": occ,
+            "occupancy_max": mx}
 
 
 def _my_ip() -> str:
@@ -90,6 +109,7 @@ class _WireChannelServer:
         self._cv = threading.Condition()
         self._conns: Dict[int, protocol.Connection] = {}
         self._acked = [0] * n_readers
+        self._published = 0            # highest seq fully sent
         self._dead: set = set()        # reader indices whose conn died
         self._closing = False
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -155,26 +175,43 @@ class _WireChannelServer:
         connection died — the pipeline cannot proceed without it."""
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
+        t0 = time.perf_counter_ns()
+        try:
+            with self._cv:
+                while True:
+                    if self._closing:
+                        raise ChannelClosed(
+                            f"wire channel {self.name}: writer "
+                            f"endpoint shut down")
+                    if self._dead:
+                        raise ChannelClosed(
+                            f"wire channel {self.name}: reader(s) "
+                            f"{sorted(self._dead)} disconnected")
+                    if (len(self._conns) == self.n_readers
+                            and all(a >= seq - self.depth
+                                    for a in self._acked)):
+                        return [self._conns[i]
+                                for i in range(self.n_readers)]
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        raise ChannelTimeout(
+                            f"timed out waiting for wire-channel readers "
+                            f"({len(self._conns)}/{self.n_readers} attached, "
+                            f"acks {self._acked})")
+                    self._cv.wait(0.2 if remaining is None
+                                  else min(remaining, 0.2))
+        finally:
+            # Writer-blocked-on-ack time IS the ring-pressure signal
+            # (the staleness bound binding): surface it on /metrics.
+            CH_STATS["writer_block_ns"] += time.perf_counter_ns() - t0
+
+    def occupancy(self) -> int:
+        """Published-but-unacked messages for the laggiest reader —
+        how full the ring is (0..depth while flow control holds)."""
         with self._cv:
-            while True:
-                if self._dead:
-                    raise ChannelClosed(
-                        f"wire channel {self.name}: reader(s) "
-                        f"{sorted(self._dead)} disconnected")
-                if (len(self._conns) == self.n_readers
-                        and all(a >= seq - self.depth
-                                for a in self._acked)):
-                    return [self._conns[i]
-                            for i in range(self.n_readers)]
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
-                if remaining is not None and remaining <= 0:
-                    raise ChannelTimeout(
-                        f"timed out waiting for wire-channel readers "
-                        f"({len(self._conns)}/{self.n_readers} attached, "
-                        f"acks {self._acked})")
-                self._cv.wait(0.2 if remaining is None
-                              else min(remaining, 0.2))
+            floor = min(self._acked) if self._acked else 0
+            return max(0, self._published - floor)
 
     def live_conns(self) -> list:
         with self._cv:
@@ -305,6 +342,8 @@ class WireChannelWriter:
                       extra={"seq": seq, "transport": "wire"}):
             self._send(conns, value, error, seq)
         self._seq = seq
+        self._srv._published = seq
+        CH_STATS["writes"] += 1
 
     def write_bytes(self, data: bytes, *, error: bool = False,
                     timeout: Optional[float] = None) -> None:
@@ -379,20 +418,24 @@ class WireChannelReader:
     def _next(self, timeout: Optional[float]) -> dict:
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
-        with self._cv:
-            while True:
-                if self._queue:
-                    return self._queue.popleft()
-                if self._closed or self._dead:
-                    raise ChannelClosed(self.ch.name)
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
-                if remaining is not None and remaining <= 0:
-                    raise ChannelTimeout(
-                        f"timed out waiting for message on wire "
-                        f"channel {self.ch.label}")
-                self._cv.wait(0.2 if remaining is None
-                              else min(remaining, 0.2))
+        t0 = time.perf_counter_ns()
+        try:
+            with self._cv:
+                while True:
+                    if self._queue:
+                        return self._queue.popleft()
+                    if self._closed or self._dead:
+                        raise ChannelClosed(self.ch.name)
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        raise ChannelTimeout(
+                            f"timed out waiting for message on wire "
+                            f"channel {self.ch.label}")
+                    self._cv.wait(0.2 if remaining is None
+                                  else min(remaining, 0.2))
+        finally:
+            CH_STATS["reader_wait_ns"] += time.perf_counter_ns() - t0
 
     def _land_raw(self, msg: dict):
         """One-memcpy landing: the C envelope parser handed us a
@@ -432,6 +475,7 @@ class WireChannelReader:
                                  "seq": int(msg["seq"])})
             except protocol.ConnectionClosed:
                 pass               # writer gone: its flow control is moot
+            CH_STATS["reads"] += 1
         if RAW_KEY not in msg and msg.get("err"):
             # mirror the shm reader: error frames carry a pickled repr
             shown = value

@@ -15,6 +15,9 @@ from ray_tpu.rllib.core.learner import (LearnerGroup, PPOLearner,
 from ray_tpu.rllib.core.rl_module import ActorCriticModule, Categorical
 from ray_tpu.rllib.env.env_runner import EnvRunnerConfig, SingleAgentEnvRunner
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rllib.sebulba import (InferenceActor, Sebulba,
+                                   SebulbaConfig, SebulbaEnvRunner,
+                                   SebulbaLearner, SebulbaRunnerConfig)
 from ray_tpu.rllib.tune_adapter import tune_trainable
 
 __all__ = [
@@ -23,4 +26,6 @@ __all__ = [
     "ActorCriticModule", "Categorical", "SingleAgentEnvRunner",
     "EnvRunnerConfig", "EnvRunnerGroup", "FaultTolerantActorManager",
     "RemoteCallResults", "CallResult", "tune_trainable",
+    "InferenceActor", "SebulbaEnvRunner", "SebulbaRunnerConfig",
+    "SebulbaLearner", "Sebulba", "SebulbaConfig",
 ]

@@ -1,0 +1,19 @@
+"""Sebulba RL (r20): the Podracer actor/learner split on ray_tpu.
+
+Batched inference actors serve actions to vectorized env runners over
+the r18 direct call plane; trajectory shards ride r13 wire-channel
+rings (depth = queue bound = staleness bound) to a mesh-sharded
+V-trace learner; refreshed weights return via the r12 broadcast tree,
+versioned so staleness is measurable end to end. See PAPERS.md
+"Podracer architectures for scalable Reinforcement Learning".
+"""
+from ray_tpu.rllib.sebulba.env_runner import (SebulbaEnvRunner,
+                                              SebulbaRunnerConfig)
+from ray_tpu.rllib.sebulba.inference import InferenceActor
+from ray_tpu.rllib.sebulba.learner import SebulbaLearner
+from ray_tpu.rllib.sebulba.trainer import Sebulba, SebulbaConfig
+
+__all__ = [
+    "InferenceActor", "SebulbaEnvRunner", "SebulbaRunnerConfig",
+    "SebulbaLearner", "Sebulba", "SebulbaConfig",
+]

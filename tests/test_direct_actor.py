@@ -359,3 +359,115 @@ def test_inline_release_hook():
     assert d.take_inline("oid1") is None
     with d._lock:
         assert "oid1" not in d._oid_task
+
+
+def test_on_actor_died_invalidates_endpoint_cache():
+    """r20 regression: surfacing an ActorDiedError must drop the
+    cached endpoint AND the negative-resolve memo so a restarted actor
+    is re-resolved on the next call (not NACK-discovered), and clear
+    the sticky fallback only when no calls are in flight."""
+    from ray_tpu._private.direct_actor import WorkerDirectCaller
+
+    class _Conn:
+        def peer_speaks_direct_actor(self):
+            return False
+
+    class _Ctx:
+        conn = _Conn()
+
+    d = WorkerDirectCaller(_Ctx())
+    with d._lock:
+        d._endpoints["a1"] = {"host": "h", "port": 1}
+        d._neg["a1"] = time.monotonic() + 60.0   # backoff from a race
+        d._fallback.add("a1")
+    d.on_actor_died("a1")
+    with d._lock:
+        assert "a1" not in d._endpoints
+        assert "a1" not in d._neg                # next call re-resolves
+        assert "a1" not in d._fallback           # books empty: unstick
+    # with calls still pending the fail/NACK discipline owns the flag
+    with d._lock:
+        d._endpoints["a2"] = {"host": "h", "port": 2}
+        d._fallback.add("a2")
+        d._actor_pending["a2"] = 1
+    d.on_actor_died("a2")
+    with d._lock:
+        assert "a2" not in d._endpoints
+        assert "a2" in d._fallback               # sticky until drained
+
+
+def test_get_surfaces_actor_death_to_direct_caller():
+    """The worker get() path routes an ActorDiedError (raw or wrapped
+    in a TaskError cause chain) into on_actor_died."""
+    from ray_tpu._private.worker_main import WorkerContext
+    from ray_tpu.exceptions import ActorDiedError, TaskError
+
+    class _Caller:
+        def __init__(self):
+            self.seen = []
+
+        def on_actor_died(self, actor_id):
+            self.seen.append(actor_id)
+
+    ctx = WorkerContext.__new__(WorkerContext)
+    ctx._direct = _Caller()
+    ctx._note_actor_death(ActorDiedError("a1", "gone"))
+    ctx._note_actor_death(
+        TaskError(ActorDiedError("a2", "gone"), "tb"))
+    ctx._note_actor_death(ValueError("unrelated"))
+    ctx._note_actor_death(TaskError(ValueError("x"), "tb"))
+    assert ctx._direct.seen == ["a1", "a2"]
+    ctx._direct = None
+    ctx._note_actor_death(ActorDiedError("a3", "gone"))   # no caller: noop
+
+
+def test_delta_window_adapts_to_caller_rate():
+    """r20: the ACTOR_INFLIGHT_DELTA collect window widens while
+    flushes run near-empty (sparse caller) and shrinks back toward
+    the base when frames fill — head mirror frames amortize by call
+    count, not wall clock."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu._private.direct_actor import WorkerDirectCaller
+
+    class _Conn:
+        def __init__(self):
+            self.sent = []
+
+        def peer_speaks_direct_actor(self):
+            return False
+
+        def send(self, msg):
+            self.sent.append(msg)
+
+    class _Ctx:
+        def __init__(self):
+            self.conn = _Conn()
+
+    d = WorkerDirectCaller(_Ctx())
+    base = CONFIG.direct_actor_delta_delay_ms
+    cap = CONFIG.direct_actor_delta_delay_max_ms
+    assert d._delta_delay_ms() == base
+    # sparse flushes (1 entry each) double the window up to the cap
+    widths = []
+    for _ in range(16):
+        with d._delta_lock:
+            d._delta_buf.append(("done", "a1", "t", False, [], True))
+        d.flush_delta()
+        widths.append(d._delta_delay_ms())
+    assert widths[0] == base * 2
+    assert widths[-1] == cap
+    assert all(b >= a for a, b in zip(widths, widths[1:]))
+    # near-full frames (>= delta_max/2 entries) halve back toward the
+    # base — no cap<->base sawtooth for a mid-rate caller
+    shrink = []
+    for _ in range(16):
+        with d._delta_lock:
+            for i in range(CONFIG.direct_actor_delta_max // 2):
+                d._delta_buf.append(
+                    ("done", "a1", f"t{i}", False, [], True))
+        d.flush_delta()
+        shrink.append(d._delta_delay_ms())
+    assert shrink[0] == cap / 2
+    assert shrink[-1] == base
+    assert all(b <= a for a, b in zip(shrink, shrink[1:]))
+    assert len(d._ctx.conn.sent) == 32        # every flush one frame

@@ -83,6 +83,9 @@ def test_store_overflow_admits_after_budget(monkeypatch):
         CONFIG.reload()
 
 
+@pytest.mark.slow    # ~5s (r20 tier-1 budget): subprocess job e2e;
+# test_store_overflow_admits_after_budget keeps the spill/backpressure
+# admission contract in tier-1.
 def test_job_completes_beyond_capacity(tmp_path):
     """The judge's done-criterion: fill the store far beyond capacity
     under active tasks; the job completes via spill/backpressure."""

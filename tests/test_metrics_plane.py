@@ -283,6 +283,9 @@ def test_submit_stamp_stays_off_the_wire(metrics_env):
     assert (clone.task_id, clone.func_id) == ("t", "f")
 
 
+@pytest.mark.slow    # ~7s (r20 tier-1 budget): the cluster-scoped
+# disabled-mode sweep; test_disabled_mode_records_nothing keeps the
+# disabled-mode contract in tier-1.
 def test_disabled_mode_cluster_ops_empty(metrics_env):
     os.environ["RAY_TPU_METRICS"] = "0"
     CONFIG.reload()

@@ -33,7 +33,8 @@ if _REPO not in sys.path:          # standalone: python tools/chaos_soak.py
 
 SCENARIOS = ("kill", "partition", "blip", "actor_kill",
              "actor_partition", "llm_replica_kill",
-             "llm_replica_partition")
+             "llm_replica_partition", "rl_inference_kill",
+             "rl_inference_partition")
 
 
 def _wait(pred, timeout=30.0, step=0.05):
@@ -346,6 +347,105 @@ def run_llm_scenario(rt, agents, scenario: str, seed: int = 0,
     return report
 
 
+def run_rl_scenario(rt, agents, scenario: str, seed: int = 0,
+                    shards_pre: int = 3, shards_post: int = 6) -> dict:
+    """r20 Sebulba gates: kill or partition an inference actor
+    MID-STREAM. Env runners (pinned to the head, out of the blast
+    radius) must fail over to the surviving inference actor with zero
+    hangs; the learner's per-runner shard seqs must stay contiguous
+    (exact step accounting — a failover re-asks the same observation,
+    it never loses or duplicates an env step); a partitioned zombie
+    must be fenced behind a fresh node incarnation."""
+    import ray_tpu
+    from ray_tpu.rllib.sebulba import SebulbaConfig
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..", "tests"))
+    import chaos
+
+    kind = scenario.split("_")[-1]            # kill | partition
+    tag = f"soak_{scenario}_{seed}"
+    # pace the inference forward so rollouts outlive fault detection
+    # (inference actors inherit this env at agent spawn)
+    os.environ["RAY_TPU_RL_STEP_DELAY_S"] = "0.05"
+    # one inference actor per agent: each agent carries one tag slot
+    nids = [_join_agent(rt, agents, {tag: 1.0}) for _ in range(2)]
+    inc0 = {n: rt.controller.node_incarnation(n) for n in nids}
+
+    t0 = time.time()
+    cfg = SebulbaConfig(
+        num_env_runners=2, num_inference_actors=2,
+        num_envs_per_runner=4, rollout_length=8,
+        act_timeout_s=20.0, read_timeout_s=60.0,
+        inference_options={"num_cpus": 0, "resources": {tag: 1.0},
+                           "max_concurrency": 16},
+        runner_options={"num_cpus": 0, "resources": {"head": 0.5}},
+        seed=seed)
+    tr = cfg.build()
+    hangs = 0
+    try:
+        # inference actors must sit on DISTINCT agents: the fault has
+        # to leave a live survivor for the runners to fail over to
+        def _spread():
+            recs = [rt.controller.get_actor(h._actor_id)
+                    for h in tr._infer]
+            return len({r.node_id for r in recs if r is not None}) == 2
+        assert _wait(_spread, 30), "inference actors did not spread"
+
+        def consume(n):
+            nonlocal hangs
+            for _ in range(n):
+                try:
+                    tr.learner.update_shard(tr._next_shard())
+                    tr._publish()
+                except TimeoutError:
+                    hangs += 1
+        consume(shards_pre)                   # stream is warm
+        victim = rt.controller.get_actor(tr._infer[0]._actor_id)
+        if kind == "kill":
+            chaos.drop_worker(rt, victim.node_id, victim.worker_id)
+        else:
+            chaos.partition(rt, victim.node_id)
+            assert _wait(lambda: not rt.cluster.get_node(
+                victim.node_id).alive, 20), \
+                "partitioned agent not declared dead"
+            time.sleep(0.3)
+            chaos.heal(rt, victim.node_id)
+            assert _wait(lambda: rt.cluster.get_node(
+                victim.node_id).alive, 30), \
+                "fenced agent did not re-register"
+        consume(shards_post)                  # through the fault
+        runner_stats = ray_tpu.get(
+            [r.stats.remote() for r in tr._runners], timeout=30)
+        failovers = sum(s["failovers"] for s in runner_stats)
+        stream_errors = sum(1 for s in runner_stats
+                            if s["stream_error"] is not None)
+        report = {
+            "scenario": scenario, "seed": seed,
+            "wall_s": round(time.time() - t0, 2),
+            "shards": tr.learner.shards_consumed,
+            "updates": tr.learner.version,
+            "steps": tr.learner.steps_consumed,
+            "hangs": hangs, "seq_gaps": tr.learner.seq_gaps,
+            "failovers": failovers, "stream_errors": stream_errors,
+            "staleness_max": tr.learner.staleness_max,
+        }
+        ok = (hangs == 0                       # zero env-runner hangs
+              and stream_errors == 0
+              and failovers >= 1               # the fault hit acting
+              and tr.learner.seq_gaps == 0     # exact step accounting
+              and tr.learner.shards_consumed == shards_pre + shards_post
+              and tr.learner.version == tr.learner.shards_consumed)
+        if kind == "partition":
+            # zombie fenced: fresh incarnation after the heal
+            ok = ok and rt.controller.node_incarnation(
+                victim.node_id) > inc0[victim.node_id]
+        report["ok"] = ok
+        return report
+    finally:
+        tr.stop()
+        os.environ.pop("RAY_TPU_RL_STEP_DELAY_S", None)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="chaos_soak")
     p.add_argument("--scenarios", default=",".join(SCENARIOS))
@@ -371,7 +471,10 @@ def main(argv=None) -> int:
         try:
             for scenario in args.scenarios.split(","):
                 scenario = scenario.strip()
-                if scenario.startswith("llm_"):
+                if scenario.startswith("rl_"):
+                    rep = run_rl_scenario(rt, agents, scenario,
+                                          seed=seed)
+                elif scenario.startswith("llm_"):
                     rep = run_llm_scenario(rt, agents, scenario,
                                            seed=seed)
                 elif scenario.startswith("actor_"):

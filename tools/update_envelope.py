@@ -63,6 +63,12 @@ LABELS = [
     ("serve_llm_stream",
      "LLM serving open-loop, 2 replica groups, direct-stream tokens "
      "(r19)"),
+    ("rl_sebulba_head",
+     "Sebulba RL, 4 env-runners x 2 inference actors, head-routed "
+     "act() (RAY_TPU_DIRECT_ACTOR=0)"),
+    ("rl_sebulba_direct",
+     "Sebulba RL, 4 env-runners x 2 inference actors, direct-plane "
+     "act() (r20)"),
     ("tasks_sync_per_s", "tasks, sync round-trip"),
     ("tasks_batch_per_s", "tasks, batched"),
     ("actor_calls_sync_per_s", "actor calls, sync"),
@@ -136,6 +142,13 @@ def _fmt_result(rec: dict) -> str:
                     f"{rec['head_frames_per_token']})")
         if "stream_speedup" in rec:
             out += f" (stream speedup {rec['stream_speedup']}x)"
+        if "staleness_p50" in rec:
+            # r20 Sebulba columns: policy-version lag of each shard
+            # the learner consumed (bounded by the trajectory ring
+            # depth by construction — the queue bound IS the
+            # staleness bound)
+            out += (f" (staleness p50/p95 {rec['staleness_p50']}/"
+                    f"{rec['staleness_p95']})")
         if "p50_ms" in rec:
             # r18 latency columns: sync scenarios carry per-call
             # percentiles so a latency regression can't hide behind
